@@ -1,0 +1,30 @@
+"""consul-tpu: a TPU-native distributed-coordination framework.
+
+A from-scratch re-design of HashiCorp Consul's capability set
+(SWIM gossip membership + failure detection, Serf-style Lamport-clocked
+event broadcast, Vivaldi network coordinates, Raft-backed catalog/KV with
+blocking queries, HTTP/DNS/CLI surface) built JAX/XLA-first.
+
+Its distinguishing capability is the *gossip simulation backend*: the
+memberlist probe/suspect/dead state machine and Serf's user-event epidemic
+broadcast are re-expressed as vectorized sparse message passing lowered to
+``jax.lax.scan`` + scatter/segment ops, sharded with ``jax.sharding`` across
+a TPU mesh, so failure-detection and broadcast-convergence behavior can be
+studied at million-node scale.
+
+Layout:
+  - ``consul_tpu.protocol`` — protocol constants + scaling formulas
+    (the ground truth both the simulator and the host agent obey).
+  - ``consul_tpu.ops``      — array primitives (random peer sampling,
+    infection scatter/arrival ops).
+  - ``consul_tpu.models``   — the protocol planes as pure JAX models
+    (SWIM failure detection, event broadcast).
+  - ``consul_tpu.parallel`` — device-mesh / sharding helpers (node-axis
+    sharding, segment<->device mapping).
+  - ``consul_tpu.sim``      — scan-based simulation engine, metrics,
+    and the baseline scenario presets.
+"""
+
+from consul_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,36 @@
+"""Protocol planes as pure JAX models.
+
+Each model exposes an ``init(...) -> State`` and a
+``round(state, key, cfg) -> State`` pure function; the engine in
+``consul_tpu.sim`` scans them over time and shards them over devices.
+"""
+
+from consul_tpu.models.broadcast import (
+    BroadcastConfig,
+    BroadcastState,
+    broadcast_init,
+    broadcast_round,
+)
+from consul_tpu.models.swim import (
+    SwimConfig,
+    SwimState,
+    swim_init,
+    swim_round,
+    VIEW_ALIVE,
+    VIEW_SUSPECT,
+    VIEW_DEAD,
+)
+
+__all__ = [
+    "BroadcastConfig",
+    "BroadcastState",
+    "broadcast_init",
+    "broadcast_round",
+    "SwimConfig",
+    "SwimState",
+    "swim_init",
+    "swim_round",
+    "VIEW_ALIVE",
+    "VIEW_SUSPECT",
+    "VIEW_DEAD",
+]

@@ -1,0 +1,142 @@
+"""Serf user-event epidemic broadcast as a vectorized JAX model.
+
+Re-expresses the reference's event path — serf.UserEvent queues the event
+on a TransmitLimitedQueue, every gossip tick each node drains its queue
+into packets for GossipNodes random peers, receivers dedup against a
+Lamport-keyed ring buffer and re-queue for rebroadcast
+(serf/serf.go:459-516, serf/delegate.go:64-73,137-171,
+memberlist/state.go:566-616, memberlist/queue.go:288-373) —
+as one ``(state, key) -> state`` round over N-length arrays:
+
+  knows[i]    — event present in node i's dedup buffer (serf.go:1231-1287)
+  tx_left[i]  — remaining transmissions of the event by node i; fresh
+                recipients get retransmit_limit(mult, N) transmissions
+                (memberlist/util.go:72-76), one per target per tick while
+                budget lasts, mirroring TransmitLimitedQueue semantics.
+
+One tick = one GossipInterval.  Packet loss is a Bernoulli mask per
+(sender, target) message.  Multiple concurrent events vmap over the
+leading axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import (
+    aggregate_arrivals,
+    bernoulli_mask,
+    deliver_or,
+    sample_peers,
+)
+from consul_tpu.protocol import retransmit_limit
+from consul_tpu.protocol.profiles import GossipProfile, LAN
+
+
+@dataclasses.dataclass(frozen=True)
+class BroadcastConfig:
+    """Static (trace-time) parameters of a broadcast study.
+
+    ``delivery`` selects the network model:
+
+    * ``"edges"`` — exact message-level simulation: every sender draws its
+      fanout targets and each (sender, target) message is scattered to its
+      receiver.  The faithful-but-scatter-bound path; default.
+    * ``"aggregate"`` — receiver-side Poissonized delivery: because every
+      in-flight copy of a given message is identical, a receiver's state
+      change depends only on *how many* copies arrive, and with S senders
+      each fanning out F uniform targets, per-receiver arrival counts are
+      Binomial(S*F, (1-loss)/(n-1)) -> Poisson in the large-n limit (the
+      same aggregation step the SWIM paper's analysis uses).  This turns
+      the network into pure elementwise RNG — no scatter, and the only
+      cross-shard traffic is the scalar sender count.  Distributional
+      equivalence to "edges" is pinned by tests/test_aggregate.py.
+    """
+
+    n: int
+    # None = follow the profile (gossip_nodes / retransmit_mult); pass an
+    # int to override for a study.
+    fanout: int | None = None
+    retransmit_mult: int | None = None
+    loss: float = 0.0           # per-message drop probability
+    profile: GossipProfile = LAN
+    delivery: str = "edges"
+
+    def __post_init__(self):
+        if self.delivery not in ("edges", "aggregate"):
+            raise ValueError(
+                f"delivery must be 'edges' or 'aggregate', got {self.delivery!r}"
+            )
+        if self.fanout is None:
+            object.__setattr__(self, "fanout", self.profile.gossip_nodes)
+        if self.retransmit_mult is None:
+            object.__setattr__(
+                self, "retransmit_mult", self.profile.retransmit_mult
+            )
+
+    @property
+    def tx_limit(self) -> int:
+        return retransmit_limit(self.retransmit_mult, self.n)
+
+
+class BroadcastState(NamedTuple):
+    knows: jax.Array    # bool[n]
+    tx_left: jax.Array  # int32[n]
+    tick: jax.Array     # int32 scalar
+
+
+def broadcast_init(cfg: BroadcastConfig, origin: int = 0) -> BroadcastState:
+    """Event fired at ``origin`` (serf.UserEvent handles it locally and
+    queues the broadcast, serf/serf.go:507-515)."""
+    knows = jnp.zeros((cfg.n,), jnp.bool_).at[origin].set(True)
+    tx_left = jnp.zeros((cfg.n,), jnp.int32).at[origin].set(cfg.tx_limit)
+    return BroadcastState(knows=knows, tx_left=tx_left, tick=jnp.int32(0))
+
+
+def broadcast_round(
+    state: BroadcastState,
+    key: jax.Array,
+    cfg: BroadcastConfig,
+    alive: Optional[jax.Array] = None,
+) -> BroadcastState:
+    """One gossip tick.  ``alive`` (bool[n], optional) masks nodes that
+    neither send nor count as reachable targets (failed nodes still
+    receive in the reference until reaped; modeling them as deaf is the
+    conservative choice for convergence measurements)."""
+    n, fanout = cfg.n, cfg.fanout
+    k_sel, k_loss = jax.random.split(key)
+
+    senders = state.knows & (state.tx_left > 0)
+    if alive is not None:
+        senders = senders & alive
+
+    if cfg.delivery == "edges":
+        # Each node picks its gossip targets (memberlist/state.go:575-585
+        # kRandomNodes over the member list, excluding self).
+        targets = sample_peers(k_sel, n, fanout)                   # [n, f]
+        delivered = senders[:, None] & bernoulli_mask(
+            k_loss, (n, fanout), 1.0 - cfg.loss
+        )
+        if alive is not None:
+            delivered = delivered & alive[targets]
+        new_knows = deliver_or(state.knows, targets, delivered)
+    else:
+        # Receiver-side Poissonized delivery (see BroadcastConfig).
+        got = aggregate_arrivals(k_loss, senders, fanout, cfg.loss, n)
+        if alive is not None:
+            got = got & alive
+        new_knows = state.knows | got
+
+    # Senders consumed one transmission per target packet this tick
+    # (queue.go:288-373 increments transmit count per packet drained);
+    # fresh recipients queue the event with a full budget.
+    spent = jnp.where(senders, fanout, 0).astype(jnp.int32)
+    tx_left = jnp.maximum(state.tx_left - spent, 0)
+    newly = new_knows & ~state.knows
+    tx_left = jnp.where(newly, cfg.tx_limit, tx_left)
+
+    return BroadcastState(knows=new_knows, tx_left=tx_left, tick=state.tick + 1)

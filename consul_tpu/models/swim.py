@@ -1,0 +1,418 @@
+"""SWIM probe/suspect/dead state machine as a vectorized JAX model.
+
+This simulates the fate of ONE subject node ``f`` through the eyes of all
+N cluster members — the quantity the north-star studies care about
+(first-detection time, suspicion/dead propagation curves).  Everything a
+member tracks about the subject is a length-N array:
+
+  view[i]           — node i's view of f: ALIVE / SUSPECT / DEAD
+                      (memberlist nodeState.State, state.go)
+  inc_seen[i]       — subject incarnation attached to that view
+                      (nodeState.Incarnation)
+  suspect_since[i]  — tick when i marked f suspect (Lifeguard timer start,
+                      suspicion.go:50-80)
+  confirmations[i]  — independent suspect confirmations received
+                      (suspicion.go:103-130 Confirm)
+  tx_suspect/tx_dead/tx_refute[i] — remaining retransmissions of each
+                      message class in i's TransmitLimitedQueue, with
+                      sus_era/dead_era/ref_era[i] the incarnation the
+                      queued message carries
+  probe_pending_at[i] — tick when i's failed probe of f matures into
+                      suspicion (probes resolve at the end of their
+                      ProbeInterval cycle: direct timeout, then k indirect
+                      probes, then suspect — state.go:283-497)
+
+The protocol rules implemented per tick, with their sources:
+
+  * Probing: every ProbeInterval each node probes one uniform random
+    member (state.go:214-256); probes of a dead subject always fail; a
+    probe of a live subject fails only if the direct ping round-trip AND
+    all IndirectChecks relayed ping paths drop (state.go:326-454).
+  * Suspicion declaration broadcasts suspectMsg carrying the suspector's
+    current incarnation for the subject (state.go:495-496 -> 1134-1217);
+    messages with an incarnation below the receiver's view are ignored.
+  * A suspect message about an already-suspect node is a confirmation and
+    is re-gossiped when new (state.go:1152-1157, suspicion Confirm).
+  * Suspicion timeout starts at max = SuspicionMaxTimeoutMult * min and is
+    driven toward min = suspicionTimeout(mult, n, ProbeInterval) on a log
+    scale by k = SuspicionMult - 2 confirmations (state.go:1186-1199,
+    suspicion.go:86-97); expiry declares the node dead and broadcasts
+    deadMsg at the suspicion's incarnation (state.go:1200-1215).
+  * The subject refutes every suspect/dead message about itself by
+    broadcasting alive with incarnation accused+1 (state.go:1166-1170,
+    1246-1251, refute at state.go:880-915); an alive message with a
+    strictly higher incarnation overrides any view including DEAD
+    (aliveNode, state.go:917-1131), so false-positive suspicion can
+    recur at ever-higher incarnations ("flapping"), exactly like the
+    reference.
+  * Queueing a broadcast for a node invalidates its older queued
+    broadcasts (TransmitLimitedQueue name-keyed replacement, queue.go).
+
+One tick = one GossipInterval; all packets between a pair within a tick
+ride one compound packet (net.go makeCompoundMessage), so one
+targets/loss draw per tick covers all three message classes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import (
+    aggregate_arrivals,
+    bernoulli_mask,
+    deliver_max,
+    sample_peers,
+    sample_probe_targets,
+)
+from consul_tpu.protocol import (
+    retransmit_limit,
+    suspicion_timeout_bounds,
+)
+from consul_tpu.protocol.profiles import GossipProfile, LAN
+
+VIEW_ALIVE = 0
+VIEW_SUSPECT = 1
+VIEW_DEAD = 2
+
+NEVER = jnp.iinfo(jnp.int32).max
+NO_MSG = -1  # "no copy arrived" marker in received-era arrays
+
+
+@dataclasses.dataclass(frozen=True)
+class SwimConfig:
+    """Static parameters of a failure-detection study."""
+
+    n: int
+    subject: int = 0
+    subject_alive: bool = False   # False: crash study; True: false-positive study
+    fail_at_tick: int = 0
+    loss: float = 0.0
+    profile: GossipProfile = LAN
+    # "edges" = exact per-message scatter; "aggregate" = receiver-side
+    # Poissonized arrival counts (see BroadcastConfig.delivery — identical
+    # reasoning; message classes here are suspect/dead/refute).
+    delivery: str = "edges"
+
+    def __post_init__(self):
+        if self.delivery not in ("edges", "aggregate"):
+            raise ValueError(
+                f"delivery must be 'edges' or 'aggregate', got {self.delivery!r}"
+            )
+
+    @property
+    def fanout(self) -> int:
+        return self.profile.gossip_nodes
+
+    @property
+    def tx_limit(self) -> int:
+        return retransmit_limit(self.profile.retransmit_mult, self.n)
+
+    @property
+    def probe_interval_ticks(self) -> int:
+        return self.profile.probe_interval_ticks
+
+    @property
+    def confirmations_k(self) -> int:
+        # state.go:1186-1196: k = SuspicionMult - 2, or 0 if n-2 < k.
+        k = self.profile.suspicion_mult - 2
+        return 0 if self.n - 2 < k else k
+
+    @property
+    def suspicion_bounds_ticks(self) -> tuple[float, float]:
+        lo_ms, hi_ms = suspicion_timeout_bounds(
+            self.profile.suspicion_mult,
+            self.profile.suspicion_max_timeout_mult,
+            self.n,
+            self.profile.probe_interval_ms,
+        )
+        g = self.profile.gossip_interval_ms
+        return lo_ms / g, hi_ms / g
+
+    @property
+    def probe_fail_prob_alive(self) -> float:
+        """P(a probe of the *live* subject fails) under Bernoulli loss:
+        the direct ping round-trip (2 legs) and each of the
+        IndirectChecks relayed paths (4 legs) must all drop
+        (state.go:326-454; TCP fallback not modeled)."""
+        ok = 1.0 - self.loss
+        p_direct = 1.0 - ok**2
+        p_indirect = 1.0 - ok**4
+        return p_direct * (p_indirect ** self.profile.indirect_checks)
+
+
+class SwimState(NamedTuple):
+    view: jax.Array             # int32[n]
+    inc_seen: jax.Array         # int32[n] — incarnation attached to view
+    suspect_since: jax.Array    # int32[n] — NEVER if not suspecting
+    confirmations: jax.Array    # int32[n]
+    tx_suspect: jax.Array       # int32[n]
+    sus_era: jax.Array          # int32[n] — incarnation the queued suspect carries
+    tx_dead: jax.Array          # int32[n]
+    dead_era: jax.Array         # int32[n]
+    tx_refute: jax.Array        # int32[n]
+    ref_era: jax.Array          # int32[n]
+    probe_pending_at: jax.Array # int32[n] — NEVER if no failed probe pending
+    subject_inc: jax.Array      # int32 scalar — subject's own incarnation
+    tick: jax.Array             # int32 scalar
+
+
+def swim_init(cfg: SwimConfig) -> SwimState:
+    n = cfg.n
+    z = jnp.zeros((n,), jnp.int32)
+    return SwimState(
+        view=z,
+        inc_seen=z,
+        suspect_since=jnp.full((n,), NEVER, jnp.int32),
+        confirmations=z,
+        tx_suspect=z,
+        sus_era=z,
+        tx_dead=z,
+        dead_era=z,
+        tx_refute=z,
+        ref_era=z,
+        probe_pending_at=jnp.full((n,), NEVER, jnp.int32),
+        subject_inc=jnp.int32(0),
+        tick=jnp.int32(0),
+    )
+
+
+def _lifeguard_timeout_ticks(cfg: SwimConfig, confirmations: jax.Array) -> jax.Array:
+    """Vectorized suspicion.go:86-97 remainingSuspicionTime (total timeout,
+    in fractional ticks).  Parity with
+    protocol.formulas.remaining_suspicion_timeout is pinned by tests."""
+    lo, hi = cfg.suspicion_bounds_ticks
+    k = cfg.confirmations_k
+    if k < 1:
+        return jnp.full_like(confirmations, lo, dtype=jnp.float32)
+    frac = jnp.log(confirmations.astype(jnp.float32) + 1.0) / math.log(k + 1.0)
+    raw = hi - frac * (hi - lo)
+    # Reference floors at ms precision; a tick is coarser than a ms, so
+    # round UP at tick precision so expiry never fires earlier than the
+    # reference would (same rationale as profiles.ticks_for).
+    return jnp.maximum(jnp.ceil(raw), lo)
+
+
+def swim_round(state: SwimState, key: jax.Array, cfg: SwimConfig) -> SwimState:
+    n, f = cfg.n, cfg.subject
+    t = state.tick
+    k_gossip, k_loss, k_probe, k_pfail = jax.random.split(key, 4)
+
+    subject_dead_now = jnp.logical_and(
+        jnp.logical_not(cfg.subject_alive), t >= cfg.fail_at_tick
+    )
+    is_subject = jnp.arange(n, dtype=jnp.int32) == f
+    not_subject = jnp.logical_not(is_subject)
+    # The subject does not participate in gossip once crashed.
+    participates = jnp.where(is_subject & subject_dead_now, False, True)
+
+    # ------------------------------------------------------------------
+    # 1. Gossip fan-out: one compound packet per (sender, target).
+    #    Per message class the receiver needs (a) did >= 1 copy arrive,
+    #    (b) the highest incarnation among arriving copies.
+    # ------------------------------------------------------------------
+    can_send = participates                                          # [n]
+
+    if cfg.delivery == "edges":
+        targets = sample_peers(k_gossip, n, cfg.fanout)              # [n, F]
+        wire_ok = bernoulli_mask(k_loss, (n, cfg.fanout), 1.0 - cfg.loss)
+        # A crashed subject neither sends nor receives.
+        wire_ok = wire_ok & jnp.take(participates, targets)
+
+        def rx_era(tx_left: jax.Array, era: jax.Array) -> jax.Array:
+            """int32[n]: max incarnation among copies received this tick
+            (NO_MSG if none)."""
+            send = can_send & (tx_left > 0)
+            delivered = send[:, None] & wire_ok
+            vals = jnp.broadcast_to(era[:, None], (n, cfg.fanout))
+            return deliver_max(
+                jnp.full((n,), NO_MSG, jnp.int32), targets, vals, delivered
+            )
+
+        sus_rx = rx_era(state.tx_suspect, state.sus_era)
+        dead_rx = rx_era(state.tx_dead, state.dead_era)
+        ref_rx = rx_era(state.tx_refute, state.ref_era)
+    else:
+        # Receiver-side Poissonized delivery: arrival of a class depends
+        # only on the global sender count, and the arriving incarnation is
+        # approximated by the newest circulating one (cycles are nearly
+        # synchronized: a new incarnation only starts once the previous
+        # refute has spread).  The "network" is elementwise RNG; the only
+        # cross-shard traffic is three scalar sums and three scalar maxes.
+        k_sus, k_dead, k_ref = jax.random.split(k_gossip, 3)
+
+        def rx_era(kcls, tx_left: jax.Array, era: jax.Array) -> jax.Array:
+            send = can_send & (tx_left > 0)
+            got = aggregate_arrivals(kcls, send, cfg.fanout, cfg.loss, n)
+            got = got & participates
+            newest = jnp.max(jnp.where(send, era, NO_MSG))
+            return jnp.where(got, newest, NO_MSG)
+
+        sus_rx = rx_era(k_sus, state.tx_suspect, state.sus_era)
+        dead_rx = rx_era(k_dead, state.tx_dead, state.dead_era)
+        ref_rx = rx_era(k_ref, state.tx_refute, state.ref_era)
+
+    # Budget spent: one transmission per target packet drained this tick.
+    def spend(tx_left):
+        send = can_send & (tx_left > 0)
+        return jnp.maximum(tx_left - jnp.where(send, cfg.fanout, 0), 0)
+
+    tx_suspect = spend(state.tx_suspect)
+    tx_dead = spend(state.tx_dead)
+    tx_refute = spend(state.tx_refute)
+    sus_era, dead_era, ref_era = state.sus_era, state.dead_era, state.ref_era
+
+    # ------------------------------------------------------------------
+    # 2. Apply deliveries (incarnation-ordered merge rules).
+    # ------------------------------------------------------------------
+    view, inc_seen = state.view, state.inc_seen
+    suspect_since, confirmations = state.suspect_since, state.confirmations
+
+    # Suspect msgs: ignored below the receiver's incarnation
+    # (state.go:1145-1148).  New-to-us while ALIVE -> SUSPECT at the
+    # message's incarnation, start Lifeguard timer, re-gossip
+    # (state.go:1134-1217).  The subject itself never becomes suspect of
+    # itself — it refutes instead (state.go:1166-1170).
+    got_suspect = sus_rx >= jnp.maximum(inc_seen, 0)
+    fresh_suspect = got_suspect & (view == VIEW_ALIVE) & not_subject
+    # Already-suspect: confirmations accumulate toward k, and new
+    # confirmations are re-gossiped (suspicion.go Confirm -> broadcast).
+    # Lifeguard counts *distinct* confirmers (suspicion.go:40-44 keys by
+    # From, and re-gossiped suspect msgs keep their original From); we
+    # approximate distinctness by counting at most one confirmation per
+    # tick — a given origin suspector transmits to any one receiver at
+    # most ~once per tick, and with many circulating origins a repeat
+    # from the same origin across ticks is O(1/origins) likely.
+    confirming = got_suspect & (view == VIEW_SUSPECT)
+    new_conf = jnp.minimum(
+        confirmations + confirming.astype(jnp.int32), cfg.confirmations_k
+    )
+    gained_conf = confirming & (new_conf > confirmations)
+    confirmations = new_conf
+
+    view = jnp.where(fresh_suspect, VIEW_SUSPECT, view)
+    inc_seen = jnp.where(fresh_suspect, sus_rx, inc_seen)
+    suspect_since = jnp.where(fresh_suspect, t, suspect_since)
+    rebroadcast_sus = fresh_suspect | gained_conf
+    tx_suspect = jnp.where(rebroadcast_sus, cfg.tx_limit, tx_suspect)
+    sus_era = jnp.where(rebroadcast_sus, jnp.maximum(sus_era, sus_rx), sus_era)
+
+    # The subject refutes every suspect/dead message about itself while
+    # alive with incarnation accused+1 (state.go:880-915 refute;
+    # 1166-1170, 1246-1251) — per message, not once, which is what
+    # guarantees eventual recovery of false-DEAD views and produces the
+    # recurring-suspicion "flapping" the reference exhibits under loss.
+    accused = jnp.maximum(sus_rx[f], dead_rx[f])
+    refute_now = (
+        jnp.bool_(cfg.subject_alive) & (accused >= state.subject_inc)
+    )
+    subject_inc = jnp.where(refute_now, accused + 1, state.subject_inc)
+    tx_refute = tx_refute.at[f].set(
+        jnp.where(refute_now, cfg.tx_limit, tx_refute[f])
+    )
+    ref_era = ref_era.at[f].set(
+        jnp.where(refute_now, subject_inc, ref_era[f])
+    )
+
+    # Refute (alive) deliveries: an alive message with a strictly higher
+    # incarnation overrides any view — including DEAD (aliveNode
+    # resurrects when a.Incarnation > state.Incarnation, state.go:917+).
+    accept_refute = ref_rx > inc_seen
+    view = jnp.where(accept_refute, VIEW_ALIVE, view)
+    inc_seen = jnp.where(accept_refute, ref_rx, inc_seen)
+    suspect_since = jnp.where(accept_refute, NEVER, suspect_since)
+    confirmations = jnp.where(accept_refute, 0, confirmations)
+    tx_refute = jnp.where(accept_refute, cfg.tx_limit, tx_refute)
+    ref_era = jnp.where(accept_refute, ref_rx, ref_era)
+    # Queueing the alive rebroadcast invalidates queued suspect/dead
+    # broadcasts for the same node (TransmitLimitedQueue name-keyed
+    # replacement, memberlist/queue.go).
+    tx_suspect = jnp.where(accept_refute, 0, tx_suspect)
+    tx_dead = jnp.where(accept_refute, 0, tx_dead)
+
+    # Dead deliveries: dead overrides suspect/alive at >= the receiver's
+    # incarnation (deadNode ignores lower incarnations, state.go:1228-1232),
+    # so a stale dead loses to a higher-incarnation refuted-alive view.
+    accept_dead = (dead_rx >= inc_seen) & (view != VIEW_DEAD)
+    if cfg.subject_alive:
+        # A live subject refutes its own obituary instead of accepting it.
+        accept_dead = accept_dead & not_subject
+    view = jnp.where(accept_dead, VIEW_DEAD, view)
+    inc_seen = jnp.where(accept_dead, dead_rx, inc_seen)
+    suspect_since = jnp.where(accept_dead, NEVER, suspect_since)
+    tx_dead = jnp.where(accept_dead, cfg.tx_limit, tx_dead)
+    dead_era = jnp.where(accept_dead, dead_rx, dead_era)
+    # Dead supersedes the queued suspect broadcast (queue invalidation).
+    tx_suspect = jnp.where(accept_dead, 0, tx_suspect)
+
+    # ------------------------------------------------------------------
+    # 3. Probe plane (every ProbeInterval ticks).
+    # ------------------------------------------------------------------
+    is_probe_tick = (t % cfg.probe_interval_ticks) == 0
+    probe_target = sample_probe_targets(k_probe, n)
+    # A node only probes members it considers non-dead (the probe loop
+    # skips dead nodes, state.go:241-248).
+    probed_f = (
+        (probe_target == f) & can_send & not_subject & (view != VIEW_DEAD)
+    )
+    # Probes of a crashed subject always fail; of a live subject, fail
+    # only with probe_fail_prob_alive (loss on every path).
+    p_fail = jnp.where(
+        subject_dead_now, 1.0, jnp.float32(cfg.probe_fail_prob_alive)
+    )
+    probe_failed = probed_f & bernoulli_mask(k_pfail, (n,), p_fail) & is_probe_tick
+    # Failed probes mature into suspicion at the end of the probe cycle
+    # (direct timeout + indirect probes fill the interval, state.go:283-497).
+    matures_at = t + cfg.probe_interval_ticks
+    probe_pending_at = jnp.where(
+        probe_failed & (state.probe_pending_at == NEVER),
+        matures_at,
+        state.probe_pending_at,
+    )
+    # Mature pending probes -> local suspicion at the prober's current
+    # incarnation for the subject + broadcast, if the view is still ALIVE
+    # (probeNode suspects with state.Incarnation, state.go:495-496); this
+    # is what restarts suspicion at incarnation k after a refute at k.
+    maturing = (probe_pending_at <= t) & (view == VIEW_ALIVE)
+    view = jnp.where(maturing, VIEW_SUSPECT, view)
+    suspect_since = jnp.where(maturing, t, suspect_since)
+    tx_suspect = jnp.where(maturing, cfg.tx_limit, tx_suspect)
+    sus_era = jnp.where(maturing, inc_seen, sus_era)
+    probe_pending_at = jnp.where(
+        probe_pending_at <= t, NEVER, probe_pending_at
+    )
+
+    # ------------------------------------------------------------------
+    # 4. Suspicion timeout expiry -> declare dead at the suspicion's
+    #    incarnation, broadcast deadMsg (state.go:1200-1215).
+    # ------------------------------------------------------------------
+    timeout_ticks = _lifeguard_timeout_ticks(cfg, confirmations)
+    elapsed = (t - suspect_since).astype(jnp.float32)
+    expire = (view == VIEW_SUSPECT) & (suspect_since != NEVER) & (
+        elapsed >= timeout_ticks
+    )
+    view = jnp.where(expire, VIEW_DEAD, view)
+    suspect_since = jnp.where(expire, NEVER, suspect_since)
+    tx_dead = jnp.where(expire, cfg.tx_limit, tx_dead)
+    dead_era = jnp.where(expire, inc_seen, dead_era)
+    tx_suspect = jnp.where(expire, 0, tx_suspect)  # queue invalidation
+
+    return SwimState(
+        view=view,
+        inc_seen=inc_seen,
+        suspect_since=suspect_since,
+        confirmations=confirmations,
+        tx_suspect=tx_suspect,
+        sus_era=sus_era,
+        tx_dead=tx_dead,
+        dead_era=dead_era,
+        tx_refute=tx_refute,
+        ref_era=ref_era,
+        probe_pending_at=probe_pending_at,
+        subject_inc=subject_inc,
+        tick=t + 1,
+    )

@@ -1,0 +1,27 @@
+"""Array primitives shared by the simulation models.
+
+These are the TPU-native equivalents of the reference's inner loops:
+random peer sampling (memberlist/util.go:125-153 kRandomNodes),
+broadcast fan-out delivery (memberlist/state.go:566-616 gossip +
+queue.go TransmitLimitedQueue), and the per-edge packet-loss model.
+"""
+
+from consul_tpu.ops.sampling import (
+    sample_peers,
+    sample_probe_targets,
+    bernoulli_mask,
+    aggregate_arrivals,
+)
+from consul_tpu.ops.scatter import (
+    deliver_or,
+    deliver_max,
+)
+
+__all__ = [
+    "sample_peers",
+    "sample_probe_targets",
+    "bernoulli_mask",
+    "aggregate_arrivals",
+    "deliver_or",
+    "deliver_max",
+]

@@ -1,0 +1,86 @@
+"""Random peer sampling — the vectorized analogue of kRandomNodes.
+
+The reference selects gossip/probe targets by rejection-sampling random
+member-list offsets, excluding self and filtered nodes
+(memberlist/util.go:125-153, state.go:541-562).  Here every node draws its
+targets in parallel from a per-(round, node) PRNG stream, so a simulated
+round is a pure function of ``(state, key)`` and therefore reproducible
+across shardings and device counts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_peers(key: jax.Array, n: int, fanout: int) -> jax.Array:
+    """Each of the n nodes picks ``fanout`` peers uniformly, excluding self.
+
+    Returns int32 [n, fanout] of target indices in [0, n), never equal to
+    the row index.  Self-exclusion uses the shift trick: draw from
+    [0, n-1) and bump values >= self by one — exact uniform over the
+    other n-1 nodes, no rejection loop (which would be data-dependent
+    control flow under jit).
+
+    Unlike kRandomNodes (memberlist/util.go:131-153) we do not dedupe the
+    ``fanout`` draws within one node/round; for n >> fanout the collision
+    probability is O(fanout^2/n) and does not measurably distort
+    convergence (a collision just wastes one transmission, which real UDP
+    loss does far more often).
+    """
+    draws = jax.random.randint(
+        key, (n, fanout), minval=0, maxval=max(n - 1, 1), dtype=jnp.int32
+    )
+    self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    return jnp.where(draws >= self_idx, draws + 1, draws) % n
+
+
+def sample_probe_targets(key: jax.Array, n: int) -> jax.Array:
+    """One probe target per node per probe round (memberlist probes one
+    node per ProbeInterval, state.go:214-256).  Uniform excluding self.
+
+    The reference iterates a shuffled ring rather than sampling uniformly;
+    over timescales of the suspicion timeout (many probe rounds) the
+    per-round marginal is the same 1/(n-1) per peer, which is what the
+    SWIM paper's analysis assumes.  Returns int32 [n].
+    """
+    return sample_peers(key, n, 1)[:, 0]
+
+
+def bernoulli_mask(key: jax.Array, shape, p_success) -> jax.Array:
+    """Per-message delivery mask: True = delivered.
+
+    The BASELINE loss configs (1% failure, 30% loss) are Bernoulli masks
+    on simulated edges (SURVEY.md §5).  ``p_success`` = 1 - loss rate.
+    """
+    return jax.random.uniform(key, shape) < p_success
+
+
+def aggregate_arrivals(
+    key: jax.Array,
+    senders: jax.Array,
+    fanout: int,
+    loss: float,
+    n: int,
+) -> jax.Array:
+    """bool[n]: received >= 1 copy, under Poissonized push-gossip delivery.
+
+    The receiver-side dual of ``sample_peers`` + scatter: with S senders
+    each pushing ``fanout`` copies to uniform non-self targets and each
+    copy surviving loss independently, receiver arrival counts are
+    Binomial(S*fanout, (1-loss)/(n-1)) -> Poisson in the large-n limit,
+    so P(>=1 copy) = 1 - exp(-lambda).  A sender's own copies are
+    excluded from its lambda (it never targets itself).  All copies of a
+    message class being identical is what makes the count sufficient —
+    see BroadcastConfig.delivery for the full argument; equivalence to
+    the exact edge-level path is pinned by tests/test_aggregate.py.
+    """
+    s_total = jnp.sum(senders, dtype=jnp.float32)
+    lam = (
+        (s_total - senders.astype(jnp.float32))
+        * fanout
+        * (1.0 - loss)
+        / max(n - 1, 1)
+    )
+    return jax.random.uniform(key, (n,)) < -jnp.expm1(-lam)

@@ -1,0 +1,52 @@
+"""Message delivery as scatter ops.
+
+A gossip round's "network" is one scatter: every sender wrote its payload
+at its targets' indices.  These wrappers centralize the scatter idioms so
+the models stay readable and so a Pallas/sort-based implementation can be
+swapped in underneath without touching the protocol code.
+
+All ops take flat target indices (int32 [m]) plus a delivery mask
+(bool [m]); masked-out messages are dropped by pointing them at index n
+(out of range) with mode='drop' — this keeps shapes static under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_targets(targets: jax.Array, mask: jax.Array, n: int) -> jax.Array:
+    """Route undelivered messages to the out-of-range bucket n (dropped)."""
+    return jnp.where(mask, targets, n)
+
+
+def deliver_or(
+    dest: jax.Array, targets: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """OR a True bit into dest[t] for every delivered message.
+
+    The epidemic-infection primitive: dest is the per-node "knows this
+    message" bit (serf's eventBuffer dedup ring presence,
+    serf/serf.go:1231-1287, collapsed to one bit per in-flight message).
+    """
+    n = dest.shape[-1]
+    t = _masked_targets(targets.ravel(), mask.ravel(), n)
+    hits = jnp.zeros((n,), dtype=jnp.bool_).at[t].set(True, mode="drop")
+    return dest | hits
+
+
+def deliver_max(
+    dest: jax.Array, targets: jax.Array, values: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """dest[t] = max(dest[t], value) per delivered message.
+
+    The merge rule for incarnation numbers and Lamport times is
+    take-the-max (serf/lamport.go:31-45 Witness; memberlist incarnation
+    comparisons in state.go:917-1131 aliveNode).  Reserved for the
+    multi-event serf simulation (Lamport-clock witnessing); not yet used
+    by the single-subject models, which track eras as scalars.
+    """
+    n = dest.shape[-1]
+    t = _masked_targets(targets.ravel(), mask.ravel(), n)
+    return dest.at[t].max(values.ravel(), mode="drop")

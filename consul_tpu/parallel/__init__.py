@@ -1,0 +1,10 @@
+"""Device-mesh and sharding helpers (node-axis data parallelism)."""
+
+from consul_tpu.parallel.mesh import (
+    make_mesh,
+    node_sharding,
+    replicated,
+    shard_state,
+)
+
+__all__ = ["make_mesh", "node_sharding", "replicated", "shard_state"]

@@ -1,0 +1,50 @@
+"""Mesh construction and node-axis sharding.
+
+The simulator's execution strategy is data parallelism over the node
+population (SURVEY.md §2.4): every per-node array is sharded along a
+single ``nodes`` mesh axis; random cross-shard gossip edges become XLA
+collectives over ICI.  Segments/datacenters (the reference's LAN
+partitions, agent/consul/server_serf.go:50) map onto contiguous node
+ranges so that one segment lives on one device and WAN edges are the only
+cross-device traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name ``nodes``."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def node_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a per-node array: first dim split across the mesh."""
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a model state pytree: per-node arrays (ndim >= 1, leading dim
+    divisible by mesh size) sharded on the node axis, scalars replicated."""
+    n_dev = mesh.devices.size
+    shard, repl = node_sharding(mesh), replicated(mesh)
+
+    def place(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % n_dev == 0:
+            return jax.device_put(x, shard)
+        return jax.device_put(x, repl)
+
+    return jax.tree_util.tree_map(place, state)

@@ -1,0 +1,37 @@
+"""Protocol ground truth: timing profiles and scaling formulas.
+
+Both planes of the framework — the TPU simulator (``consul_tpu.sim``) and
+the host agent (``consul_tpu.net``) — import their constants and scaling
+math from here, so there is exactly one place where the protocol is
+defined.
+"""
+
+from consul_tpu.protocol.profiles import (
+    GossipProfile,
+    LAN,
+    WAN,
+    LOCAL,
+    ticks_for,
+)
+from consul_tpu.protocol.formulas import (
+    suspicion_timeout,
+    suspicion_timeout_bounds,
+    remaining_suspicion_timeout,
+    retransmit_limit,
+    push_pull_scale,
+    scale_with_cluster_size,
+)
+
+__all__ = [
+    "GossipProfile",
+    "LAN",
+    "WAN",
+    "LOCAL",
+    "ticks_for",
+    "suspicion_timeout",
+    "suspicion_timeout_bounds",
+    "remaining_suspicion_timeout",
+    "retransmit_limit",
+    "push_pull_scale",
+    "scale_with_cluster_size",
+]

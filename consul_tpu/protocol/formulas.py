@@ -1,0 +1,107 @@
+"""Scaling formulas that define the protocol's O(log N) behavior.
+
+These are scalar (host-side) reference implementations; the vectorized
+JAX versions in ``consul_tpu.models`` are pinned to these by parity tests
+(tests/test_formulas.py).
+
+Sources in the reference:
+  - suspicion_timeout:    vendor/memberlist/util.go:64-69
+  - retransmit_limit:     vendor/memberlist/util.go:72-76
+  - push_pull_scale:      vendor/memberlist/util.go:89-97
+  - remaining_suspicion_timeout: vendor/memberlist/suspicion.go:86-97
+  - scale_with_cluster_size (anti-entropy): agent/ae/ae.go:25-38
+"""
+
+from __future__ import annotations
+
+import math
+
+#: Cluster size above which push/pull anti-entropy slows down
+#: (memberlist: pushPullScaleThreshold = 32).
+PUSH_PULL_SCALE_THRESHOLD = 32
+
+#: Cluster size above which agent anti-entropy sync runs spread out
+#: (agent/ae/ae.go:25 scaleThreshold = 128).
+AE_SCALE_THRESHOLD = 128
+
+
+def suspicion_timeout(suspicion_mult: int, n: int, interval_ms: float) -> float:
+    """Base suspicion timeout before confirmations, in ms.
+
+    memberlist/util.go:64-69: ``mult * max(1, log10(max(1, n))) * interval``
+    with the node scale kept to 1/1000 precision (the Go code multiplies by
+    1000 and truncates to keep precision inside integer time.Duration math).
+    """
+    node_scale = max(1.0, math.log10(max(1.0, float(n))))
+    # Mirror the reference's fixed-point rounding: Duration(nodeScale*1000)
+    # truncates toward zero, then divides by 1000.
+    return suspicion_mult * math.floor(node_scale * 1000.0) * interval_ms / 1000.0
+
+
+def suspicion_timeout_bounds(
+    suspicion_mult: int, max_timeout_mult: int, n: int, interval_ms: float
+) -> tuple[float, float]:
+    """(min, max) suspicion timeout in ms.
+
+    memberlist/state.go:1187-1217: min = suspicionTimeout(...), max =
+    SuspicionMaxTimeoutMult * min.
+    """
+    lo = suspicion_timeout(suspicion_mult, n, interval_ms)
+    return lo, max_timeout_mult * lo
+
+
+def remaining_suspicion_timeout(
+    confirmations: int, k: int, min_ms: float, max_ms: float
+) -> float:
+    """Total (not remaining-after-elapsed) suspicion timeout in ms after
+    ``confirmations`` independent confirmations, driving from max toward
+    min on a log scale in the number of confirmations.
+
+    memberlist/suspicion.go:86-97 (Lifeguard):
+      frac    = log(n+1) / log(k+1)
+      timeout = max - frac*(max-min), floored to ms, clamped to >= min.
+
+    The reference subtracts elapsed time from this to reset its timer; we
+    return the total timeout and let callers compare against elapsed.
+    """
+    if k < 1:
+        return min_ms
+    frac = math.log(confirmations + 1.0) / math.log(k + 1.0)
+    raw = max_ms - frac * (max_ms - min_ms)
+    timeout = math.floor(raw)  # reference floors at ms precision
+    return max(timeout, min_ms)
+
+
+def retransmit_limit(retransmit_mult: int, n: int) -> int:
+    """Number of times a broadcast is retransmitted: mult * ceil(log10(n+1)).
+
+    memberlist/util.go:72-76.
+    """
+    return retransmit_mult * int(math.ceil(math.log10(float(n + 1))))
+
+
+def push_pull_scale(interval_ms: float, n: int) -> float:
+    """Scaled push/pull (full state sync) interval in ms.
+
+    memberlist/util.go:89-97: no scaling until n > 32, then
+    ``ceil(log2(n) - log2(32)) + 1`` multiplier (doubles every doubling).
+    """
+    if n <= PUSH_PULL_SCALE_THRESHOLD:
+        return interval_ms
+    multiplier = math.ceil(
+        math.log2(float(n)) - math.log2(float(PUSH_PULL_SCALE_THRESHOLD))
+    ) + 1.0
+    return multiplier * interval_ms
+
+
+def scale_with_cluster_size(n: int) -> int:
+    """Anti-entropy sync delay factor for an n-node cluster.
+
+    agent/ae/ae.go:33-38 scaleFactor: 1 until n > 128, then
+    ``ceil(log2(n) - log2(128)) + 1``.
+    """
+    if n <= AE_SCALE_THRESHOLD:
+        return 1
+    return int(
+        math.ceil(math.log2(float(n)) - math.log2(float(AE_SCALE_THRESHOLD))) + 1.0
+    )

@@ -1,0 +1,23 @@
+"""Scan-based simulation engine, convergence metrics, scenario presets."""
+
+from consul_tpu.sim.engine import (
+    run_broadcast,
+    run_swim,
+    broadcast_scan,
+    swim_scan,
+)
+from consul_tpu.sim.metrics import (
+    time_to_fraction,
+    BroadcastReport,
+    SwimReport,
+)
+
+__all__ = [
+    "run_broadcast",
+    "run_swim",
+    "broadcast_scan",
+    "swim_scan",
+    "time_to_fraction",
+    "BroadcastReport",
+    "SwimReport",
+]

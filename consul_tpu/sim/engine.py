@@ -1,0 +1,127 @@
+"""The scan engine: whole studies compiled as single XLA programs.
+
+``lax.scan`` over ticks, per-node arrays optionally sharded over a device
+mesh (consul_tpu.parallel).  Each scan carries compact per-tick counters
+out (infection counts), so a million-node, thousand-tick study transfers
+only O(ticks) scalars back to the host.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models.broadcast import (
+    BroadcastConfig,
+    broadcast_init,
+    broadcast_round,
+)
+from consul_tpu.models.swim import (
+    SwimConfig,
+    swim_init,
+    swim_round,
+    VIEW_DEAD,
+    VIEW_SUSPECT,
+)
+from consul_tpu.parallel import make_mesh, shard_state
+from consul_tpu.sim.metrics import BroadcastReport, SwimReport
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+def broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int):
+    """Run ``steps`` gossip ticks; returns (final_state, infected[steps])."""
+
+    def tick(carry, k):
+        nxt = broadcast_round(carry, k, cfg)
+        return nxt, jnp.sum(nxt.knows, dtype=jnp.int32)
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+def swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int):
+    """Run ``steps`` ticks; returns (final_state, (suspecting, dead_known))."""
+
+    def tick(carry, k):
+        nxt = swim_round(carry, k, cfg)
+        return nxt, (
+            jnp.sum(nxt.view == VIEW_SUSPECT, dtype=jnp.int32),
+            jnp.sum(nxt.view == VIEW_DEAD, dtype=jnp.int32),
+        )
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+def _timed(make_state, scan_fn, key, cfg, steps, warmup: bool):
+    """Run a scan, returning (host outputs, wall seconds).
+
+    The barrier is an explicit device->host transfer of the per-tick
+    counters: on some platforms (the axon TPU tunnel) block_until_ready
+    returns before execution finishes, so np.asarray is the only honest
+    fence.  With ``warmup`` the program is compiled and executed once
+    outside the timed region, so the wall time is steady-state.
+    """
+    if warmup:
+        _, out = scan_fn(make_state(), key, cfg, steps)
+        jax.tree_util.tree_map(np.asarray, out)
+    t0 = time.perf_counter()
+    final, out = scan_fn(make_state(), key, cfg, steps)
+    out = jax.tree_util.tree_map(np.asarray, out)
+    wall = time.perf_counter() - t0
+    return final, out, wall
+
+
+def run_broadcast(
+    cfg: BroadcastConfig,
+    steps: int,
+    seed: int = 0,
+    origin: int = 0,
+    sharded: bool = False,
+    mesh=None,
+    warmup: bool = True,
+) -> BroadcastReport:
+    def make_state():
+        st = broadcast_init(cfg, origin=origin)
+        return shard_state(st, mesh or make_mesh()) if sharded else st
+
+    key = jax.random.PRNGKey(seed)
+    _, infected, wall = _timed(make_state, broadcast_scan, key, cfg, steps, warmup)
+    return BroadcastReport(
+        n=cfg.n,
+        ticks=steps,
+        tick_ms=cfg.profile.gossip_interval_ms,
+        infected=np.asarray(infected),
+        wall_s=wall,
+    )
+
+
+def run_swim(
+    cfg: SwimConfig,
+    steps: int,
+    seed: int = 0,
+    sharded: bool = False,
+    mesh=None,
+    warmup: bool = True,
+) -> SwimReport:
+    def make_state():
+        st = swim_init(cfg)
+        return shard_state(st, mesh or make_mesh()) if sharded else st
+
+    key = jax.random.PRNGKey(seed)
+    _, (sus, dead), wall = _timed(make_state, swim_scan, key, cfg, steps, warmup)
+    return SwimReport(
+        n=cfg.n,
+        ticks=steps,
+        tick_ms=cfg.profile.gossip_interval_ms,
+        probe_interval_ms=cfg.profile.probe_interval_ms,
+        suspecting=np.asarray(sus),
+        dead_known=np.asarray(dead),
+        wall_s=wall,
+    )

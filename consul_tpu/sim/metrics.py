@@ -1,0 +1,87 @@
+"""Convergence metrics computed from per-tick scan traces.
+
+The counter names follow the reference's metric tree style
+(lib/telemetry.go; e.g. serf.queue.Event, memberlist.msg.suspect) so the
+simulator's output reads like the real agent's telemetry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+def time_to_fraction(counts: np.ndarray, n: int, frac: float) -> Optional[int]:
+    """First tick index at which counts/n >= frac, or None if never."""
+    hit = np.nonzero(np.asarray(counts) >= frac * n)[0]
+    return int(hit[0]) if hit.size else None
+
+
+@dataclasses.dataclass
+class BroadcastReport:
+    """Infection curve summary for one event broadcast."""
+
+    n: int
+    ticks: int
+    tick_ms: float
+    infected: np.ndarray          # int per tick (post-tick counts)
+    wall_s: float                 # host wall time for the simulated run
+
+    def time_to_ms(self, frac: float) -> Optional[float]:
+        t = time_to_fraction(self.infected, self.n, frac)
+        return None if t is None else (t + 1) * self.tick_ms
+
+    @property
+    def rounds_per_sec(self) -> float:
+        return self.ticks / self.wall_s if self.wall_s > 0 else float("inf")
+
+    def summary(self) -> dict:
+        return {
+            "n": self.n,
+            "ticks": self.ticks,
+            "tick_ms": self.tick_ms,
+            "infected_final": int(self.infected[-1]),
+            "t50_ms": self.time_to_ms(0.50),
+            "t99_ms": self.time_to_ms(0.99),
+            "t9999_ms": self.time_to_ms(0.9999),
+            "sim_rounds_per_sec": self.rounds_per_sec,
+        }
+
+
+@dataclasses.dataclass
+class SwimReport:
+    """Failure-detection summary for one subject."""
+
+    n: int
+    ticks: int
+    tick_ms: float
+    probe_interval_ms: float
+    suspecting: np.ndarray        # nodes viewing subject SUSPECT, per tick
+    dead_known: np.ndarray        # nodes viewing subject DEAD, per tick
+    wall_s: float
+
+    @property
+    def rounds_per_sec(self) -> float:
+        return self.ticks / self.wall_s if self.wall_s > 0 else float("inf")
+
+    def first_tick(self, counts: np.ndarray) -> Optional[int]:
+        hit = np.nonzero(np.asarray(counts) > 0)[0]
+        return int(hit[0]) if hit.size else None
+
+    def summary(self) -> dict:
+        fd = self.first_tick(self.suspecting)
+        fdead = self.first_tick(self.dead_known)
+        t99 = time_to_fraction(self.dead_known, self.n - 1, 0.99)
+        return {
+            "n": self.n,
+            "ticks": self.ticks,
+            "tick_ms": self.tick_ms,
+            "first_suspect_ms": None if fd is None else (fd + 1) * self.tick_ms,
+            "first_dead_ms": None if fdead is None else (fdead + 1) * self.tick_ms,
+            "t99_dead_known_ms": None if t99 is None else (t99 + 1) * self.tick_ms,
+            "suspecting_final": int(self.suspecting[-1]),
+            "dead_known_final": int(self.dead_known[-1]),
+            "sim_rounds_per_sec": self.rounds_per_sec,
+        }

@@ -1,0 +1,22 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the default unit of
+testing is a deterministic in-process fake network — here, JAX CPU devices
+standing in for TPU chips.  Must set env before the first jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The baked axon sitecustomize force-registers the TPU platform at
+# interpreter start; this config update (before first backend use) is the
+# override that actually sticks.
+jax.config.update("jax_platforms", "cpu")

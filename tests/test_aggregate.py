@@ -1,0 +1,64 @@
+"""Distributional-equivalence tests: 'aggregate' (Poissonized receiver)
+delivery must reproduce the 'edges' (exact per-message scatter) dynamics.
+
+The aggregation is exact in the large-n limit (multinomial arrival counts
+-> independent Poisson); at n=4096 the convergence curves of the two modes
+must agree to within a tick or two.  Averaged over seeds to keep the test
+stable."""
+
+import dataclasses
+
+import numpy as np
+
+from consul_tpu.models import BroadcastConfig, SwimConfig
+from consul_tpu.sim import run_broadcast, run_swim, time_to_fraction
+
+N = 4096
+SEEDS = range(3)
+
+
+def _mean_t(reports, frac):
+    ts = [time_to_fraction(r.infected, N, frac) for r in reports]
+    assert all(t is not None for t in ts)
+    return np.mean(ts)
+
+
+def test_broadcast_modes_agree_on_convergence():
+    cfg_e = BroadcastConfig(n=N, fanout=3, delivery="edges")
+    cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")
+    r_e = [run_broadcast(cfg_e, steps=40, seed=s, warmup=False) for s in SEEDS]
+    r_a = [run_broadcast(cfg_a, steps=40, seed=s, warmup=False) for s in SEEDS]
+    for frac in (0.5, 0.99):
+        assert abs(_mean_t(r_e, frac) - _mean_t(r_a, frac)) <= 2.0
+
+
+def test_broadcast_modes_agree_under_loss():
+    cfg_e = BroadcastConfig(n=N, fanout=3, loss=0.3, delivery="edges")
+    cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")
+    r_e = [run_broadcast(cfg_e, steps=60, seed=s, warmup=False) for s in SEEDS]
+    r_a = [run_broadcast(cfg_a, steps=60, seed=s, warmup=False) for s in SEEDS]
+    assert abs(_mean_t(r_e, 0.99) - _mean_t(r_a, 0.99)) <= 3.0
+
+
+def test_swim_modes_agree_on_detection():
+    cfg_e = SwimConfig(n=N, subject=3, delivery="edges")
+    cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")
+    sus_e, sus_a, dead_e, dead_a = [], [], [], []
+    for s in SEEDS:
+        re = run_swim(cfg_e, steps=150, seed=s, warmup=False)
+        ra = run_swim(cfg_a, steps=150, seed=s, warmup=False)
+        sus_e.append(re.first_tick(re.suspecting))
+        sus_a.append(ra.first_tick(ra.suspecting))
+        dead_e.append(re.first_tick(re.dead_known))
+        dead_a.append(ra.first_tick(ra.dead_known))
+    assert all(v is not None for v in sus_e + sus_a + dead_e + dead_a)
+    # First-suspicion time is set by the probe plane (identical in both
+    # modes); dead time by suspicion timing + gossip spread.
+    assert abs(np.mean(sus_e) - np.mean(sus_a)) <= 5.0
+    assert abs(np.mean(dead_e) - np.mean(dead_a)) <= 10.0
+
+
+def test_aggregate_total_loss_never_spreads():
+    cfg = BroadcastConfig(n=256, loss=1.0, delivery="aggregate")
+    r = run_broadcast(cfg, steps=10, seed=0, warmup=False)
+    assert r.infected[-1] == 1

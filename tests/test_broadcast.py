@@ -1,0 +1,105 @@
+"""Event-broadcast model tests: infection dynamics, dedup, retransmit
+budgets, loss tolerance.  Small-N studies run exact; convergence targets
+follow the epidemic O(log N) expectation (SWIM paper / serf docs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.models import (
+    BroadcastConfig,
+    broadcast_init,
+    broadcast_round,
+)
+from consul_tpu.sim import run_broadcast, time_to_fraction
+
+
+def test_init_only_origin_knows():
+    cfg = BroadcastConfig(n=64)
+    st = broadcast_init(cfg, origin=7)
+    assert int(jnp.sum(st.knows)) == 1
+    assert bool(st.knows[7])
+    assert int(st.tx_left[7]) == cfg.tx_limit
+    assert int(st.tx_left[0]) == 0
+
+
+def test_infection_is_monotone_and_total():
+    cfg = BroadcastConfig(n=128, fanout=3, loss=0.0)
+    st = broadcast_init(cfg)
+    key = jax.random.PRNGKey(0)
+    prev = 1
+    for i in range(40):
+        st = broadcast_round(st, jax.random.fold_in(key, i), cfg)
+        cur = int(jnp.sum(st.knows))
+        assert cur >= prev, "infection can never regress (dedup ring keeps events)"
+        prev = cur
+    assert prev == 128, "lossless broadcast must reach everyone"
+
+
+def test_convergence_is_log_n_rounds():
+    # Epidemic broadcast with fanout 3 should reach 99% of 1k nodes in
+    # O(log N) rounds — well under 20 ticks (4s simulated LAN time);
+    # cf. serf's 'leave propagates to 99.99% of 100k in 3s' basis
+    # (lib/serf/serf.go:26-30).
+    report = run_broadcast(BroadcastConfig(n=1000, fanout=3), steps=40, seed=1)
+    t99 = time_to_fraction(report.infected, 1000, 0.99)
+    assert t99 is not None and t99 < 20
+
+
+def test_tx_budget_depletes_and_gossip_stops():
+    cfg = BroadcastConfig(n=16, fanout=3)
+    st = broadcast_init(cfg)
+    key = jax.random.PRNGKey(2)
+    for i in range(200):
+        st = broadcast_round(st, jax.random.fold_in(key, i), cfg)
+    assert int(jnp.max(st.tx_left)) == 0, "all budgets spent after enough ticks"
+
+
+def test_total_loss_never_spreads():
+    cfg = BroadcastConfig(n=64, loss=1.0)
+    st = broadcast_init(cfg)
+    key = jax.random.PRNGKey(3)
+    for i in range(20):
+        st = broadcast_round(st, jax.random.fold_in(key, i), cfg)
+    assert int(jnp.sum(st.knows)) == 1
+
+
+def test_heavy_loss_still_converges():
+    # 30% loss (the BASELINE WAN config) must still infect everyone,
+    # just slower — epidemic broadcast is loss-tolerant by design.
+    r_lossy = run_broadcast(
+        BroadcastConfig(n=500, fanout=3, loss=0.30), steps=60, seed=4
+    )
+    r_clean = run_broadcast(
+        BroadcastConfig(n=500, fanout=3, loss=0.0), steps=60, seed=4
+    )
+    t99_lossy = time_to_fraction(r_lossy.infected, 500, 0.99)
+    t99_clean = time_to_fraction(r_clean.infected, 500, 0.99)
+    assert t99_lossy is not None
+    assert t99_lossy >= t99_clean
+
+
+def test_dead_nodes_do_not_relay():
+    cfg = BroadcastConfig(n=64, fanout=3)
+    alive = jnp.ones((64,), jnp.bool_).at[10:40].set(False)
+    st = broadcast_init(cfg, origin=0)
+    key = jax.random.PRNGKey(5)
+    for i in range(40):
+        st = broadcast_round(st, jax.random.fold_in(key, i), cfg, alive=alive)
+    knows = np.asarray(st.knows)
+    assert not knows[10:40].any(), "deaf/dead nodes never learn the event"
+    assert knows[np.r_[0:10, 40:64]].all(), "live nodes all converge"
+
+
+def test_determinism_same_key_same_curve():
+    cfg = BroadcastConfig(n=256, fanout=3, loss=0.1)
+    r1 = run_broadcast(cfg, steps=30, seed=7)
+    r2 = run_broadcast(cfg, steps=30, seed=7)
+    assert np.array_equal(r1.infected, r2.infected)
+
+
+def test_retransmit_budget_matches_formula():
+    # 4 * ceil(log10(n+1)): n=1000 -> 16.
+    assert BroadcastConfig(n=1000).tx_limit == 16
+    assert BroadcastConfig(n=100_000).tx_limit == 24

@@ -1,0 +1,138 @@
+"""Golden tests pinning the protocol math to the reference formulas.
+
+Every expected value here is computed by hand from the Go sources cited in
+consul_tpu/protocol/formulas.py — these are the parity anchors (SURVEY.md
+§7 P1)."""
+
+import math
+
+import pytest
+
+from consul_tpu.protocol import (
+    LAN,
+    WAN,
+    LOCAL,
+    push_pull_scale,
+    remaining_suspicion_timeout,
+    retransmit_limit,
+    suspicion_timeout,
+    suspicion_timeout_bounds,
+    scale_with_cluster_size,
+)
+
+
+class TestSuspicionTimeout:
+    # memberlist/util.go:64-69; BASELINE.md "Suspicion max timeout @10k
+    # nodes = 120s" = 6 * 4 * log10(10k) * 1s.
+    def test_ten_k_nodes_lan_bounds(self):
+        # 6*4*log10(10_000)*1s = 96s max, 16s min.  (BASELINE.md's table
+        # says "120s @10k" but 120s is the formula's value at 100k nodes;
+        # the formula in util.go:64-69 is the ground truth.)
+        lo, hi = suspicion_timeout_bounds(
+            LAN.suspicion_mult, LAN.suspicion_max_timeout_mult, 10_000, 1000.0
+        )
+        assert hi == pytest.approx(96_000.0)
+        assert lo == pytest.approx(16_000.0)
+
+    def test_hundred_k_nodes_lan_max_is_120s(self):
+        lo, hi = suspicion_timeout_bounds(
+            LAN.suspicion_mult, LAN.suspicion_max_timeout_mult, 100_000, 1000.0
+        )
+        assert hi == pytest.approx(120_000.0)
+
+    def test_small_clusters_clamp_node_scale_to_one(self):
+        # nodeScale = max(1, log10(max(1, n))): n<=10 gives scale 1.
+        for n in (0, 1, 5, 10):
+            assert suspicion_timeout(4, n, 1000.0) == pytest.approx(4000.0)
+
+    def test_fixed_point_truncation_matches_go(self):
+        # Go keeps nodeScale to 1/1000 precision via int truncation:
+        # n=50 -> log10(50)=1.69897 -> 1698/1000 * 4 * 1s = 6.792s... with
+        # floor(1.69897*1000)=1698.
+        got = suspicion_timeout(4, 50, 1000.0)
+        assert got == pytest.approx(4 * 1698 * 1000.0 / 1000.0)
+
+    def test_wan_mult(self):
+        assert suspicion_timeout(WAN.suspicion_mult, 10_000, 5000.0) == (
+            pytest.approx(6 * 4 * 5000.0)
+        )
+
+
+class TestLifeguardRemaining:
+    # memberlist/suspicion.go:86-97.
+    def test_zero_confirmations_is_max(self):
+        assert remaining_suspicion_timeout(0, 2, 4000.0, 24_000.0) == 24_000.0
+
+    def test_k_confirmations_reaches_min(self):
+        assert remaining_suspicion_timeout(2, 2, 4000.0, 24_000.0) == 4000.0
+
+    def test_log_scale_midpoint(self):
+        # frac = log(2)/log(3) = 0.6309; raw = 24000 - .6309*20000
+        got = remaining_suspicion_timeout(1, 2, 4000.0, 24_000.0)
+        frac = math.log(2.0) / math.log(3.0)
+        assert got == pytest.approx(math.floor(24_000.0 - frac * 20_000.0))
+
+    def test_k_zero_is_min(self):
+        assert remaining_suspicion_timeout(0, 0, 4000.0, 24_000.0) == 4000.0
+
+    def test_clamped_to_min(self):
+        assert remaining_suspicion_timeout(50, 2, 4000.0, 24_000.0) == 4000.0
+
+
+class TestRetransmitLimit:
+    # memberlist/util.go:72-76; LAN mult 4 -> 4*ceil(log10(n+1)).
+    @pytest.mark.parametrize(
+        "n,expected",
+        [(0, 0), (9, 4), (10, 8), (99, 8), (100, 12), (10_000, 20),
+         (100_000, 24), (1_000_000, 28)],
+    )
+    def test_lan_values(self, n, expected):
+        assert retransmit_limit(LAN.retransmit_mult, n) == expected
+
+    def test_local_profile_mult(self):
+        assert retransmit_limit(LOCAL.retransmit_mult, 100_000) == 12
+
+
+class TestPushPullScale:
+    # memberlist/util.go:89-97, threshold 32.
+    def test_no_scale_at_or_below_threshold(self):
+        assert push_pull_scale(30_000.0, 32) == 30_000.0
+
+    @pytest.mark.parametrize(
+        "n,mult", [(33, 2), (64, 2), (65, 3), (128, 3), (129, 4)]
+    )
+    def test_doubling_scale(self, n, mult):
+        assert push_pull_scale(30_000.0, n) == mult * 30_000.0
+
+
+class TestAeScale:
+    # agent/ae/ae.go:33-38, threshold 128.
+    @pytest.mark.parametrize(
+        "n,factor", [(1, 1), (128, 1), (129, 2), (256, 2), (257, 3), (8192, 7)]
+    )
+    def test_scale_factor(self, n, factor):
+        assert scale_with_cluster_size(n) == factor
+
+
+class TestProfiles:
+    # BASELINE.md protocol constants table.
+    def test_lan(self):
+        assert (LAN.probe_interval_ms, LAN.probe_timeout_ms) == (1000, 500)
+        assert (LAN.gossip_interval_ms, LAN.gossip_nodes) == (200, 3)
+        assert LAN.push_pull_interval_ms == 30_000
+        assert (LAN.suspicion_mult, LAN.suspicion_max_timeout_mult) == (4, 6)
+        assert LAN.probe_interval_ticks == 5
+
+    def test_wan(self):
+        assert (WAN.probe_interval_ms, WAN.probe_timeout_ms) == (5000, 3000)
+        assert (WAN.gossip_interval_ms, WAN.gossip_nodes) == (500, 4)
+        assert WAN.suspicion_mult == 6
+
+    def test_local(self):
+        assert (LOCAL.probe_timeout_ms, LOCAL.indirect_checks) == (200, 1)
+        assert LOCAL.retransmit_mult == 2
+
+    def test_packet_budget(self):
+        assert LAN.udp_buffer_size == 1400
+        assert LAN.event_buffer_size == 512
+        assert LAN.max_user_event_size == 512

@@ -1,0 +1,200 @@
+"""SWIM failure-detection model tests: detection latency, suspicion
+timing, refutation, loss behavior.  Expected timings derive from the
+protocol constants (BASELINE.md) — LAN: probe every 5 ticks, suspicion
+min 4*log10(n) s, max 6*min."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models import (
+    SwimConfig,
+    swim_init,
+    swim_round,
+    VIEW_ALIVE,
+    VIEW_DEAD,
+    VIEW_SUSPECT,
+)
+from consul_tpu.models.swim import _lifeguard_timeout_ticks, NEVER
+from consul_tpu.protocol import remaining_suspicion_timeout
+from consul_tpu.sim import run_swim
+
+
+def advance(st, cfg, steps, seed=0):
+    key = jax.random.PRNGKey(seed)
+    for i in range(steps):
+        st = swim_round(st, jax.random.fold_in(key, i), cfg)
+    return st
+
+
+class TestDetection:
+    def test_dead_subject_gets_suspected_then_dead(self):
+        # 64 nodes, LAN: expected first suspicion within a few probe
+        # intervals (each of 63 probers hits f w.p. 1/63 per interval ->
+        # ~63% per interval; P(no suspicion after 5 intervals) < 1%).
+        cfg = SwimConfig(n=64, subject=3)
+        report = run_swim(cfg, steps=400, seed=0)
+        assert report.summary()["first_suspect_ms"] is not None
+        assert report.summary()["first_suspect_ms"] <= 6 * 1000.0
+        # Suspicion min timeout at n=64: 4*log10(64)*1s = 7.2s = 36 ticks;
+        # dead must be declared after that and spread to everyone.
+        assert report.summary()["first_dead_ms"] is not None
+        assert report.dead_known[-1] == 63, "all 63 live nodes converge to DEAD"
+
+    def test_no_failure_no_suspicion_without_loss(self):
+        cfg = SwimConfig(n=32, subject=0, subject_alive=True, loss=0.0)
+        st = advance(swim_init(cfg), cfg, 100)
+        assert int(jnp.sum(st.view == VIEW_SUSPECT)) == 0
+        assert int(jnp.sum(st.view == VIEW_DEAD)) == 0
+
+    def test_detection_under_30pct_loss(self):
+        # The BASELINE 1M-node config uses 30% loss WAN; at small scale,
+        # detection must still complete, only slower.
+        cfg = SwimConfig(n=64, subject=1, loss=0.30)
+        report = run_swim(cfg, steps=600, seed=2)
+        assert report.summary()["first_dead_ms"] is not None
+        assert report.dead_known[-1] >= 0.99 * 63
+
+
+class TestSuspicionTiming:
+    def test_suspicion_not_declared_before_min_timeout(self):
+        # With zero confirmations the timer stays at max; no node may
+        # declare dead before min timeout ticks have elapsed from its own
+        # suspicion start (state.go:1186-1199).
+        cfg = SwimConfig(n=64, subject=2)
+        lo, hi = cfg.suspicion_bounds_ticks
+        report = run_swim(cfg, steps=400, seed=3)
+        first_sus = report.first_tick(report.suspecting)
+        first_dead = report.first_tick(report.dead_known)
+        assert first_dead is not None
+        assert first_dead - first_sus >= lo
+
+    def test_lifeguard_matches_scalar_reference(self):
+        cfg = SwimConfig(n=1000, subject=0)
+        lo, hi = cfg.suspicion_bounds_ticks
+        k = cfg.confirmations_k
+        confs = jnp.arange(0, k + 1, dtype=jnp.int32)
+        vec = np.asarray(_lifeguard_timeout_ticks(cfg, confs))
+        for c in range(k + 1):
+            want = remaining_suspicion_timeout(c, k, lo, hi)
+            assert abs(vec[c] - want) <= 1.0, (c, vec[c], want)
+
+    def test_confirmations_k_small_cluster_is_zero(self):
+        # state.go:1191-1196: n-2 < k -> k=0.
+        assert SwimConfig(n=3).confirmations_k == 0
+        assert SwimConfig(n=64).confirmations_k == 2
+
+
+class TestRefutation:
+    def test_live_subject_refutes_false_suspicion(self):
+        # Force a false suspicion by hand-marking a suspector, then let
+        # the refute epidemic win: the subject hears the suspicion,
+        # bumps incarnation, and all nodes return to ALIVE @ era 1
+        # (state.go:1166-1170, aliveNode incarnation rules).
+        cfg = SwimConfig(n=32, subject=5, subject_alive=True, loss=0.0)
+        st = swim_init(cfg)
+        st = st._replace(
+            view=st.view.at[20].set(VIEW_SUSPECT),
+            suspect_since=st.suspect_since.at[20].set(0),
+            tx_suspect=st.tx_suspect.at[20].set(cfg.tx_limit),
+        )
+        st = advance(st, cfg, 120, seed=4)
+        assert int(st.subject_inc) >= 1
+        assert int(jnp.sum(st.view == VIEW_DEAD)) == 0
+        assert int(jnp.sum(st.view == VIEW_SUSPECT)) == 0
+        assert int(jnp.sum((st.view == VIEW_ALIVE) & (st.inc_seen == 1))) > 0
+
+    def test_stale_dead_loses_to_refuted_alive(self):
+        # A laggard whose suspicion timer expired before the refute
+        # reached it broadcasts dead @ era 0; nodes already at refuted
+        # ALIVE @ era 1 must ignore it (deadNode ignores lower
+        # incarnations, state.go:1228-1232).
+        cfg = SwimConfig(n=32, subject=5, subject_alive=True, loss=0.0)
+        st = swim_init(cfg)
+        st = st._replace(
+            inc_seen=jnp.ones_like(st.inc_seen),  # all refuted @ era 1
+            view=st.view.at[20].set(VIEW_DEAD),
+            tx_dead=st.tx_dead.at[20].set(cfg.tx_limit),
+        )
+        st = st._replace(inc_seen=st.inc_seen.at[20].set(0))
+        st = advance(st, cfg, 60, seed=11)
+        dead = np.asarray(st.view == VIEW_DEAD)
+        assert dead.sum() == 1 and dead[20], (
+            "stale era-0 dead must not spread into an era-1 cluster"
+        )
+
+    def test_subject_never_suspects_itself(self):
+        # memberlist state.go:1166-1170: a node refutes a suspicion about
+        # itself and explicitly does not mark itself suspect.
+        cfg = SwimConfig(n=16, subject=2, subject_alive=True, loss=0.0)
+        st = swim_init(cfg)
+        st = st._replace(
+            view=st.view.at[9].set(VIEW_SUSPECT),
+            suspect_since=st.suspect_since.at[9].set(0),
+            tx_suspect=st.tx_suspect.at[9].set(cfg.tx_limit),
+        )
+        key = jax.random.PRNGKey(12)
+        for i in range(80):
+            st = swim_round(st, jax.random.fold_in(key, i), cfg)
+            assert int(st.view[2]) != VIEW_SUSPECT
+            assert int(st.view[2]) != VIEW_DEAD
+
+    def test_flapping_recurs_at_higher_incarnations(self):
+        # Under heavy loss a live subject keeps getting falsely suspected;
+        # each cycle must run at a higher incarnation (suspect@k ->
+        # refute@k+1 -> re-suspect@k+1 -> ...), like the reference — the
+        # cluster must never wedge in a state where re-suspicion is
+        # impossible (aliveNode/suspectNode incarnation rules).
+        cfg = SwimConfig(n=32, subject=4, subject_alive=True, loss=0.35)
+        # p(probe failure) ~ 0.27/probe; with ~31 probers one fails most
+        # probe intervals, so several refute cycles happen in 600 ticks.
+        st = advance(swim_init(cfg), cfg, 600, seed=13)
+        assert int(st.subject_inc) >= 2, (
+            "subject must have refuted repeatedly (flapping), got "
+            f"{int(st.subject_inc)}"
+        )
+
+    def test_refuted_nodes_ignore_stale_suspect_msgs(self):
+        cfg = SwimConfig(n=16, subject=0, subject_alive=True)
+        st = swim_init(cfg)
+        # Node 3 already accepted the refute (era 1)...
+        st = st._replace(inc_seen=st.inc_seen.at[3].set(1))
+        # ...and node 7 still gossips the stale era-0 suspicion.
+        st = st._replace(
+            view=st.view.at[7].set(VIEW_SUSPECT),
+            suspect_since=st.suspect_since.at[7].set(0),
+            tx_suspect=st.tx_suspect.at[7].set(cfg.tx_limit),
+        )
+        st = advance(st, cfg, 30, seed=5)
+        assert int(st.view[3]) == VIEW_ALIVE, "era-1 node never regresses to era-0 suspicion"
+
+
+class TestStateMachine:
+    def test_dead_overrides_suspect(self):
+        cfg = SwimConfig(n=16, subject=0)
+        st = swim_init(cfg)
+        st = st._replace(
+            view=st.view.at[4].set(VIEW_SUSPECT).at[8].set(VIEW_DEAD),
+            suspect_since=st.suspect_since.at[4].set(0),
+            tx_dead=st.tx_dead.at[8].set(cfg.tx_limit),
+        )
+        st = advance(st, cfg, 40, seed=6)
+        assert int(st.view[4]) == VIEW_DEAD
+
+    def test_probe_pending_matures_after_probe_interval(self):
+        cfg = SwimConfig(n=64, subject=9)
+        st = swim_init(cfg)
+        key = jax.random.PRNGKey(7)
+        # Run exactly one probe cycle: any node with a pending probe at
+        # tick 0 must not be SUSPECT before probe_interval_ticks pass.
+        for i in range(cfg.probe_interval_ticks):
+            st = swim_round(st, jax.random.fold_in(key, i), cfg)
+            if i < cfg.probe_interval_ticks - 1:
+                assert int(jnp.sum(st.view == VIEW_SUSPECT)) == 0
+
+    def test_determinism(self):
+        cfg = SwimConfig(n=128, subject=1, loss=0.2)
+        r1 = run_swim(cfg, steps=100, seed=9)
+        r2 = run_swim(cfg, steps=100, seed=9)
+        assert np.array_equal(r1.dead_known, r2.dead_known)
+        assert np.array_equal(r1.suspecting, r2.suspecting)
