@@ -20,6 +20,14 @@ from consul_tpu.models.swim import (
     VIEW_SUSPECT,
     VIEW_DEAD,
 )
+from consul_tpu.models.vivaldi import (
+    VivaldiConfig,
+    VivaldiState,
+    vivaldi_init,
+    vivaldi_round,
+    estimated_rtt,
+    euclidean_rtt_model,
+)
 
 __all__ = [
     "BroadcastConfig",
@@ -33,4 +41,10 @@ __all__ = [
     "VIEW_ALIVE",
     "VIEW_SUSPECT",
     "VIEW_DEAD",
+    "VivaldiConfig",
+    "VivaldiState",
+    "vivaldi_init",
+    "vivaldi_round",
+    "estimated_rtt",
+    "euclidean_rtt_model",
 ]

@@ -1,0 +1,220 @@
+"""Vivaldi network coordinates, vectorized: all N nodes update at once.
+
+The reference (vendor/serf/coordinate/) maintains one 8-D Euclidean
+coordinate + height + adjustment per node, updated from the RTT of each
+SWIM probe (serf/ping_delegate.go:46-90 feeds probe RTTs into
+coordinate/client.go Update).  Here the whole population's coordinates
+live in [n, dim] arrays; one round = every node applying its probe's
+observation simultaneously:
+
+  update rule        client.go:144-167 updateVivaldi (error EWMA with
+                     confidence weighting, force application)
+  adjustment term    client.go:170-187 updateAdjustment (windowed mean of
+                     rtt - raw distance, halved)
+  gravity            client.go:190-196 updateGravity (quadratic pull to
+                     the origin, rho=150)
+  force application  coordinate.go:104-118 ApplyForce (unit vector +
+                     height coupling, height floor)
+  distance           coordinate.go:121-139 DistanceTo (raw + heights +
+                     adjustments when positive)
+  tuning             config.go:62-71 DefaultConfig (8 dims, ce=cc=0.25,
+                     error max 1.5, height min 10us, window 20, rho 150)
+
+Deviation: the per-peer median-of-3 latency filter (client.go:120-140)
+is omitted — it is keyed per (observer, peer) pair, which is O(n^2)
+state; at simulation scale a node re-probes the same peer every ~n probe
+rounds, so the filter window never fills and its effect vanishes.  Noise
+robustness can instead be studied through the rtt jitter knob.
+
+Ground truth: nodes are placed in a latent space (positions [n, d_true])
+and the "measured" RTT between i and j is the latent distance plus
+lognormal-ish jitter — the simulator's stand-in for real network RTTs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import sample_probe_targets
+
+ZERO_THRESHOLD = 1.0e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class VivaldiConfig:
+    """Tuning parameters (coordinate/config.go:62-71 DefaultConfig)."""
+
+    n: int
+    dimensionality: int = 8
+    vivaldi_error_max: float = 1.5
+    vivaldi_ce: float = 0.25
+    vivaldi_cc: float = 0.25
+    adjustment_window_size: int = 20
+    height_min: float = 10.0e-6
+    gravity_rho: float = 150.0
+    # Observation model.
+    rtt_jitter: float = 0.0   # multiplicative jitter sigma on measured RTTs
+
+
+class VivaldiState(NamedTuple):
+    vec: jax.Array          # f32[n, dim] — Euclidean part, seconds
+    error: jax.Array        # f32[n] — confidence (dimensionless)
+    height: jax.Array       # f32[n] — non-Euclidean access-link term
+    adjustment: jax.Array   # f32[n] — windowed offset term
+    adj_samples: jax.Array  # f32[n, window] — ring buffer of rtt - rawdist
+    adj_index: jax.Array    # int32 scalar — ring position
+    tick: jax.Array         # int32 scalar
+
+
+def vivaldi_init(cfg: VivaldiConfig) -> VivaldiState:
+    """All nodes start at the origin with max error (coordinate.go:54-61)."""
+    return VivaldiState(
+        vec=jnp.zeros((cfg.n, cfg.dimensionality), jnp.float32),
+        error=jnp.full((cfg.n,), cfg.vivaldi_error_max, jnp.float32),
+        height=jnp.full((cfg.n,), cfg.height_min, jnp.float32),
+        adjustment=jnp.zeros((cfg.n,), jnp.float32),
+        adj_samples=jnp.zeros(
+            (cfg.n, cfg.adjustment_window_size), jnp.float32
+        ),
+        adj_index=jnp.int32(0),
+        tick=jnp.int32(0),
+    )
+
+
+def raw_distance(
+    vec_a: jax.Array, h_a: jax.Array, vec_b: jax.Array, h_b: jax.Array
+) -> jax.Array:
+    """coordinate.go:141-145 rawDistanceTo: ||a-b|| + heights, seconds."""
+    return (
+        jnp.sqrt(jnp.sum((vec_a - vec_b) ** 2, axis=-1) + 1e-30) + h_a + h_b
+    )
+
+
+def estimated_rtt(state: VivaldiState, i: jax.Array, j: jax.Array) -> jax.Array:
+    """coordinate.go:121-133 DistanceTo incl. adjustments (when positive)."""
+    dist = raw_distance(
+        state.vec[i], state.height[i], state.vec[j], state.height[j]
+    )
+    adjusted = dist + state.adjustment[i] + state.adjustment[j]
+    return jnp.where(adjusted > 0.0, adjusted, dist)
+
+
+def vivaldi_round(
+    state: VivaldiState,
+    key: jax.Array,
+    cfg: VivaldiConfig,
+    true_rtt_fn,
+) -> VivaldiState:
+    """One probe round: every node observes the RTT to one uniform peer
+    (the SWIM probe schedule, state.go:214-256) and applies the Vivaldi
+    update.  ``true_rtt_fn(i, j) -> f32`` supplies ground-truth RTTs in
+    seconds for index arrays i, j."""
+    n = cfg.n
+    k_peer, k_jit, k_dir = jax.random.split(key, 3)
+
+    i = jnp.arange(n, dtype=jnp.int32)
+    j = sample_probe_targets(k_peer, n)
+
+    rtt = true_rtt_fn(i, j)
+    if cfg.rtt_jitter > 0.0:
+        rtt = rtt * jnp.exp(
+            cfg.rtt_jitter * jax.random.normal(k_jit, (n,))
+        )
+    rtt = jnp.maximum(rtt, ZERO_THRESHOLD)  # client.go:147-149
+
+    vec_o, h_o = state.vec[j], state.height[j]
+    err_o, adj_o = state.error[j], state.adjustment[j]
+
+    def apply_force(vec, height, force, other_vec, other_h, rand_key=None):
+        """coordinate.go:104-118 ApplyForce: move along the unit vector
+        from other toward self; couple height when not coincident."""
+        delta = vec - other_vec
+        mag = jnp.sqrt(jnp.sum(delta**2, axis=-1))
+        if rand_key is not None:
+            # Coincident points push in a random unit direction
+            # (coordinate.go:186-199 unitVectorAt).
+            rd = jax.random.normal(rand_key, vec.shape)
+            rd = rd / jnp.linalg.norm(rd, axis=-1, keepdims=True)
+        else:
+            rd = jnp.zeros_like(vec)
+        unit = jnp.where(
+            (mag > ZERO_THRESHOLD)[:, None],
+            delta / jnp.maximum(mag, 1e-30)[:, None],
+            rd,
+        )
+        new_vec = vec + unit * force[:, None]
+        new_height = jnp.where(
+            mag > ZERO_THRESHOLD,
+            jnp.maximum(
+                (height + other_h) * force / jnp.maximum(mag, 1e-30) + height,
+                cfg.height_min,
+            ),
+            height,
+        )
+        return new_vec, new_height
+
+    # --- updateVivaldi (client.go:144-167) ---
+    # dist is DistanceTo, i.e. raw + both adjustment terms when the sum
+    # stays positive (client.go:150, coordinate.go:121-133).
+    rdist = raw_distance(state.vec, state.height, vec_o, h_o)
+    adjusted = rdist + state.adjustment + adj_o
+    dist = jnp.where(adjusted > 0.0, adjusted, rdist)
+    wrongness = jnp.abs(dist - rtt) / rtt
+    total_error = jnp.maximum(state.error + err_o, ZERO_THRESHOLD)
+    weight = state.error / total_error
+    ce = cfg.vivaldi_ce
+    new_error = jnp.minimum(
+        ce * weight * wrongness + state.error * (1.0 - ce * weight),
+        cfg.vivaldi_error_max,
+    )
+    force = cfg.vivaldi_cc * weight * (rtt - dist)
+    new_vec, new_height = apply_force(
+        state.vec, state.height, force, vec_o, h_o, rand_key=k_dir
+    )
+
+    # --- updateAdjustment (client.go:170-187) ---
+    # The sample uses rawDistanceTo of the *updated* coordinate (the
+    # reference applies the Vivaldi force before computing it).
+    sample = rtt - raw_distance(new_vec, new_height, vec_o, h_o)
+    w = cfg.adjustment_window_size
+    adj_samples = state.adj_samples.at[:, state.adj_index % w].set(sample)
+    new_adjustment = jnp.sum(adj_samples, axis=-1) / (2.0 * w)
+
+    # --- updateGravity (client.go:190-196) ---
+    # Full ApplyForce toward the origin: the negative force also decays
+    # the height term each round (clamped at height_min).
+    origin_vec = jnp.zeros_like(new_vec)
+    origin_h = jnp.zeros_like(new_height)
+    g_rdist = raw_distance(new_vec, new_height, origin_vec, origin_h)
+    g_adjusted = g_rdist + new_adjustment  # origin adjustment is 0
+    g_dist = jnp.where(g_adjusted > 0.0, g_adjusted, g_rdist)
+    g_force = -1.0 * (g_dist / cfg.gravity_rho) ** 2
+    new_vec, new_height = apply_force(
+        new_vec, new_height, g_force, origin_vec, origin_h
+    )
+
+    return VivaldiState(
+        vec=new_vec,
+        error=new_error,
+        height=new_height,
+        adjustment=new_adjustment,
+        adj_samples=adj_samples,
+        adj_index=state.adj_index + 1,
+        tick=state.tick + 1,
+    )
+
+
+def euclidean_rtt_model(positions: jax.Array):
+    """Ground-truth RTT = Euclidean distance between latent positions
+    (seconds).  positions: f32[n, d_true]."""
+
+    def true_rtt(i: jax.Array, j: jax.Array) -> jax.Array:
+        return jnp.sqrt(
+            jnp.sum((positions[i] - positions[j]) ** 2, axis=-1) + 1e-30
+        )
+
+    return true_rtt

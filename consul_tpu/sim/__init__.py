@@ -11,6 +11,7 @@ from consul_tpu.sim.metrics import (
     BroadcastReport,
     SwimReport,
 )
+from consul_tpu.sim.scenarios import SCENARIOS, run_scenario
 
 __all__ = [
     "run_broadcast",
@@ -20,4 +21,6 @@ __all__ = [
     "time_to_fraction",
     "BroadcastReport",
     "SwimReport",
+    "SCENARIOS",
+    "run_scenario",
 ]

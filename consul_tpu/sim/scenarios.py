@@ -1,0 +1,114 @@
+"""The BASELINE.json study configs as runnable presets.
+
+Each scenario returns a summary dict via ``run_scenario(name)`` — the
+programmatic entry point for the benchmark harness (and the CLI, once
+the host agent plane lands).
+
+  dev3        3-node LAN pool, single user-event broadcast (CPU ref)
+  probe1k     1k-node SWIM probe/ack with 1% induced failure, fanout 3
+  event100k   100k-node serf event broadcast, LAN timing, fanout 4,
+              99% infection time
+  suspect1m   1M-node suspicion/dead propagation, 30% loss, WAN profile
+  multidc1m   1M-node 8-segment multi-DC epidemic broadcast, sharded
+              across the device mesh
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import numpy as np
+
+from consul_tpu.models import BroadcastConfig, SwimConfig
+from consul_tpu.protocol import LAN, WAN
+from consul_tpu.sim.engine import run_broadcast, run_swim
+
+
+def dev3(seed: int = 0) -> dict:
+    """BASELINE config 1: 3-node dev pool, one user event (CPU-scale ref).
+
+    The 3-node `agent -dev` LAN pool of the reference; at this size the
+    exact edge simulation is the only sensible mode."""
+    cfg = BroadcastConfig(n=3, profile=LAN, delivery="edges")
+    rep = run_broadcast(cfg, steps=10, seed=seed, warmup=False)
+    return {"scenario": "dev3", **rep.summary()}
+
+
+def probe1k(seed: int = 0) -> dict:
+    """BASELINE config 2: 1k nodes, SWIM probe/ack, 1% induced failure.
+
+    1% of 1000 nodes = 10 independent crash subjects, vmapped."""
+    cfg = SwimConfig(n=1000, subject=0, loss=0.0, profile=LAN,
+                     delivery="edges")
+    # 1% of 1000 nodes = 10 subjects, run as independent studies (the
+    # subject index only relabels nodes, so varying the seed is the
+    # faithful ensemble).
+    summaries = [
+        run_swim(cfg, steps=200, seed=seed + s, warmup=False).summary()
+        for s in range(10)
+    ]
+    first_sus = [s["first_suspect_ms"] for s in summaries]
+    first_dead = [s["first_dead_ms"] for s in summaries]
+    return {
+        "scenario": "probe1k",
+        "n": 1000,
+        "subjects": len(summaries),
+        "mean_first_suspect_ms": float(np.mean(first_sus)),
+        "mean_first_dead_ms": float(np.mean(first_dead)),
+    }
+
+
+def event100k(seed: int = 0) -> dict:
+    """BASELINE config 3: 100k-node event broadcast, LAN, fanout 4."""
+    cfg = BroadcastConfig(n=100_000, fanout=4, profile=LAN,
+                          delivery="aggregate")
+    rep = run_broadcast(cfg, steps=100, seed=seed)
+    return {"scenario": "event100k", **rep.summary()}
+
+
+def suspect1m(seed: int = 0) -> dict:
+    """BASELINE config 4: 1M-node suspicion/dead propagation, 30% loss,
+    WAN timing."""
+    cfg = SwimConfig(n=1_000_000, subject=42, loss=0.30, profile=WAN,
+                     delivery="aggregate")
+    # Suspicion min timeout at 1M WAN = 6*log10(1e6)*5s = 180s = 360
+    # ticks; run past it so dead propagation is measured.
+    rep = run_swim(cfg, steps=500, seed=seed)
+    return {"scenario": "suspect1m", **rep.summary()}
+
+
+def multidc1m(seed: int = 0) -> dict:
+    """BASELINE config 5: 1M nodes in 8 segments (1 segment per device),
+    epidemic broadcast sharded across the mesh."""
+    from consul_tpu.parallel import make_mesh
+
+    cfg = BroadcastConfig(n=1_000_000, fanout=4, profile=LAN,
+                          delivery="aggregate")
+    mesh = make_mesh()
+    rep = run_broadcast(cfg, steps=100, seed=seed, sharded=True, mesh=mesh)
+    return {
+        "scenario": "multidc1m",
+        "segments": int(mesh.devices.size),
+        **rep.summary(),
+    }
+
+
+SCENARIOS: dict[str, Callable[..., dict]] = {
+    "dev3": dev3,
+    "probe1k": probe1k,
+    "event100k": event100k,
+    "suspect1m": suspect1m,
+    "multidc1m": multidc1m,
+}
+
+
+def run_scenario(name: str, seed: int = 0) -> dict:
+    try:
+        fn = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; choose from {sorted(SCENARIOS)}"
+        ) from None
+    return fn(seed=seed)

@@ -1,0 +1,23 @@
+"""Scenario preset smoke tests (the million-node presets are exercised
+by bench.py on real hardware; here only the CPU-scale ones run)."""
+
+import pytest
+
+from consul_tpu.sim import SCENARIOS, run_scenario
+
+
+def test_registry_covers_baseline_configs():
+    assert set(SCENARIOS) == {
+        "dev3", "probe1k", "event100k", "suspect1m", "multidc1m"
+    }
+
+
+def test_dev3_converges():
+    out = run_scenario("dev3")
+    assert out["infected_final"] == 3
+    assert out["t99_ms"] is not None
+
+
+def test_unknown_scenario_raises():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        run_scenario("nope")

@@ -1,0 +1,80 @@
+"""Vivaldi coordinate tests: convergence to ground-truth geometry,
+error decay, invariants (height floor, validity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models import (
+    VivaldiConfig,
+    euclidean_rtt_model,
+    vivaldi_init,
+    vivaldi_round,
+)
+from consul_tpu.models.vivaldi import raw_distance
+
+
+def run(cfg, positions, rounds, seed=0):
+    st = vivaldi_init(cfg)
+    rtt_fn = euclidean_rtt_model(positions)
+    key = jax.random.PRNGKey(seed)
+    step = jax.jit(lambda s, k: vivaldi_round(s, k, cfg, rtt_fn))
+    for i in range(rounds):
+        st = step(st, jax.random.fold_in(key, i))
+    return st
+
+
+def rel_rtt_error(st, positions, n_pairs=2000, seed=99):
+    """Median relative error of estimated vs true RTT over random pairs."""
+    rng = np.random.default_rng(seed)
+    n = positions.shape[0]
+    i = rng.integers(0, n, n_pairs)
+    j = (i + 1 + rng.integers(0, n - 1, n_pairs)) % n
+    true = np.asarray(
+        jnp.sqrt(jnp.sum((positions[i] - positions[j]) ** 2, axis=-1))
+    )
+    est = np.asarray(
+        raw_distance(st.vec[i], st.height[i], st.vec[j], st.height[j])
+    )
+    return float(np.median(np.abs(est - true) / np.maximum(true, 1e-9)))
+
+
+def test_coordinates_converge_to_geometry():
+    # 64 nodes on a ring with ~10-50 ms RTTs; after a few hundred probe
+    # rounds the coordinate system should predict pairwise RTTs well
+    # (Vivaldi paper: median relative error ~ 10-25%).
+    n = 64
+    theta = jnp.linspace(0, 2 * jnp.pi, n, endpoint=False)
+    positions = 0.025 * jnp.stack([jnp.cos(theta), jnp.sin(theta)], axis=-1)
+    cfg = VivaldiConfig(n=n)
+    st = run(cfg, positions, rounds=600)
+    err = rel_rtt_error(st, positions)
+    assert err < 0.30, f"median relative RTT error {err:.2%}"
+
+
+def test_error_decays_from_max():
+    n = 32
+    positions = jax.random.uniform(jax.random.PRNGKey(1), (n, 3)) * 0.05
+    cfg = VivaldiConfig(n=n)
+    st0 = vivaldi_init(cfg)
+    st = run(cfg, positions, rounds=200)
+    assert float(jnp.mean(st.error)) < float(jnp.mean(st0.error))
+    assert float(jnp.max(st.error)) <= cfg.vivaldi_error_max + 1e-6
+
+
+def test_height_floor_and_validity():
+    n = 32
+    positions = jax.random.uniform(jax.random.PRNGKey(2), (n, 2)) * 0.02
+    cfg = VivaldiConfig(n=n, rtt_jitter=0.2)
+    st = run(cfg, positions, rounds=300)
+    assert float(jnp.min(st.height)) >= cfg.height_min - 1e-12
+    for leaf in st:
+        assert bool(jnp.all(jnp.isfinite(jnp.asarray(leaf, jnp.float32))))
+
+
+def test_jitter_tolerated():
+    n = 64
+    positions = jax.random.uniform(jax.random.PRNGKey(3), (n, 3)) * 0.04
+    cfg = VivaldiConfig(n=n, rtt_jitter=0.1)
+    st = run(cfg, positions, rounds=600)
+    assert rel_rtt_error(st, positions) < 0.45
