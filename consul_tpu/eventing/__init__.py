@@ -1,0 +1,30 @@
+"""Gossip eventing layer (the serf equivalent).
+
+Lamport-clocked membership intents, user-event epidemic broadcast with a
+dedup ring, request/response queries, tag-carrying members, and a
+push/pull convergence backstop — layered on ``consul_tpu.net.Memberlist``
+through its delegate hooks, exactly as serf layers on memberlist
+(vendor/serf/serf/delegate.go).
+"""
+
+from consul_tpu.eventing.lamport import LamportClock
+from consul_tpu.eventing.cluster import (
+    Cluster,
+    ClusterConfig,
+    Event,
+    EventType,
+    Member,
+    QueryResponseHandle,
+    QueryResult,
+)
+
+__all__ = [
+    "LamportClock",
+    "Cluster",
+    "ClusterConfig",
+    "Event",
+    "EventType",
+    "Member",
+    "QueryResponseHandle",
+    "QueryResult",
+]

@@ -1,0 +1,683 @@
+"""The Cluster: serf-equivalent gossip eventing over Memberlist.
+
+Maps to vendor/serf/serf/serf.go (Serf struct) + delegate.go:
+
+  message routing    delegate.go:28-135 NotifyMsg dispatch over the
+                     serf message-type byte carried in memberlist USER
+                     payloads (messages.go:15-26, same numbering)
+  3 Lamport clocks   serf.go:64-101 (clock, eventClock, queryClock)
+  join/leave intents serf.go handleNodeJoinIntent/handleNodeLeaveIntent
+  user events        serf.go:459-516 UserEvent + 1231-1287
+                     handleUserEvent (dedup ring keyed LTime % size)
+  queries            serf.go:522-640 Query + 1290-1440 handleQuery /
+                     handleQueryResponse (direct response to the
+                     originator's address, ack flag support)
+  tags               members carry a msgpack tag map in the memberlist
+                     node meta (serf.go EncodeTags/DecodeTags)
+  push/pull backstop delegate.go:173-297 LocalState/MergeRemoteState
+                     exchanging clocks + recent event buffer
+  reaping            serf.go:1547-1612 reap loop: failed members pruned
+                     after ReconnectTimeout, left after TombstoneTimeout
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import logging
+import time
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from consul_tpu.net.memberlist import (
+    Memberlist,
+    MemberlistConfig,
+    Node,
+    NodeStatus,
+)
+from consul_tpu.net.transport import Transport
+from consul_tpu.eventing.lamport import LamportClock
+from consul_tpu.protocol import GossipProfile, LAN
+
+log = logging.getLogger("consul_tpu.eventing")
+
+
+class SerfMessageType(enum.IntEnum):
+    """serf/messages.go:15-26 (same numbering)."""
+
+    LEAVE = 0
+    JOIN = 1
+    PUSH_PULL = 2
+    USER_EVENT = 3
+    QUERY = 4
+    QUERY_RESPONSE = 5
+    CONFLICT_RESPONSE = 6
+    KEY_REQUEST = 7
+    KEY_RESPONSE = 8
+    RELAY = 9
+
+
+QUERY_FLAG_ACK = 1  # messages.go:28-35
+
+
+class MemberStatus(enum.IntEnum):
+    """serf.go MemberStatus."""
+
+    NONE = 0
+    ALIVE = 1
+    LEAVING = 2
+    LEFT = 3
+    FAILED = 4
+
+
+class EventType(enum.IntEnum):
+    MEMBER_JOIN = 0
+    MEMBER_LEAVE = 1
+    MEMBER_FAILED = 2
+    MEMBER_UPDATE = 3
+    MEMBER_REAP = 4
+    USER = 5
+    QUERY = 6
+
+
+@dataclasses.dataclass
+class Member:
+    name: str
+    addr: str
+    tags: dict[str, str]
+    status: MemberStatus
+    status_ltime: int = 0
+    leave_time: Optional[float] = None  # when FAILED/LEFT was observed
+
+
+@dataclasses.dataclass
+class Event:
+    type: EventType
+    members: list[Member] = dataclasses.field(default_factory=list)
+    ltime: int = 0
+    name: str = ""
+    payload: bytes = b""
+    query: Optional["QueryResponseHandle"] = None
+
+
+@dataclasses.dataclass
+class QueryResult:
+    """What query() returns: who acked receipt (when want_ack) and who
+    answered (serf query.go QueryResponse AckCh/ResponseCh)."""
+
+    acks: list[str]
+    responses: list[tuple[str, bytes]]
+
+
+@dataclasses.dataclass
+class QueryResponseHandle:
+    """Handed to the app for an incoming query; respond() sends the
+    answer straight back to the originator (serf query.go Respond)."""
+
+    cluster: "Cluster"
+    id: int
+    ltime: int
+    name: str
+    payload: bytes
+    origin_addr: str
+
+    async def respond(self, payload: bytes) -> None:
+        await self.cluster._send_query_response(self, payload)
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    name: str
+    tags: dict[str, str] = dataclasses.field(default_factory=dict)
+    profile: GossipProfile = LAN
+    interval_scale: float = 1.0
+    # serf/config.go:291,311
+    event_buffer_size: int = 512
+    query_buffer_size: int = 512
+    max_user_event_size: int = 512
+    # Reaping (serf/config.go ReconnectTimeout/TombstoneTimeout, scaled).
+    reconnect_timeout_s: float = 24 * 3600.0
+    tombstone_timeout_s: float = 24 * 3600.0
+    reap_interval_s: float = 15.0
+    # Event sink: called for every Event (the EventCh analogue); events
+    # are also readable from Cluster.events (an asyncio.Queue).
+    on_event: Optional[Callable[[Event], None]] = None
+
+
+def encode_tags(tags: dict[str, str]) -> bytes:
+    """serf.go EncodeTags (msgpack map, no magic byte needed in v0)."""
+    return msgpack.packb(tags, use_bin_type=True)
+
+
+def decode_tags(meta: bytes) -> dict[str, str]:
+    if not meta:
+        return {}
+    try:
+        return msgpack.unpackb(meta, raw=False)
+    except Exception:
+        return {}
+
+
+class Cluster:
+    def __init__(self, config: ClusterConfig, transport: Transport):
+        self.config = config
+        self.clock = LamportClock()        # member intents
+        self.event_clock = LamportClock()  # user events
+        self.query_clock = LamportClock()  # queries
+        self.event_min_time = 0
+        self.query_min_time = 0
+        self.events: asyncio.Queue[Event] = asyncio.Queue()
+        self.members: dict[str, Member] = {}
+        # Dedup rings keyed LTime % size (serf.go:1231-1287).
+        self._event_buffer: list[Optional[dict]] = [None] * config.event_buffer_size
+        self._query_buffer: list[Optional[dict]] = [None] * config.query_buffer_size
+        self._query_responses: dict[int, asyncio.Queue] = {}
+        self._query_id = 0
+        # Intents that arrived before their member (serf recentIntents).
+        self._recent_intents: dict[str, tuple[SerfMessageType, int, float]] = {}
+        self._left = False
+        self._tasks: list[asyncio.Task] = []
+        # Serf broadcasts ride their own transmit-limited queue
+        # (serf.go:64-101 broadcasts/eventBroadcasts/queryBroadcasts;
+        # one queue suffices since the drain order is FIFO-within-tier).
+        from consul_tpu.net.broadcast_queue import TransmitLimitedQueue
+
+        self._broadcast_queue = TransmitLimitedQueue(
+            num_nodes=lambda: max(len(self.alive_members()), 1),
+            retransmit_mult=config.profile.retransmit_mult,
+        )
+
+        self.memberlist = Memberlist(
+            MemberlistConfig(
+                name=config.name,
+                profile=config.profile,
+                interval_scale=config.interval_scale,
+                node_meta=lambda: encode_tags(self.config.tags),
+                notify_user_msg=self._on_user_msg,
+                get_broadcasts=self._get_broadcasts,
+                local_state=self._local_state,
+                merge_remote_state=self._merge_remote_state,
+                notify_join=self._on_node_join,
+                notify_leave=self._on_node_leave,
+                notify_update=self._on_node_update,
+            ),
+            transport,
+        )
+
+    # ------------------------------------------------------------------
+    # lifecycle (serf.go:244 Create, 459 UserEvent, 630 Join, ...)
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self.clock.increment()
+        self.event_clock.increment()
+        self.query_clock.increment()
+        await self.memberlist.start()
+        self._tasks.append(asyncio.create_task(self._reap_loop()))
+
+    async def join(self, addrs: list[str]) -> int:
+        n = await self.memberlist.join(addrs)
+        if n > 0:
+            self._broadcast_intent(
+                SerfMessageType.JOIN,
+                {"ltime": self.clock.increment(), "node": self.config.name},
+            )
+        return n
+
+    async def leave(self) -> None:
+        """serf.go:690-740 Leave: broadcast the leave intent, then leave
+        the memberlist."""
+        self._left = True
+        self._broadcast_intent(
+            SerfMessageType.LEAVE,
+            {
+                "ltime": self.clock.increment(),
+                "node": self.config.name,
+                "prune": False,
+            },
+        )
+        await asyncio.sleep(self.config.interval_scale * 0.5)
+        await self.memberlist.leave()
+
+    async def shutdown(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await self.memberlist.shutdown()
+
+    def local_member(self) -> Member:
+        return self.members[self.config.name]
+
+    def alive_members(self) -> list[Member]:
+        return [
+            m for m in self.members.values() if m.status == MemberStatus.ALIVE
+        ]
+
+    # ------------------------------------------------------------------
+    # user events (serf.go:459-516, 1231-1287)
+    # ------------------------------------------------------------------
+
+    async def user_event(self, name: str, payload: bytes,
+                         coalesce: bool = True) -> None:
+        if len(name) + len(payload) > self.config.max_user_event_size:
+            raise ValueError(
+                f"user event exceeds {self.config.max_user_event_size} byte limit"
+            )
+        ltime = self.event_clock.time()
+        self.event_clock.increment()
+        msg = {
+            "ltime": ltime,
+            "name": name,
+            "payload": payload,
+            "cc": coalesce,
+        }
+        self._handle_user_event(msg)  # process locally first (serf.go:510)
+        self._queue_serf_msg(SerfMessageType.USER_EVENT, msg)
+
+    def _handle_user_event(self, msg: dict) -> bool:
+        self.event_clock.witness(msg["ltime"])
+        ltime = msg["ltime"]
+        if ltime < self.event_min_time:
+            return False
+        size = self.config.event_buffer_size
+        cur = self.event_clock.time()
+        if cur > size and ltime < cur - size:
+            log.warning("received old event %s from time %d", msg["name"], ltime)
+            return False
+        idx = ltime % size
+        seen = self._event_buffer[idx]
+        key = (msg["name"], bytes(msg["payload"]))
+        if seen is not None and seen["ltime"] == ltime:
+            if key in seen["events"]:
+                return False
+        else:
+            seen = {"ltime": ltime, "events": set()}
+            self._event_buffer[idx] = seen
+        seen["events"].add(key)
+        self._emit(
+            Event(
+                type=EventType.USER,
+                ltime=ltime,
+                name=msg["name"],
+                payload=bytes(msg["payload"]),
+            )
+        )
+        return True
+
+    # ------------------------------------------------------------------
+    # queries (serf.go:522-640, 1290-1440)
+    # ------------------------------------------------------------------
+
+    async def query(
+        self,
+        name: str,
+        payload: bytes,
+        timeout_s: Optional[float] = None,
+        want_ack: bool = False,
+    ) -> QueryResult:
+        """Broadcast a query and collect acks + (node, response) pairs
+        until the timeout (serf query semantics; default timeout =
+        GossipInterval * QueryTimeoutMult(16) * log(N+1),
+        serf.go DefaultQueryTimeout)."""
+        import math
+
+        if timeout_s is None:
+            n = max(len(self.members), 1)
+            timeout_s = (
+                self.config.profile.gossip_interval_ms
+                / 1000.0
+                * self.config.interval_scale
+                * 16
+                * max(1.0, math.ceil(math.log10(n + 1)))
+            )
+        ltime = self.query_clock.time()
+        self.query_clock.increment()
+        self._query_id += 1
+        qid = self._query_id
+        responses: asyncio.Queue = asyncio.Queue()
+        self._query_responses[qid] = responses
+        msg = {
+            "ltime": ltime,
+            "id": qid,
+            "addr": self.memberlist.transport.local_addr(),
+            "node": self.config.name,
+            "flags": QUERY_FLAG_ACK if want_ack else 0,
+            "name": name,
+            "payload": payload,
+        }
+        self._handle_query(msg)
+        self._queue_serf_msg(SerfMessageType.QUERY, msg)
+        result = QueryResult(acks=[], responses=[])
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        try:
+            while True:
+                left = deadline - loop.time()
+                if left <= 0:
+                    break
+                try:
+                    kind, node, payload = await asyncio.wait_for(
+                        responses.get(), left
+                    )
+                    if kind == "ack":
+                        result.acks.append(node)
+                    else:
+                        result.responses.append((node, payload))
+                except asyncio.TimeoutError:
+                    break
+        finally:
+            self._query_responses.pop(qid, None)
+        return result
+
+    def _handle_query(self, msg: dict) -> bool:
+        self.query_clock.witness(msg["ltime"])
+        ltime = msg["ltime"]
+        if ltime < self.query_min_time:
+            return False
+        size = self.config.query_buffer_size
+        cur = self.query_clock.time()
+        if cur > size and ltime < cur - size:
+            return False
+        idx = ltime % size
+        seen = self._query_buffer[idx]
+        if seen is not None and seen["ltime"] == ltime:
+            if msg["id"] in seen["ids"]:
+                return False
+        else:
+            seen = {"ltime": ltime, "ids": set()}
+            self._query_buffer[idx] = seen
+        seen["ids"].add(msg["id"])
+
+        handle = QueryResponseHandle(
+            cluster=self,
+            id=msg["id"],
+            ltime=ltime,
+            name=msg["name"],
+            payload=bytes(msg["payload"]),
+            origin_addr=msg["addr"],
+        )
+        if msg["flags"] & QUERY_FLAG_ACK and msg["node"] != self.config.name:
+            asyncio.ensure_future(
+                self._send_direct(
+                    SerfMessageType.QUERY_RESPONSE,
+                    {
+                        "ltime": ltime,
+                        "id": msg["id"],
+                        "from": self.config.name,
+                        "flags": QUERY_FLAG_ACK,
+                        "payload": b"",
+                    },
+                    msg["addr"],
+                )
+            )
+        self._emit(
+            Event(
+                type=EventType.QUERY,
+                ltime=ltime,
+                name=msg["name"],
+                payload=bytes(msg["payload"]),
+                query=handle,
+            )
+        )
+        return True
+
+    async def _send_query_response(
+        self, handle: QueryResponseHandle, payload: bytes
+    ) -> None:
+        await self._send_direct(
+            SerfMessageType.QUERY_RESPONSE,
+            {
+                "ltime": handle.ltime,
+                "id": handle.id,
+                "from": self.config.name,
+                "flags": 0,
+                "payload": payload,
+            },
+            handle.origin_addr,
+        )
+
+    def _handle_query_response(self, msg: dict) -> None:
+        q = self._query_responses.get(msg["id"])
+        if q is None:
+            return
+        kind = "ack" if msg["flags"] & QUERY_FLAG_ACK else "response"
+        q.put_nowait((kind, msg["from"], bytes(msg["payload"])))
+
+    # ------------------------------------------------------------------
+    # membership intents (serf.go handleNodeJoinIntent / LeaveIntent)
+    # ------------------------------------------------------------------
+
+    def _save_recent_intent(self, kind: SerfMessageType, msg: dict) -> bool:
+        """Buffer an intent for a not-yet-known member so it can replay
+        when the member arrives (serf.go recentIntents/upsertIntent);
+        returns True if stored as the freshest intent for that node."""
+        node = msg["node"]
+        cur = self._recent_intents.get(node)
+        if cur is not None and cur[1] >= msg["ltime"]:
+            return False
+        self._recent_intents[node] = (kind, msg["ltime"], time.monotonic())
+        return True
+
+    def _handle_join_intent(self, msg: dict) -> bool:
+        self.clock.witness(msg["ltime"])
+        m = self.members.get(msg["node"])
+        if m is None:
+            return self._save_recent_intent(SerfMessageType.JOIN, msg)
+        if msg["ltime"] <= m.status_ltime:
+            return False
+        m.status_ltime = msg["ltime"]
+        if m.status == MemberStatus.LEAVING:
+            m.status = MemberStatus.ALIVE
+        return True
+
+    def _handle_leave_intent(self, msg: dict) -> bool:
+        self.clock.witness(msg["ltime"])
+        m = self.members.get(msg["node"])
+        if m is None:
+            return self._save_recent_intent(SerfMessageType.LEAVE, msg)
+        if msg["ltime"] <= m.status_ltime:
+            return False
+        m.status_ltime = msg["ltime"]
+        if m.status == MemberStatus.ALIVE:
+            m.status = MemberStatus.LEAVING
+            return True
+        if m.status == MemberStatus.FAILED:
+            # A failed node's leave intent converts it to graceful left
+            # (serf.go handleNodeLeaveIntent).
+            m.status = MemberStatus.LEFT
+            self._emit(Event(type=EventType.MEMBER_LEAVE, members=[m]))
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # memberlist delegate plumbing
+    # ------------------------------------------------------------------
+
+    def _queue_serf_msg(
+        self, t: SerfMessageType, body: dict, name: Optional[str] = None
+    ) -> None:
+        self._broadcast_queue.queue(
+            bytes([t]) + msgpack.packb(body, use_bin_type=True), name=name
+        )
+
+    def _broadcast_intent(self, t: SerfMessageType, body: dict) -> None:
+        # Intents are name-keyed so a newer intent for the same node
+        # replaces the queued older one (TransmitLimitedQueue
+        # invalidation, like serf's broadcast Invalidates).
+        self._queue_serf_msg(t, body, name=f"intent:{body['node']}")
+
+    def _get_broadcasts(self, overhead: int, limit: int) -> list[bytes]:
+        """Drain serf broadcasts into the gossip packet, each message
+        retransmitted up to the budget (delegate.go:137-171)."""
+        return self._broadcast_queue.get_broadcasts(overhead, limit)
+
+    async def _send_direct(self, t: SerfMessageType, body: dict, addr: str) -> None:
+        from consul_tpu.net import wire
+
+        payload = bytes([t]) + msgpack.packb(body, use_bin_type=True)
+        await self.memberlist.transport.write_to(
+            wire.encode(wire.MessageType.USER, payload), addr
+        )
+
+    def _on_user_msg(self, payload: bytes) -> None:
+        if not payload:
+            return
+        t = SerfMessageType(payload[0])
+        body = msgpack.unpackb(bytes(payload[1:]), raw=False)
+        rebroadcast = False
+        if t == SerfMessageType.USER_EVENT:
+            rebroadcast = self._handle_user_event(body)
+        elif t == SerfMessageType.QUERY:
+            rebroadcast = self._handle_query(body)
+        elif t == SerfMessageType.QUERY_RESPONSE:
+            self._handle_query_response(body)
+        elif t == SerfMessageType.JOIN:
+            rebroadcast = self._handle_join_intent(body)
+        elif t == SerfMessageType.LEAVE:
+            rebroadcast = self._handle_leave_intent(body)
+        else:
+            log.warning("unhandled serf message type %s", t)
+        if rebroadcast:
+            self._queue_serf_msg(t, body)
+
+    # --- member events from memberlist (serf delegate NotifyJoin etc.)
+
+    def _member_from_node(self, node: Node) -> Member:
+        return Member(
+            name=node.name,
+            addr=node.addr,
+            tags=decode_tags(node.meta),
+            status=MemberStatus.ALIVE,
+        )
+
+    def _on_node_join(self, node: Node) -> None:
+        m = self.members.get(node.name)
+        if m is None:
+            m = self._member_from_node(node)
+            self.members[node.name] = m
+        else:
+            m.addr = node.addr
+            m.tags = decode_tags(node.meta)
+            m.status = MemberStatus.ALIVE
+        # Replay any intent that gossiped ahead of the membership
+        # (serf.go handleNodeJoin recentIntents replay).
+        pending = self._recent_intents.pop(node.name, None)
+        if pending is not None:
+            kind, ltime, _ = pending
+            body = {"ltime": ltime, "node": node.name}
+            if kind == SerfMessageType.LEAVE:
+                self._handle_leave_intent({**body, "prune": False})
+            else:
+                self._handle_join_intent(body)
+        self._emit(Event(type=EventType.MEMBER_JOIN, members=[m]))
+
+    def _on_node_leave(self, node: Node) -> None:
+        m = self.members.get(node.name)
+        if m is None:
+            return
+        m.leave_time = time.monotonic()
+        if node.status == NodeStatus.LEFT or m.status == MemberStatus.LEAVING:
+            m.status = MemberStatus.LEFT
+            self._emit(Event(type=EventType.MEMBER_LEAVE, members=[m]))
+        else:
+            m.status = MemberStatus.FAILED
+            self._emit(Event(type=EventType.MEMBER_FAILED, members=[m]))
+
+    def _on_node_update(self, node: Node) -> None:
+        m = self.members.get(node.name)
+        if m is None:
+            return
+        m.tags = decode_tags(node.meta)
+        self._emit(Event(type=EventType.MEMBER_UPDATE, members=[m]))
+
+    def _emit(self, event: Event) -> None:
+        self.events.put_nowait(event)
+        if self.config.on_event is not None:
+            try:
+                self.config.on_event(event)
+            except Exception:
+                log.exception("event handler failed")
+
+    # ------------------------------------------------------------------
+    # push/pull backstop (delegate.go:173-297)
+    # ------------------------------------------------------------------
+
+    def _local_state(self, join: bool) -> bytes:
+        recent = [
+            {"ltime": s["ltime"],
+             "events": [{"name": n, "payload": p} for (n, p) in s["events"]]}
+            for s in self._event_buffer
+            if s is not None
+        ]
+        return msgpack.packb(
+            {
+                "ltime": self.clock.time(),
+                "event_ltime": self.event_clock.time(),
+                "query_ltime": self.query_clock.time(),
+                "status_ltimes": {
+                    name: m.status_ltime for name, m in self.members.items()
+                },
+                "left_members": [
+                    name
+                    for name, m in self.members.items()
+                    if m.status == MemberStatus.LEFT
+                ],
+                "events": recent,
+            },
+            use_bin_type=True,
+        )
+
+    def _merge_remote_state(self, raw: bytes, join: bool) -> None:
+        body = msgpack.unpackb(raw, raw=False)
+        self.clock.witness(body["ltime"])
+        self.event_clock.witness(body["event_ltime"])
+        self.query_clock.witness(body["query_ltime"])
+        for name, lt in body.get("status_ltimes", {}).items():
+            m = self.members.get(name)
+            if m is not None and lt > m.status_ltime:
+                m.status_ltime = lt
+        for name in body.get("left_members", []):
+            m = self.members.get(name)
+            if m is not None and m.status == MemberStatus.FAILED:
+                m.status = MemberStatus.LEFT
+        for entry in body.get("events", []):
+            for ev in entry["events"]:
+                self._handle_user_event(
+                    {
+                        "ltime": entry["ltime"],
+                        "name": ev["name"],
+                        "payload": ev["payload"],
+                        "cc": False,
+                    }
+                )
+
+    # ------------------------------------------------------------------
+    # reaping (serf.go:1547-1612)
+    # ------------------------------------------------------------------
+
+    async def _reap_loop(self) -> None:
+        interval = self.config.reap_interval_s * self.config.interval_scale
+        while True:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            for name, m in list(self.members.items()):
+                if m.status not in (MemberStatus.FAILED, MemberStatus.LEFT):
+                    continue
+                cutoff = (
+                    self.config.reconnect_timeout_s
+                    if m.status == MemberStatus.FAILED
+                    else self.config.tombstone_timeout_s
+                ) * self.config.interval_scale
+                changed = getattr(m, "leave_time", None)
+                node = self.memberlist.nodes.get(name)
+                ref = changed or (node.state_change if node else now)
+                if now - ref > cutoff:
+                    del self.members[name]
+                    self.memberlist.nodes.pop(name, None)
+                    self._emit(Event(type=EventType.MEMBER_REAP, members=[m]))
+            # Expire buffered intents that never found their member
+            # (serf.go recentIntents expiry).
+            for name, (_, _, ts) in list(self._recent_intents.items()):
+                if now - ts > 60.0 * self.config.interval_scale * 5:
+                    del self._recent_intents[name]

@@ -1,0 +1,38 @@
+"""Host networking plane: the real (socket) gossip implementation.
+
+This is the runnable agent counterpart of the TPU simulator: the same
+protocol (constants and formulas imported from ``consul_tpu.protocol``)
+executed by an asyncio event loop over pluggable transports.
+
+  wire.py            message types + msgpack codec + compound messages
+  transport.py       Transport interface; in-memory mock network (the
+                     default unit of testing, after memberlist's
+                     MockTransport) and a UDP/TCP socket transport
+  broadcast_queue.py TransmitLimitedQueue equivalent
+  suspicion.py       Lifeguard suspicion timer
+  memberlist.py      SWIM membership + failure detection
+"""
+
+from consul_tpu.net.wire import MessageType, encode, decode
+from consul_tpu.net.transport import (
+    Transport,
+    InMemoryNetwork,
+    InMemoryTransport,
+    UDPTransport,
+)
+from consul_tpu.net.broadcast_queue import TransmitLimitedQueue
+from consul_tpu.net.memberlist import Memberlist, MemberlistConfig, Node
+
+__all__ = [
+    "MessageType",
+    "encode",
+    "decode",
+    "Transport",
+    "InMemoryNetwork",
+    "InMemoryTransport",
+    "UDPTransport",
+    "TransmitLimitedQueue",
+    "Memberlist",
+    "MemberlistConfig",
+    "Node",
+]

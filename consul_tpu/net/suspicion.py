@@ -1,0 +1,71 @@
+"""Lifeguard suspicion timer (host side).
+
+Equivalent of memberlist/suspicion.go: starts at the max timeout and is
+driven toward the min by independent confirmations on a log scale.  The
+timeout math is shared with the simulator via
+consul_tpu.protocol.formulas.remaining_suspicion_timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable
+
+from consul_tpu.protocol import remaining_suspicion_timeout
+
+
+class Suspicion:
+    """suspicion.go:50-130 newSuspicion/Confirm."""
+
+    def __init__(
+        self,
+        from_node: str,
+        k: int,
+        min_s: float,
+        max_s: float,
+        timeout_fn: Callable[[int], None],
+    ):
+        self.k = k
+        self.min_s = min_s
+        self.max_s = max_s
+        self.confirmations = {from_node}  # the accuser doesn't confirm
+        self.n = 0
+        self._timeout_fn = timeout_fn
+        self._start = time.monotonic()
+        timeout = min_s if k < 1 else max_s
+        self._handle = asyncio.get_running_loop().call_later(
+            timeout, self._fire
+        )
+
+    def _fire(self) -> None:
+        self._timeout_fn(self.n)
+
+    def remaining(self) -> float:
+        """Seconds left on the timer given current confirmations."""
+        total_ms = remaining_suspicion_timeout(
+            self.n, self.k, self.min_s * 1000.0, self.max_s * 1000.0
+        )
+        elapsed = time.monotonic() - self._start
+        return total_ms / 1000.0 - elapsed
+
+    def confirm(self, from_node: str) -> bool:
+        """Register an independent confirmation; True if it was new
+        information (suspicion.go:103-130)."""
+        if self.n >= self.k:
+            return False
+        if from_node in self.confirmations:
+            return False
+        self.confirmations.add(from_node)
+        self.n += 1
+        remaining = self.remaining()
+        self._handle.cancel()
+        loop = asyncio.get_running_loop()
+        if remaining > 0:
+            self._handle = loop.call_later(remaining, self._fire)
+        else:
+            self._handle = loop.call_soon(self._fire)
+        return True
+
+    def stop(self) -> None:
+        self._handle.cancel()

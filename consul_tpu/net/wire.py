@@ -1,0 +1,77 @@
+"""Wire format: message type enum + msgpack codec + compound messages.
+
+Mirrors the reference's packet grammar (memberlist/net.go:44-95): each
+UDP payload is one byte of message type followed by a msgpack body;
+``compound`` packets carry several messages in one datagram
+(net.go makeCompoundMessage / decodeCompoundMessage, util.go:157-217).
+
+Encryption (AES-GCM, security.go) and LZW compression (util.go:219-275)
+are deliberately not implemented in v0; the enum slots are reserved so
+the wire numbering matches.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from typing import Any, Iterable
+
+import msgpack
+
+
+class MessageType(enum.IntEnum):
+    """memberlist/net.go:44-59 messageType enum (same numbering)."""
+
+    PING = 0
+    INDIRECT_PING = 1
+    ACK_RESP = 2
+    SUSPECT = 3
+    ALIVE = 4
+    DEAD = 5
+    PUSH_PULL = 6
+    COMPOUND = 7
+    USER = 8            # carries an opaque delegate payload (serf)
+    COMPRESS = 9        # reserved, not implemented
+    ENCRYPT = 10        # reserved, not implemented
+    NACK_RESP = 11
+    HAS_CRC = 12        # reserved
+    ERR = 13
+
+
+def encode(msg_type: MessageType, body: Any) -> bytes:
+    """One byte of type + msgpack body (net.go encode / util.go:37-52)."""
+    return bytes([msg_type]) + msgpack.packb(body, use_bin_type=True)
+
+
+def decode(raw: bytes) -> tuple[MessageType, Any]:
+    if not raw:
+        raise ValueError("empty packet")
+    return MessageType(raw[0]), msgpack.unpackb(raw[1:], raw=False)
+
+
+def make_compound(messages: Iterable[bytes]) -> bytes:
+    """COMPOUND byte + count + u16 lengths + bodies (util.go:157-177)."""
+    msgs = list(messages)
+    if len(msgs) > 255:
+        raise ValueError("too many messages for one compound packet")
+    out = [bytes([MessageType.COMPOUND]), bytes([len(msgs)])]
+    for m in msgs:
+        out.append(struct.pack(">H", len(m)))
+    out.extend(msgs)
+    return b"".join(out)
+
+
+def split_compound(raw: bytes) -> list[bytes]:
+    """Inverse of make_compound; raw includes the leading COMPOUND byte
+    (util.go:180-217 decodeCompoundMessage)."""
+    if not raw or raw[0] != MessageType.COMPOUND:
+        raise ValueError("not a compound message")
+    n = raw[1]
+    lengths = struct.unpack_from(f">{n}H", raw, 2)
+    parts, off = [], 2 + 2 * n
+    for ln in lengths:
+        if off + ln > len(raw):
+            raise ValueError("truncated compound message")
+        parts.append(raw[off : off + ln])
+        off += ln
+    return parts

@@ -1,0 +1,270 @@
+"""Host-plane memberlist tests over the in-memory network — the
+counterpart of memberlist's MockTransport-based tests (SURVEY.md §4.2).
+All clusters run at interval_scale=0.02 (50x faster than LAN timing)."""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.net import (
+    InMemoryNetwork,
+    Memberlist,
+    MemberlistConfig,
+)
+from consul_tpu.net.memberlist import NodeStatus
+
+SCALE = 0.02
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def make_cluster(net, n, joined=True, **cfg_kw):
+    nodes = []
+    for i in range(n):
+        t = net.new_transport(f"mem://n{i}")
+        m = Memberlist(
+            MemberlistConfig(name=f"n{i}", interval_scale=SCALE, **cfg_kw), t
+        )
+        await m.start()
+        nodes.append(m)
+    if joined:
+        for m in nodes[1:]:
+            assert await m.join(["mem://n0"]) == 1
+    return nodes
+
+
+async def wait_until(pred, timeout=30.0, step=0.02):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(step)
+    return False
+
+
+async def stop_all(nodes):
+    for m in nodes:
+        await m.shutdown()
+
+
+def test_three_node_cluster_forms():
+    async def main():
+        net = InMemoryNetwork()
+        nodes = await make_cluster(net, 3)
+        ok = await wait_until(
+            lambda: all(len(m.members()) == 3 for m in nodes)
+        )
+        assert ok, [len(m.members()) for m in nodes]
+        # Everyone sees everyone alive by name.
+        names = {m.config.name for m in nodes}
+        for m in nodes:
+            assert {x.name for x in m.members()} == names
+        await stop_all(nodes)
+
+    run(main())
+
+
+def test_failure_detection_marks_dead():
+    async def main():
+        net = InMemoryNetwork()
+        nodes = await make_cluster(net, 4)
+        assert await wait_until(
+            lambda: all(len(m.members()) == 4 for m in nodes)
+        )
+        # Crash n3: its transport vanishes from the network.
+        await nodes[3].shutdown()
+        survivors = nodes[:3]
+        ok = await wait_until(
+            lambda: all(
+                m.nodes["n3"].status in (NodeStatus.DEAD,) for m in survivors
+            ),
+            timeout=40.0,
+        )
+        assert ok, [m.nodes["n3"].status for m in survivors]
+        await stop_all(survivors)
+
+    run(main())
+
+
+def test_graceful_leave_is_left_not_dead():
+    async def main():
+        net = InMemoryNetwork()
+        nodes = await make_cluster(net, 3)
+        assert await wait_until(
+            lambda: all(len(m.members()) == 3 for m in nodes)
+        )
+        await nodes[2].leave()
+        await nodes[2].shutdown()
+        ok = await wait_until(
+            lambda: all(
+                m.nodes["n2"].status == NodeStatus.LEFT for m in nodes[:2]
+            )
+        )
+        assert ok, [m.nodes["n2"].status for m in nodes[:2]]
+        await stop_all(nodes[:2])
+
+    run(main())
+
+
+def test_false_suspicion_is_refuted():
+    async def main():
+        net = InMemoryNetwork()
+        nodes = await make_cluster(net, 3)
+        assert await wait_until(
+            lambda: all(len(m.members()) == 3 for m in nodes)
+        )
+        # Inject a false suspicion about n1 directly into n0's state
+        # machine (the serf messageDropper-style fault injection).
+        victim_inc = nodes[0].nodes["n1"].incarnation
+        nodes[0]._suspect_node(
+            {"inc": victim_inc, "node": "n1", "from": "n0"}
+        )
+        assert nodes[0].nodes["n1"].status == NodeStatus.SUSPECT
+        # n1 must hear the gossiped suspicion, refute with a higher
+        # incarnation, and everyone returns to alive.
+        ok = await wait_until(
+            lambda: all(
+                m.nodes["n1"].status == NodeStatus.ALIVE
+                and m.nodes["n1"].incarnation > victim_inc
+                for m in nodes
+            ),
+            timeout=40.0,
+        )
+        assert ok, [
+            (m.nodes["n1"].status, m.nodes["n1"].incarnation) for m in nodes
+        ]
+        await stop_all(nodes)
+
+    run(main())
+
+
+def test_cluster_survives_30pct_packet_loss():
+    async def main():
+        net = InMemoryNetwork(loss=0.30, seed=7)
+        nodes = await make_cluster(net, 4)
+        ok = await wait_until(
+            lambda: all(len(m.members()) == 4 for m in nodes), timeout=40.0
+        )
+        assert ok
+        # Under loss, transient suspicion may occur, but nobody should be
+        # declared dead while all transports are up: give it a while and
+        # confirm views return to/stay alive.
+        await asyncio.sleep(2.0)
+        for m in nodes:
+            assert all(
+                x.status in (NodeStatus.ALIVE, NodeStatus.SUSPECT)
+                for x in m.nodes.values()
+            ), f"{m.config.name} sees a dead node despite all being up"
+        await stop_all(nodes)
+
+    run(main())
+
+
+def test_push_pull_converges_without_gossip():
+    async def main():
+        # Drop every gossip/user datagram except ping/ack traffic: the
+        # periodic TCP push/pull must still converge membership.
+        from consul_tpu.net import wire
+
+        def drop(payload, src, dst):
+            t = payload[0]
+            return t in (
+                wire.MessageType.SUSPECT,
+                wire.MessageType.ALIVE,
+                wire.MessageType.DEAD,
+                wire.MessageType.COMPOUND,
+            )
+
+        net = InMemoryNetwork(drop_fn=drop)
+        nodes = await make_cluster(net, 3)
+        ok = await wait_until(
+            lambda: all(len(m.members()) == 3 for m in nodes), timeout=50.0
+        )
+        assert ok, [len(m.members()) for m in nodes]
+        await stop_all(nodes)
+
+    run(main())
+
+
+def test_stale_alive_does_not_clear_suspicion():
+    async def main():
+        net = InMemoryNetwork()
+        nodes = await make_cluster(net, 3)
+        assert await wait_until(
+            lambda: all(len(m.members()) == 3 for m in nodes)
+        )
+        m0 = nodes[0]
+        inc = m0.nodes["n1"].incarnation
+        m0._suspect_node({"inc": inc, "node": "n1", "from": "n0"})
+        assert m0.nodes["n1"].status == NodeStatus.SUSPECT
+        # A stale alive at the SAME incarnation must not clear it
+        # (refutation needs a strictly higher incarnation).
+        m0._alive_node(
+            {"name": "n1", "addr": "mem://n1", "inc": inc,
+             "status": 0, "meta": b""}
+        )
+        assert m0.nodes["n1"].status == NodeStatus.SUSPECT
+        await stop_all(nodes)
+
+    run(main())
+
+
+def test_late_joiner_sees_left_not_dead_via_push_pull():
+    async def main():
+        net = InMemoryNetwork()
+        nodes = await make_cluster(net, 3)
+        assert await wait_until(
+            lambda: all(len(m.members()) == 3 for m in nodes)
+        )
+        await nodes[2].leave()
+        await nodes[2].shutdown()
+        assert await wait_until(
+            lambda: nodes[0].nodes["n2"].status == NodeStatus.LEFT
+        )
+        # A late joiner merges n0's state.  Like the reference
+        # (mergeState -> deadNode ignores unknown nodes,
+        # state.go:1297-1300 + 1222-1230), it must never resurrect the
+        # departed node as ALIVE; it either never materializes or is LEFT.
+        t = net.new_transport("mem://n3")
+        late = Memberlist(
+            MemberlistConfig(name="n3", interval_scale=SCALE), t
+        )
+        await late.start()
+        assert await late.join(["mem://n0"]) == 1
+        await asyncio.sleep(1.0)
+        n2 = late.nodes.get("n2")
+        assert n2 is None or n2.status == NodeStatus.LEFT, n2
+        # And the nodes that do appear are the real live ones.
+        assert {m.name for m in late.members()} == {"n0", "n1", "n3"}
+        await stop_all(nodes[:2] + [late])
+
+    run(main())
+
+
+def test_udp_transport_smoke():
+    async def main():
+        from consul_tpu.net import UDPTransport
+
+        ts = []
+        ms = []
+        for i in range(3):
+            t = UDPTransport("127.0.0.1", 0)
+            await t.start()
+            ts.append(t)
+            m = Memberlist(
+                MemberlistConfig(name=f"u{i}", interval_scale=SCALE), t
+            )
+            await m.start()
+            ms.append(m)
+        for m in ms[1:]:
+            assert await m.join([ts[0].local_addr()]) == 1
+        ok = await wait_until(
+            lambda: all(len(m.members()) == 3 for m in ms), timeout=30.0
+        )
+        assert ok, [len(m.members()) for m in ms]
+        await stop_all(ms)
+
+    run(main())
