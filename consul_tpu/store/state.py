@@ -1,0 +1,822 @@
+"""The replicated state store: catalog, KV, sessions, coordinates.
+
+Equivalent of the reference's ``agent/consul/state`` package — a
+``go-memdb`` database of domain tables whose radix watches power
+blocking queries (``state/state_store.go:102``, schema registry
+``state/schema.go:16-38``).  Every record carries ``create_index`` /
+``modify_index`` (the Raft log index of the write), and an ``index``
+table tracks the last-modified index per table
+(``maxIndexTxn``) so queries can report ``X-Consul-Index``.
+
+Tables: nodes, services, checks, kvs, tombstones (graveyard), sessions,
+coordinates, config_entries, prepared_queries, acl_tokens, acl_policies,
+index.
+
+Deletions of KV entries leave **tombstones** (``state/graveyard.go``)
+so prefix listings report a bumped index after a delete; they are
+reaped periodically by the leader (tombstone GC, ``leader.go:292``).
+
+All writes go through ``StateStore`` methods taking an explicit
+``idx`` (the Raft index) — the FSM is the only writer in a server,
+mirroring ``fsm/fsm.go:102``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, Optional
+
+from consul_tpu.store.memdb import (
+    SEP,
+    Change,
+    IndexSchema,
+    MemDB,
+    MemTxn,
+    TableSchema,
+    WatchSet,
+)
+
+# Check status values (reference api/health.go).
+HEALTH_PASSING = "passing"
+HEALTH_WARNING = "warning"
+HEALTH_CRITICAL = "critical"
+
+# Session invalidation behaviors (structs/structs.go SessionBehavior).
+SESSION_BEHAVIOR_RELEASE = "release"
+SESSION_BEHAVIOR_DELETE = "delete"
+
+SERF_CHECK_ID = "serfHealth"  # agent/structs: SerfCheckID
+
+
+def _b(s: str) -> bytes:
+    return s.encode()
+
+
+def _schemas() -> list[TableSchema]:
+    return [
+        TableSchema("nodes", primary=lambda r: _b(r["node"])),
+        TableSchema(
+            "services",
+            primary=lambda r: _b(r["node"]) + SEP + _b(r["id"]),
+            indexes=(IndexSchema("service", key=lambda r: _b(r["service"])),),
+        ),
+        TableSchema(
+            "checks",
+            primary=lambda r: _b(r["node"]) + SEP + _b(r["check_id"]),
+            indexes=(
+                IndexSchema(
+                    "service",
+                    key=lambda r: _b(r["service_name"]) if r.get("service_name") else None,
+                ),
+                IndexSchema("status", key=lambda r: _b(r["status"])),
+            ),
+        ),
+        TableSchema(
+            "kvs",
+            primary=lambda r: _b(r["key"]),
+            indexes=(
+                IndexSchema(
+                    "session",
+                    key=lambda r: _b(r["session"]) if r.get("session") else None,
+                ),
+            ),
+        ),
+        TableSchema("tombstones", primary=lambda r: _b(r["key"])),
+        TableSchema(
+            "sessions",
+            primary=lambda r: _b(r["id"]),
+            indexes=(IndexSchema("node", key=lambda r: _b(r["node"])),),
+        ),
+        TableSchema(
+            "coordinates",
+            primary=lambda r: _b(r["node"]) + SEP + _b(r.get("segment", "")),
+        ),
+        TableSchema(
+            "config_entries",
+            primary=lambda r: _b(r["kind"]) + SEP + _b(r["name"]),
+        ),
+        TableSchema("prepared_queries", primary=lambda r: _b(r["id"])),
+        TableSchema("acl_tokens", primary=lambda r: _b(r["secret_id"])),
+        TableSchema("acl_policies", primary=lambda r: _b(r["id"])),
+        TableSchema("index", primary=lambda r: _b(r["key"])),
+    ]
+
+
+DUMP_TABLES = [s.name for s in _schemas() if s.name != "index"]
+
+
+class StateStore:
+    def __init__(self) -> None:
+        self.db = MemDB(_schemas())
+        self._abandon = None  # lazily-created asyncio.Event
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+
+    def abandon_event(self):
+        import asyncio
+
+        if self._abandon is None:
+            self._abandon = asyncio.Event()
+        return self._abandon
+
+    def abandon(self) -> None:
+        """Wake all blocked queries permanently (store being replaced by
+        a snapshot restore — ``state_store.go`` AbandonCh)."""
+        if self._abandon is not None:
+            self._abandon.set()
+            self._abandon = None
+
+    @staticmethod
+    def _bump(tx: MemTxn, idx: int, *tables: str) -> None:
+        for t in tables:
+            tx.insert("index", {"key": t, "value": idx})
+
+    def max_index(self, *tables: str, tx: Optional[MemTxn] = None) -> int:
+        tx = tx or self.db.txn()
+        best = 0
+        for t in tables:
+            rec = tx.get("index", _b(t))
+            if rec:
+                best = max(best, rec["value"])
+        return best
+
+    def table_watch(self, table: str, ws: WatchSet) -> None:
+        """Watch the whole table (root watch)."""
+        ws.add(self.db.tree(table).watch_prefix(b""))
+
+    # ------------------------------------------------------------------
+    # catalog: nodes / services / checks  (state/catalog.go)
+    # ------------------------------------------------------------------
+
+    def ensure_registration(self, idx: int, req: dict) -> None:
+        """Atomic node+service+check(s) registration
+        (``state/catalog.go:274`` EnsureRegistration)."""
+        tx = self.db.txn(write=True)
+        self._ensure_node_txn(tx, idx, req)
+        if req.get("service"):
+            self._ensure_service_txn(tx, idx, req["node"], req["service"])
+        for check in req.get("checks", []) or ([req["check"]] if req.get("check") else []):
+            self._ensure_check_txn(tx, idx, req["node"], check)
+        tx.commit()
+
+    def _ensure_node_txn(self, tx: MemTxn, idx: int, req: dict) -> None:
+        existing = tx.get("nodes", _b(req["node"]))
+        node = {
+            "node": req["node"],
+            "address": req.get("address", existing.get("address", "") if existing else ""),
+            "meta": req.get("node_meta", existing.get("meta", {}) if existing else {}),
+            "tagged_addresses": req.get(
+                "tagged_addresses",
+                existing.get("tagged_addresses", {}) if existing else {},
+            ),
+            "create_index": existing["create_index"] if existing else idx,
+            "modify_index": idx,
+        }
+        if existing and all(
+            existing[k] == node[k]
+            for k in ("address", "meta", "tagged_addresses")
+        ):
+            return  # idempotent — don't bump indexes (catalog.go ensureNodeTxn)
+        tx.insert("nodes", node)
+        self._bump(tx, idx, "nodes")
+
+    def _ensure_service_txn(self, tx: MemTxn, idx: int, node: str, svc: dict) -> None:
+        sid = svc.get("id") or svc["service"]
+        pk = _b(node) + SEP + _b(sid)
+        existing = tx.get("services", pk)
+        rec = {
+            "node": node,
+            "id": sid,
+            "service": svc["service"],
+            "tags": list(svc.get("tags", [])),
+            "address": svc.get("address", ""),
+            "port": int(svc.get("port", 0)),
+            "meta": svc.get("meta", {}),
+            "weights": svc.get("weights", {"passing": 1, "warning": 1}),
+            "create_index": existing["create_index"] if existing else idx,
+            "modify_index": idx,
+        }
+        if existing and all(
+            existing[k] == rec[k]
+            for k in ("service", "tags", "address", "port", "meta", "weights")
+        ):
+            return
+        tx.insert("services", rec)
+        self._bump(tx, idx, "services")
+
+    def _ensure_check_txn(self, tx: MemTxn, idx: int, node: str, check: dict) -> None:
+        cid = check.get("check_id") or check.get("name")
+        service_name = check.get("service_name", "")
+        if check.get("service_id") and not service_name:
+            svc = tx.get("services", _b(node) + SEP + _b(check["service_id"]))
+            if svc:
+                service_name = svc["service"]
+        pk = _b(node) + SEP + _b(cid)
+        existing = tx.get("checks", pk)
+        rec = {
+            "node": node,
+            "check_id": cid,
+            "name": check.get("name", cid),
+            "status": check.get("status", HEALTH_CRITICAL),
+            "notes": check.get("notes", ""),
+            "output": check.get("output", ""),
+            "service_id": check.get("service_id", ""),
+            "service_name": service_name,
+            "create_index": existing["create_index"] if existing else idx,
+            "modify_index": idx,
+        }
+        if existing and all(
+            existing[k] == rec[k]
+            for k in ("name", "status", "notes", "output", "service_id")
+        ):
+            return
+        tx.insert("checks", rec)
+        self._bump(tx, idx, "checks")
+        # A check leaving "passing" invalidates sessions that require it
+        # (state/session.go invalidation via session_checks).
+        if rec["status"] == HEALTH_CRITICAL:
+            self._invalidate_sessions_for_check(tx, idx, node, cid)
+
+    def delete_node(self, idx: int, node: str) -> bool:
+        """Remove a node and everything attached to it
+        (``state/catalog.go`` DeleteNode)."""
+        tx = self.db.txn(write=True)
+        if tx.get("nodes", _b(node)) is None:
+            tx.abort()
+            return False
+        tx.delete("nodes", _b(node))
+        n_svc = tx.delete_prefix("services", _b(node) + SEP)
+        n_chk = tx.delete_prefix("checks", _b(node) + SEP)
+        n_coord = tx.delete_prefix("coordinates", _b(node) + SEP)
+        self._bump(tx, idx, "nodes")
+        if n_coord:
+            self._bump(tx, idx, "coordinates")
+        if n_svc:
+            self._bump(tx, idx, "services")
+        if n_chk:
+            self._bump(tx, idx, "checks")
+        for sess in tx.records("sessions", _b(node) + SEP, index="node"):
+            self._destroy_session_txn(tx, idx, sess)
+        tx.commit()
+        return True
+
+    def delete_service(self, idx: int, node: str, service_id: str) -> bool:
+        tx = self.db.txn(write=True)
+        old = tx.delete("services", _b(node) + SEP + _b(service_id))
+        if old is None:
+            tx.abort()
+            return False
+        # Drop the service's checks too (catalog.go deleteServiceTxn),
+        # invalidating sessions bound to them like an explicit delete.
+        for chk in tx.records("checks", _b(node) + SEP):
+            if chk.get("service_id") == service_id:
+                tx.delete("checks", _b(node) + SEP + _b(chk["check_id"]))
+                self._invalidate_sessions_for_check(tx, idx, node, chk["check_id"])
+        self._bump(tx, idx, "services", "checks")
+        tx.commit()
+        return True
+
+    def delete_check(self, idx: int, node: str, check_id: str) -> bool:
+        tx = self.db.txn(write=True)
+        old = tx.delete("checks", _b(node) + SEP + _b(check_id))
+        if old is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "checks")
+        self._invalidate_sessions_for_check(tx, idx, node, check_id)
+        tx.commit()
+        return True
+
+    # -- catalog reads (each returns (index, data) and feeds the WatchSet)
+
+    def nodes(self, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        recs = tx.records("nodes", ws=ws)
+        return self.max_index("nodes", tx=tx), recs
+
+    def node(self, name: str, ws: Optional[WatchSet] = None) -> tuple[int, Optional[dict]]:
+        tx = self.db.txn()
+        return self.max_index("nodes", tx=tx), tx.get("nodes", _b(name), ws=ws)
+
+    def services(self, ws: Optional[WatchSet] = None) -> tuple[int, dict[str, list[str]]]:
+        """Service name -> union of tags (``Catalog.ListServices``)."""
+        tx = self.db.txn()
+        out: dict[str, set] = {}
+        for rec in tx.records("services", ws=ws):
+            out.setdefault(rec["service"], set()).update(rec["tags"])
+        return (
+            self.max_index("services", tx=tx),
+            {k: sorted(v) for k, v in out.items()},
+        )
+
+    def node_services(self, node: str, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        recs = tx.records("services", _b(node) + SEP, ws=ws)
+        return self.max_index("services", tx=tx), recs
+
+    def service_nodes(
+        self, service: str, tag: Optional[str] = None, ws: Optional[WatchSet] = None
+    ) -> tuple[int, list[dict]]:
+        """Service instances joined with their node's address
+        (``Catalog.ServiceNodes``)."""
+        tx = self.db.txn()
+        out = []
+        for rec in tx.records("services", _b(service) + SEP, index="service", ws=ws):
+            if tag is not None and tag not in rec["tags"]:
+                continue
+            node = tx.get("nodes", _b(rec["node"]), ws=ws)
+            merged = dict(rec)
+            merged["node_address"] = node["address"] if node else ""
+            out.append(merged)
+        return self.max_index("services", "nodes", tx=tx), out
+
+    def node_checks(self, node: str, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("checks", tx=tx),
+            tx.records("checks", _b(node) + SEP, ws=ws),
+        )
+
+    def service_checks(self, service: str, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("checks", tx=tx),
+            tx.records("checks", _b(service) + SEP, index="service", ws=ws),
+        )
+
+    def checks_in_state(self, status: str, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("checks", tx=tx),
+            tx.records("checks", _b(status) + SEP, index="status", ws=ws),
+        )
+
+    def check_service_nodes(
+        self,
+        service: str,
+        tag: Optional[str] = None,
+        passing_only: bool = False,
+        ws: Optional[WatchSet] = None,
+    ) -> tuple[int, list[dict]]:
+        """Health endpoint's joined view: service instance + node +
+        its checks (node-level + service-level)
+        (``Health.ServiceNodes``, ``state/catalog.go`` CheckServiceNodes)."""
+        tx = self.db.txn()
+        idx, instances = self.service_nodes(service, tag, ws)
+        out = []
+        for inst in instances:
+            checks = [
+                c
+                for c in tx.records("checks", _b(inst["node"]) + SEP, ws=ws)
+                if c["service_id"] in ("", inst["id"])
+            ]
+            if passing_only and any(c["status"] != HEALTH_PASSING for c in checks):
+                continue
+            out.append({"service": inst, "checks": checks})
+        return max(idx, self.max_index("checks", tx=tx)), out
+
+    # ------------------------------------------------------------------
+    # KV (state/kvs.go, graveyard state/graveyard.go)
+    # ------------------------------------------------------------------
+
+    def kv_set(self, idx: int, entry: dict) -> None:
+        tx = self.db.txn(write=True)
+        self._kv_set_txn(tx, idx, entry)
+        tx.commit()
+
+    def _kv_set_txn(self, tx: MemTxn, idx: int, entry: dict) -> None:
+        existing = tx.get("kvs", _b(entry["key"]))
+        rec = {
+            "key": entry["key"],
+            "value": entry.get("value", b""),
+            "flags": int(entry.get("flags", 0)),
+            "lock_index": existing["lock_index"] if existing else 0,
+            "session": existing.get("session") if existing else None,
+            "create_index": existing["create_index"] if existing else idx,
+            "modify_index": idx,
+        }
+        tx.insert("kvs", rec)
+        self._bump(tx, idx, "kvs")
+
+    def kv_set_cas(self, idx: int, entry: dict, cas_index: int) -> bool:
+        """Check-and-set: write only if modify_index matches (0 = only
+        if absent) (``KVSSetCAS``)."""
+        tx = self.db.txn(write=True)
+        existing = tx.get("kvs", _b(entry["key"]))
+        if cas_index == 0 and existing is not None:
+            tx.abort()
+            return False
+        if cas_index != 0 and (existing is None or existing["modify_index"] != cas_index):
+            tx.abort()
+            return False
+        self._kv_set_txn(tx, idx, entry)
+        tx.commit()
+        return True
+
+    def kv_get(self, key: str, ws: Optional[WatchSet] = None) -> tuple[int, Optional[dict]]:
+        tx = self.db.txn()
+        rec = tx.get("kvs", _b(key), ws=ws)
+        return self.max_index("kvs", "tombstones", tx=tx), rec
+
+    def kv_list(self, prefix: str, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        recs = tx.records("kvs", _b(prefix), ws=ws)
+        if ws is not None:
+            ws.add(self.db.tree("tombstones").watch_prefix(_b(prefix)))
+        idx = self.max_index("kvs", "tombstones", tx=tx)
+        return idx, recs
+
+    def kv_keys(
+        self, prefix: str, separator: str = "", ws: Optional[WatchSet] = None
+    ) -> tuple[int, list[str]]:
+        """Key listing with optional separator roll-up (``KVSListKeys``)."""
+        idx, recs = self.kv_list(prefix, ws)
+        if not separator:
+            return idx, [r["key"] for r in recs]
+        out: list[str] = []
+        for r in recs:
+            key = r["key"]
+            after = key[len(prefix):]
+            sep_at = after.find(separator)
+            if sep_at >= 0:
+                rolled = prefix + after[: sep_at + len(separator)]
+                if not out or out[-1] != rolled:
+                    out.append(rolled)
+            else:
+                out.append(key)
+        return idx, out
+
+    def kv_delete(self, idx: int, key: str) -> bool:
+        tx = self.db.txn(write=True)
+        old = tx.delete("kvs", _b(key))
+        if old is None:
+            tx.abort()
+            return False
+        tx.insert("tombstones", {"key": key, "index": idx})
+        self._bump(tx, idx, "kvs", "tombstones")
+        tx.commit()
+        return True
+
+    def kv_delete_cas(self, idx: int, key: str, cas_index: int) -> bool:
+        tx = self.db.txn(write=True)
+        existing = tx.get("kvs", _b(key))
+        if existing is None or existing["modify_index"] != cas_index:
+            tx.abort()
+            return False
+        tx.delete("kvs", _b(key))
+        tx.insert("tombstones", {"key": key, "index": idx})
+        self._bump(tx, idx, "kvs", "tombstones")
+        tx.commit()
+        return True
+
+    def kv_delete_tree(self, idx: int, prefix: str) -> int:
+        tx = self.db.txn(write=True)
+        doomed = tx.records("kvs", _b(prefix))
+        for rec in doomed:
+            tx.delete("kvs", _b(rec["key"]))
+            tx.insert("tombstones", {"key": rec["key"], "index": idx})
+        if doomed:
+            self._bump(tx, idx, "kvs", "tombstones")
+        tx.commit()
+        return len(doomed)
+
+    def kv_lock(self, idx: int, entry: dict, session_id: str) -> bool:
+        """Acquire: sets session + bumps lock_index if unlocked
+        (``KVSLock``, the Leader-Election primitive)."""
+        tx = self.db.txn(write=True)
+        if tx.get("sessions", _b(session_id)) is None:
+            tx.abort()
+            return False
+        existing = tx.get("kvs", _b(entry["key"]))
+        if existing and existing.get("session"):
+            if existing["session"] != session_id:
+                tx.abort()
+                return False
+            # Re-acquire by the same session: update value, keep lock_index.
+            lock_index = existing["lock_index"]
+        else:
+            lock_index = (existing["lock_index"] if existing else 0) + 1
+        rec = {
+            "key": entry["key"],
+            "value": entry.get("value", b""),
+            "flags": int(entry.get("flags", 0)),
+            "lock_index": lock_index,
+            "session": session_id,
+            "create_index": existing["create_index"] if existing else idx,
+            "modify_index": idx,
+        }
+        tx.insert("kvs", rec)
+        self._bump(tx, idx, "kvs")
+        tx.commit()
+        return True
+
+    def kv_unlock(self, idx: int, entry: dict, session_id: str) -> bool:
+        tx = self.db.txn(write=True)
+        existing = tx.get("kvs", _b(entry["key"]))
+        if existing is None or existing.get("session") != session_id:
+            tx.abort()
+            return False
+        rec = dict(existing)
+        rec.update(
+            value=entry.get("value", b""),
+            flags=int(entry.get("flags", 0)),
+            session=None,
+            modify_index=idx,
+        )
+        tx.insert("kvs", rec)
+        self._bump(tx, idx, "kvs")
+        tx.commit()
+        return True
+
+    def tombstone_reap(self, idx: int, up_to: int) -> int:
+        """Tombstone GC (``state/graveyard.go`` ReapTxn, driven by the
+        leader's tombstone GC loop)."""
+        tx = self.db.txn(write=True)
+        doomed = [r for r in tx.records("tombstones") if r["index"] <= up_to]
+        for r in doomed:
+            tx.delete("tombstones", _b(r["key"]))
+        tx.commit()
+        return len(doomed)
+
+    # ------------------------------------------------------------------
+    # sessions (state/session.go)
+    # ------------------------------------------------------------------
+
+    def session_create(self, idx: int, sess: dict) -> None:
+        tx = self.db.txn(write=True)
+        if tx.get("nodes", _b(sess["node"])) is None:
+            tx.abort()
+            raise ValueError(f"Missing node registration for {sess['node']!r}")
+        checks = list(sess.get("checks", [SERF_CHECK_ID]))
+        for cid in checks:
+            chk = tx.get("checks", _b(sess["node"]) + SEP + _b(cid))
+            if chk is None:
+                tx.abort()
+                raise ValueError(f"Check {cid!r} not registered on node")
+            if chk["status"] == HEALTH_CRITICAL:
+                tx.abort()
+                raise ValueError(f"Check {cid!r} is in critical state")
+        rec = {
+            "id": sess["id"],
+            "name": sess.get("name", ""),
+            "node": sess["node"],
+            "behavior": sess.get("behavior") or SESSION_BEHAVIOR_RELEASE,
+            "ttl": sess.get("ttl", ""),
+            "lock_delay": sess.get("lock_delay", 15.0),
+            "checks": checks,
+            "create_index": idx,
+            "modify_index": idx,
+        }
+        tx.insert("sessions", rec)
+        self._bump(tx, idx, "sessions")
+        tx.commit()
+
+    def session_get(self, sid: str, ws: Optional[WatchSet] = None) -> tuple[int, Optional[dict]]:
+        tx = self.db.txn()
+        return self.max_index("sessions", tx=tx), tx.get("sessions", _b(sid), ws=ws)
+
+    def session_list(self, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return self.max_index("sessions", tx=tx), tx.records("sessions", ws=ws)
+
+    def node_sessions(self, node: str, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("sessions", tx=tx),
+            tx.records("sessions", _b(node) + SEP, index="node", ws=ws),
+        )
+
+    def session_destroy(self, idx: int, sid: str) -> bool:
+        tx = self.db.txn(write=True)
+        sess = tx.get("sessions", _b(sid))
+        if sess is None:
+            tx.abort()
+            return False
+        self._destroy_session_txn(tx, idx, sess)
+        tx.commit()
+        return True
+
+    def _destroy_session_txn(self, tx: MemTxn, idx: int, sess: dict) -> None:
+        """Delete the session and apply its behavior to held locks
+        (``state/session.go`` deleteSessionTxn)."""
+        tx.delete("sessions", _b(sess["id"]))
+        self._bump(tx, idx, "sessions")
+        held = tx.records("kvs", _b(sess["id"]) + SEP, index="session")
+        for rec in held:
+            if sess["behavior"] == SESSION_BEHAVIOR_DELETE:
+                tx.delete("kvs", _b(rec["key"]))
+                tx.insert("tombstones", {"key": rec["key"], "index": idx})
+                self._bump(tx, idx, "kvs", "tombstones")
+            else:  # release
+                new = dict(rec)
+                new["session"] = None
+                new["modify_index"] = idx
+                tx.insert("kvs", new)
+                self._bump(tx, idx, "kvs")
+
+    def _invalidate_sessions_for_check(
+        self, tx: MemTxn, idx: int, node: str, check_id: str
+    ) -> None:
+        for sess in tx.records("sessions", _b(node) + SEP, index="node"):
+            if check_id in sess.get("checks", []):
+                self._destroy_session_txn(tx, idx, sess)
+
+    # ------------------------------------------------------------------
+    # coordinates (state/coordinate.go)
+    # ------------------------------------------------------------------
+
+    def coordinate_batch_update(self, idx: int, updates: list[dict]) -> None:
+        """Apply a CoordinateBatchUpdate raft entry
+        (``fsm/commands_oss.go`` applyCoordinateBatchUpdate): updates for
+        nodes not in the catalog are skipped, not failed."""
+        tx = self.db.txn(write=True)
+        wrote = False
+        for upd in updates:
+            if tx.get("nodes", _b(upd["node"])) is None:
+                continue
+            tx.insert(
+                "coordinates",
+                {
+                    "node": upd["node"],
+                    "segment": upd.get("segment", ""),
+                    "coord": upd["coord"],
+                    "create_index": idx,
+                    "modify_index": idx,
+                },
+            )
+            wrote = True
+        if wrote:
+            self._bump(tx, idx, "coordinates")
+        tx.commit()
+
+    def coordinates(self, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return self.max_index("coordinates", tx=tx), tx.records("coordinates", ws=ws)
+
+    def coordinate(self, node: str, segment: str = "") -> Optional[dict]:
+        rec = self.db.txn().get("coordinates", _b(node) + SEP + _b(segment))
+        return rec["coord"] if rec else None
+
+    # ------------------------------------------------------------------
+    # config entries / prepared queries (state/config_entries.go, prepared_query.go)
+    # ------------------------------------------------------------------
+
+    def config_entry_set(self, idx: int, entry: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("config_entries", _b(entry["kind"]) + SEP + _b(entry["name"]))
+        rec = dict(entry)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("config_entries", rec)
+        self._bump(tx, idx, "config_entries")
+        tx.commit()
+
+    def config_entry_get(
+        self, kind: str, name: str, ws: Optional[WatchSet] = None
+    ) -> tuple[int, Optional[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("config_entries", tx=tx),
+            tx.get("config_entries", _b(kind) + SEP + _b(name), ws=ws),
+        )
+
+    def config_entries_by_kind(
+        self, kind: str, ws: Optional[WatchSet] = None
+    ) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("config_entries", tx=tx),
+            tx.records("config_entries", _b(kind) + SEP, ws=ws),
+        )
+
+    def config_entry_delete(self, idx: int, kind: str, name: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.delete("config_entries", _b(kind) + SEP + _b(name)) is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "config_entries")
+        tx.commit()
+        return True
+
+    def prepared_query_set(self, idx: int, query: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("prepared_queries", _b(query["id"]))
+        rec = dict(query)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("prepared_queries", rec)
+        self._bump(tx, idx, "prepared_queries")
+        tx.commit()
+
+    def prepared_query_get(self, qid: str, ws: Optional[WatchSet] = None) -> tuple[int, Optional[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("prepared_queries", tx=tx),
+            tx.get("prepared_queries", _b(qid), ws=ws),
+        )
+
+    def prepared_query_resolve(self, name_or_id: str) -> Optional[dict]:
+        tx = self.db.txn()
+        rec = tx.get("prepared_queries", _b(name_or_id))
+        if rec:
+            return rec
+        for r in tx.records("prepared_queries"):
+            if r.get("name") == name_or_id:
+                return r
+        return None
+
+    def prepared_query_list(self, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("prepared_queries", tx=tx),
+            tx.records("prepared_queries", ws=ws),
+        )
+
+    def prepared_query_delete(self, idx: int, qid: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.delete("prepared_queries", _b(qid)) is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "prepared_queries")
+        tx.commit()
+        return True
+
+    # ------------------------------------------------------------------
+    # ACL tables (engine lives in consul_tpu.acl)
+    # ------------------------------------------------------------------
+
+    def acl_token_set(self, idx: int, token: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("acl_tokens", _b(token["secret_id"]))
+        rec = dict(token)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("acl_tokens", rec)
+        self._bump(tx, idx, "acl_tokens")
+        tx.commit()
+
+    def acl_token_get(self, secret: str) -> Optional[dict]:
+        return self.db.txn().get("acl_tokens", _b(secret))
+
+    def acl_token_list(self) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return self.max_index("acl_tokens", tx=tx), tx.records("acl_tokens")
+
+    def acl_token_delete(self, idx: int, secret: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.delete("acl_tokens", _b(secret)) is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "acl_tokens")
+        tx.commit()
+        return True
+
+    def acl_policy_set(self, idx: int, policy: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("acl_policies", _b(policy["id"]))
+        rec = dict(policy)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("acl_policies", rec)
+        self._bump(tx, idx, "acl_policies")
+        tx.commit()
+
+    def acl_policy_get(self, pid: str) -> Optional[dict]:
+        return self.db.txn().get("acl_policies", _b(pid))
+
+    def acl_policy_list(self) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return self.max_index("acl_policies", tx=tx), tx.records("acl_policies")
+
+    def acl_policy_delete(self, idx: int, pid: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.delete("acl_policies", _b(pid)) is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "acl_policies")
+        tx.commit()
+        return True
+
+    # ------------------------------------------------------------------
+    # snapshot / restore (fsm/snapshot_oss.go style table dump)
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        tx = self.db.txn()
+        return {
+            "tables": {t: tx.records(t) for t in DUMP_TABLES},
+            "indexes": tx.records("index"),
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.db = MemDB(_schemas())
+        tx = self.db.txn(write=True)
+        for table, recs in snap["tables"].items():
+            for rec in recs:
+                tx.insert(table, rec)
+        for rec in snap.get("indexes", []):
+            tx.insert("index", rec)
+        tx.commit()
+        self.abandon()

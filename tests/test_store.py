@@ -1,0 +1,448 @@
+"""Storage kernel tests: iradix semantics, memdb indexes/watches, and
+the domain StateStore (catalog / KV / sessions / coordinates).
+
+Models the reference's state-store test style (state/catalog_test.go,
+state/kvs_test.go, state/session_test.go): every write is tagged with a
+raft index, reads return (index, data), radix watches fire on writes
+under the watched prefix.
+"""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.store import StateStore, WatchSet
+from consul_tpu.store.iradix import Tree
+from consul_tpu.store.state import (
+    HEALTH_CRITICAL,
+    HEALTH_PASSING,
+    SESSION_BEHAVIOR_DELETE,
+)
+
+
+# ---------------------------------------------------------------------------
+# iradix
+# ---------------------------------------------------------------------------
+
+
+class TestIradix:
+    def test_insert_get_delete(self):
+        t = Tree()
+        txn = t.txn()
+        for k in [b"foo", b"foobar", b"fizz", b"", b"f"]:
+            txn.insert(k, k.decode() or "root")
+        t2 = txn.commit()
+        assert len(t2) == 5
+        assert t2.get(b"foobar") == ("foobar", True)
+        assert t2.get(b"fo") == (None, False)
+        assert t2.get(b"") == ("root", True)
+        # Old tree unchanged (snapshot isolation).
+        assert len(t) == 0
+        txn = t2.txn()
+        old, deleted = txn.delete(b"foo")
+        assert (old, deleted) == ("foo", True)
+        assert txn.delete(b"nope") == (None, False)
+        t3 = txn.commit()
+        assert t3.get(b"foo") == (None, False)
+        assert t3.get(b"foobar") == ("foobar", True)
+        assert t2.get(b"foo") == ("foo", True)
+
+    def test_ordered_iteration_and_prefix(self):
+        t = Tree()
+        txn = t.txn()
+        keys = [b"b", b"a", b"ab", b"abc", b"abd", b"ac", b"b/1", b"b/2"]
+        for k in keys:
+            txn.insert(k, 1)
+        t = txn.commit()
+        assert t.keys() == sorted(keys)
+        assert t.keys(b"ab") == [b"ab", b"abc", b"abd"]
+        assert t.keys(b"b/") == [b"b/1", b"b/2"]
+        assert t.keys(b"zz") == []
+
+    def test_delete_prefix(self):
+        t = Tree()
+        txn = t.txn()
+        for k in [b"a/1", b"a/2", b"a/2/x", b"b/1"]:
+            txn.insert(k, 1)
+        t = txn.commit()
+        txn = t.txn()
+        assert txn.delete_prefix(b"a/") == 3
+        t = txn.commit()
+        assert t.keys() == [b"b/1"]
+
+    def test_fuzz_against_dict(self):
+        import random
+
+        rng = random.Random(42)
+        t = Tree()
+        model: dict[bytes, int] = {}
+        alphabet = b"abc/"
+        for step in range(2000):
+            k = bytes(rng.choice(alphabet) for _ in range(rng.randint(0, 6)))
+            txn = t.txn()
+            if rng.random() < 0.6:
+                txn.insert(k, step)
+                model[k] = step
+            else:
+                _, deleted = txn.delete(k)
+                assert deleted == (k in model)
+                model.pop(k, None)
+            t = txn.commit()
+            assert len(t) == len(model)
+        assert t.keys() == sorted(model)
+        for k, v in model.items():
+            assert t.get(k) == (v, True)
+
+    def test_watch_fires_on_write_below_prefix(self):
+        async def run():
+            t = Tree()
+            txn = t.txn()
+            txn.insert(b"a/1", 1)
+            txn.insert(b"b/1", 1)
+            t = txn.commit()
+            w_a = t.watch_prefix(b"a/")
+            w_b = t.watch_prefix(b"b/")
+            txn = t.txn()
+            txn.insert(b"a/2", 2)
+            txn.commit()
+            assert w_a.is_set()
+            assert not w_b.is_set()
+
+        asyncio.run(run())
+
+    def test_watch_fires_on_key_creation(self):
+        async def run():
+            t = Tree()
+            txn = t.txn()
+            txn.insert(b"foo/bar", 1)
+            t = txn.commit()
+            ev, _, found = t.get_watch(b"foo/baz")
+            assert not found
+            txn = t.txn()
+            txn.insert(b"foo/baz", 2)
+            txn.commit()
+            assert ev.is_set()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# StateStore: catalog
+# ---------------------------------------------------------------------------
+
+
+def _register(store, idx, node="n1", service=None, checks=None, address="10.0.0.1"):
+    req = {"node": node, "address": address}
+    if service:
+        req["service"] = service
+    if checks:
+        req["checks"] = checks
+    store.ensure_registration(idx, req)
+
+
+class TestCatalog:
+    def test_registration_and_queries(self):
+        s = StateStore()
+        _register(
+            s,
+            1,
+            node="n1",
+            service={"id": "web1", "service": "web", "tags": ["v1"], "port": 80},
+            checks=[
+                {
+                    "check_id": "web1-http",
+                    "name": "http",
+                    "status": HEALTH_PASSING,
+                    "service_id": "web1",
+                }
+            ],
+        )
+        _register(
+            s,
+            2,
+            node="n2",
+            address="10.0.0.2",
+            service={"id": "web2", "service": "web", "tags": ["v2"], "port": 81},
+        )
+        idx, nodes = s.nodes()
+        assert idx == 2 and [n["node"] for n in nodes] == ["n1", "n2"]
+        idx, svcs = s.services()
+        assert svcs == {"web": ["v1", "v2"]}
+        idx, inst = s.service_nodes("web")
+        assert len(inst) == 2
+        assert inst[0]["node_address"] == "10.0.0.1"
+        idx, inst = s.service_nodes("web", tag="v2")
+        assert [i["id"] for i in inst] == ["web2"]
+
+    def test_check_service_nodes_passing_filter(self):
+        s = StateStore()
+        _register(
+            s, 1, node="n1",
+            service={"id": "api1", "service": "api"},
+            checks=[{"check_id": "c1", "status": HEALTH_PASSING, "service_id": "api1"}],
+        )
+        _register(
+            s, 2, node="n2",
+            service={"id": "api2", "service": "api"},
+            checks=[{"check_id": "c2", "status": HEALTH_CRITICAL, "service_id": "api2"}],
+        )
+        _, all_nodes = s.check_service_nodes("api")
+        assert len(all_nodes) == 2
+        _, healthy = s.check_service_nodes("api", passing_only=True)
+        assert [h["service"]["id"] for h in healthy] == ["api1"]
+
+    def test_service_nodes_watch_covers_node_changes(self):
+        async def run():
+            s = StateStore()
+            _register(s, 1, node="n1", service={"id": "w1", "service": "web"})
+            ws = WatchSet()
+            s.service_nodes("web", ws=ws)
+            # Node address change alone (services untouched) must wake it.
+            _register(s, 2, node="n1", address="10.9.9.9")
+            assert await ws.wait(timeout=0.5)
+
+        asyncio.run(run())
+
+    def test_idempotent_registration_does_not_bump(self):
+        s = StateStore()
+        _register(s, 1, node="n1", service={"id": "s1", "service": "s"})
+        idx1, _ = s.nodes()
+        _register(s, 5, node="n1", service={"id": "s1", "service": "s"})
+        idx2, _ = s.nodes()
+        assert idx1 == idx2 == 1  # catalog.go ensureNodeTxn idempotency
+
+    def test_delete_node_cascades(self):
+        s = StateStore()
+        _register(
+            s, 1, node="n1",
+            service={"id": "s1", "service": "s"},
+            checks=[{"check_id": "c1", "status": HEALTH_PASSING}],
+        )
+        assert s.delete_node(2, "n1")
+        assert s.nodes()[1] == []
+        assert s.node_services("n1")[1] == []
+        assert s.node_checks("n1")[1] == []
+        assert not s.delete_node(3, "n1")
+
+    def test_checks_in_state_index(self):
+        s = StateStore()
+        _register(s, 1, node="n1", checks=[{"check_id": "c1", "status": HEALTH_PASSING}])
+        _register(s, 2, node="n2", checks=[{"check_id": "c2", "status": HEALTH_CRITICAL}])
+        _, crit = s.checks_in_state(HEALTH_CRITICAL)
+        assert [c["check_id"] for c in crit] == ["c2"]
+
+
+# ---------------------------------------------------------------------------
+# StateStore: KV
+# ---------------------------------------------------------------------------
+
+
+class TestKV:
+    def test_set_get_list_delete(self):
+        s = StateStore()
+        s.kv_set(1, {"key": "foo/bar", "value": b"1"})
+        s.kv_set(2, {"key": "foo/baz", "value": b"2", "flags": 42})
+        idx, rec = s.kv_get("foo/bar")
+        assert idx == 2 and rec["value"] == b"1"
+        assert rec["create_index"] == 1 and rec["modify_index"] == 1
+        idx, recs = s.kv_list("foo/")
+        assert [r["key"] for r in recs] == ["foo/bar", "foo/baz"]
+        assert s.kv_delete(3, "foo/bar")
+        idx, rec = s.kv_get("foo/bar")
+        assert rec is None
+        # Tombstone keeps the prefix index at the delete index.
+        idx, recs = s.kv_list("foo/")
+        assert idx == 3 and len(recs) == 1
+        # Reap tombstones -> index stays (kvs index still 3 via delete bump).
+        assert s.tombstone_reap(4, up_to=3) == 1
+
+    def test_cas(self):
+        s = StateStore()
+        assert s.kv_set_cas(1, {"key": "k", "value": b"a"}, cas_index=0)
+        assert not s.kv_set_cas(2, {"key": "k", "value": b"b"}, cas_index=0)
+        assert not s.kv_set_cas(2, {"key": "k", "value": b"b"}, cas_index=99)
+        assert s.kv_set_cas(2, {"key": "k", "value": b"b"}, cas_index=1)
+        assert s.kv_get("k")[1]["value"] == b"b"
+        assert not s.kv_delete_cas(3, "k", cas_index=1)
+        assert s.kv_delete_cas(3, "k", cas_index=2)
+
+    def test_keys_with_separator(self):
+        s = StateStore()
+        for i, k in enumerate(["a/1", "a/2", "a/sub/x", "b", "c/d/e"]):
+            s.kv_set(i + 1, {"key": k, "value": b""})
+        _, keys = s.kv_keys("", separator="/")
+        assert keys == ["a/", "b", "c/"]
+        _, keys = s.kv_keys("a/", separator="/")
+        assert keys == ["a/1", "a/2", "a/sub/"]
+
+    def test_delete_tree(self):
+        s = StateStore()
+        for i, k in enumerate(["x/1", "x/2", "y/1"]):
+            s.kv_set(i + 1, {"key": k, "value": b""})
+        assert s.kv_delete_tree(4, "x/") == 2
+        _, recs = s.kv_list("")
+        assert [r["key"] for r in recs] == ["y/1"]
+        idx, _ = s.kv_list("x/")
+        assert idx == 4  # tombstones report the delete
+
+    def test_blocking_watch_fires(self):
+        async def run():
+            s = StateStore()
+            s.kv_set(1, {"key": "watch/me", "value": b"a"})
+            ws = WatchSet()
+            s.kv_get("watch/me", ws=ws)
+
+            async def writer():
+                await asyncio.sleep(0.01)
+                s.kv_set(2, {"key": "watch/me", "value": b"b"})
+
+            w = asyncio.create_task(writer())
+            fired = await ws.wait(timeout=1.0)
+            assert fired
+            await w
+            # Unrelated write does not wake a prefix watch elsewhere.
+            ws2 = WatchSet()
+            s.kv_list("watch/", ws=ws2)
+            s.kv_set(3, {"key": "other/key", "value": b""})
+            assert not await ws2.wait(timeout=0.05)
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# StateStore: sessions + locks
+# ---------------------------------------------------------------------------
+
+
+class TestSessions:
+    def _store_with_node(self):
+        s = StateStore()
+        _register(
+            s, 1, node="n1",
+            checks=[{"check_id": "serfHealth", "status": HEALTH_PASSING}],
+        )
+        return s
+
+    def test_create_requires_node_and_healthy_checks(self):
+        s = StateStore()
+        with pytest.raises(ValueError):
+            s.session_create(1, {"id": "s1", "node": "ghost"})
+        s = self._store_with_node()
+        s.session_create(2, {"id": "s1", "node": "n1", "checks": ["serfHealth"]})
+        assert s.session_get("s1")[1]["behavior"] == "release"
+
+    def test_lock_release_behavior(self):
+        s = self._store_with_node()
+        s.session_create(2, {"id": "s1", "node": "n1", "checks": []})
+        assert s.kv_lock(3, {"key": "lock", "value": b"me"}, "s1")
+        rec = s.kv_get("lock")[1]
+        assert rec["session"] == "s1" and rec["lock_index"] == 1
+        # Second session cannot steal.
+        s.session_create(4, {"id": "s2", "node": "n1", "checks": []})
+        assert not s.kv_lock(5, {"key": "lock", "value": b"you"}, "s2")
+        # Destroy releases (behavior=release) and keeps the key.
+        assert s.session_destroy(6, "s1")
+        rec = s.kv_get("lock")[1]
+        assert rec["session"] is None
+        # Now s2 acquires; lock_index increments (KVSLock).
+        assert s.kv_lock(7, {"key": "lock", "value": b"you"}, "s2")
+        assert s.kv_get("lock")[1]["lock_index"] == 2
+
+    def test_delete_behavior_and_check_invalidation(self):
+        s = self._store_with_node()
+        s.session_create(
+            2,
+            {"id": "s1", "node": "n1", "checks": ["serfHealth"],
+             "behavior": SESSION_BEHAVIOR_DELETE},
+        )
+        assert s.kv_lock(3, {"key": "ephemeral", "value": b"x"}, "s1")
+        # serfHealth going critical destroys the session -> key deleted.
+        _register(
+            s, 4, node="n1",
+            checks=[{"check_id": "serfHealth", "status": HEALTH_CRITICAL}],
+        )
+        assert s.session_get("s1")[1] is None
+        assert s.kv_get("ephemeral")[1] is None
+
+    def test_default_serfhealth_check_is_validated(self):
+        s = StateStore()
+        _register(s, 1, node="n1")  # no serfHealth check registered
+        with pytest.raises(ValueError):
+            s.session_create(2, {"id": "s1", "node": "n1"})  # default checks
+        s2 = self._store_with_node()
+        _register(
+            s2, 3, node="n1",
+            checks=[{"check_id": "serfHealth", "status": HEALTH_CRITICAL}],
+        )
+        with pytest.raises(ValueError):
+            s2.session_create(4, {"id": "s1", "node": "n1"})
+
+    def test_delete_service_invalidates_bound_sessions(self):
+        s = self._store_with_node()
+        _register(
+            s, 2, node="n1",
+            service={"id": "web1", "service": "web"},
+            checks=[{"check_id": "c1", "status": HEALTH_PASSING, "service_id": "web1"}],
+        )
+        s.session_create(3, {"id": "s1", "node": "n1", "checks": ["c1"]})
+        assert s.delete_service(4, "n1", "web1")
+        assert s.session_get("s1")[1] is None
+
+    def test_node_delete_destroys_sessions(self):
+        s = self._store_with_node()
+        s.session_create(2, {"id": "s1", "node": "n1", "checks": []})
+        s.delete_node(3, "n1")
+        assert s.session_get("s1")[1] is None
+
+
+# ---------------------------------------------------------------------------
+# StateStore: coordinates, snapshot/restore
+# ---------------------------------------------------------------------------
+
+
+class TestMisc:
+    def test_coordinate_batch_skips_unknown_nodes(self):
+        s = StateStore()
+        _register(s, 1, node="n1")
+        coord = {"vec": [0.0] * 8, "error": 1.5, "height": 1e-5, "adjustment": 0.0}
+        s.coordinate_batch_update(
+            2,
+            [{"node": "n1", "coord": coord}, {"node": "ghost", "coord": coord}],
+        )
+        idx, coords = s.coordinates()
+        assert idx == 2 and [c["node"] for c in coords] == ["n1"]
+        assert s.coordinate("n1") == coord
+        assert s.coordinate("ghost") is None
+
+    def test_snapshot_restore_roundtrip(self):
+        s = StateStore()
+        _register(s, 1, node="n1", service={"id": "w", "service": "web"})
+        s.kv_set(2, {"key": "a", "value": b"1"})
+        s.kv_delete(3, "a")
+        s.kv_set(4, {"key": "b", "value": b"2"})
+        snap = s.snapshot()
+
+        s2 = StateStore()
+        s2.restore(snap)
+        assert s2.nodes() == s.nodes()
+        assert s2.kv_get("b")[1]["value"] == b"2"
+        assert s2.kv_list("")[0] == 4
+        # Tombstone for "a" came along.
+        assert s2.kv_list("a")[0] == 4
+        _, svcs = s2.services()
+        assert svcs == {"web": []}
+
+    def test_config_entries_and_prepared_queries(self):
+        s = StateStore()
+        s.config_entry_set(1, {"kind": "service-defaults", "name": "web", "protocol": "http"})
+        idx, e = s.config_entry_get("service-defaults", "web")
+        assert idx == 1 and e["protocol"] == "http"
+        _, by_kind = s.config_entries_by_kind("service-defaults")
+        assert len(by_kind) == 1
+        assert s.config_entry_delete(2, "service-defaults", "web")
+
+        s.prepared_query_set(3, {"id": "q1", "name": "prod", "service": {"service": "web"}})
+        assert s.prepared_query_resolve("prod")["id"] == "q1"
+        assert s.prepared_query_resolve("q1")["name"] == "prod"
+        assert s.prepared_query_delete(4, "q1")
+        assert s.prepared_query_resolve("prod") is None
