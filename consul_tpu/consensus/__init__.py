@@ -1,0 +1,33 @@
+"""Consensus plane: host-side Raft (election, replication, snapshots).
+
+Kept on host CPUs by design — the consistency plane spans 3-5 server
+nodes (SURVEY.md §2.4: raft is "not TPU-lowered").
+"""
+
+from consul_tpu.consensus.raft import (
+    ENTRY_COMMAND,
+    ENTRY_CONFIG,
+    ENTRY_NOOP,
+    Entry,
+    FSM,
+    InmemRaftNet,
+    NotLeaderError,
+    RaftConfig,
+    RaftNode,
+    RaftTransport,
+    Role,
+)
+
+__all__ = [
+    "Entry",
+    "FSM",
+    "InmemRaftNet",
+    "NotLeaderError",
+    "RaftConfig",
+    "RaftNode",
+    "RaftTransport",
+    "Role",
+    "ENTRY_COMMAND",
+    "ENTRY_NOOP",
+    "ENTRY_CONFIG",
+]

@@ -178,19 +178,31 @@ class Txn:
         self._root = tree.root
         self._size = tree.size
         self._fire: set[asyncio.Event] = set()
+        # Nodes created inside this txn are mutated in place instead of
+        # re-copied on every op (go-iradix writable-node tracking) —
+        # keeps multi-op txns at one copy per node, not one per op.
+        self._writable: set[int] = set()
 
     # -- internals --------------------------------------------------------
     def _track(self, node: Node) -> None:
         if node._watch is not None:
             self._fire.add(node._watch)
 
+    def _new_node(self, prefix: bytes) -> Node:
+        node = Node(prefix)
+        self._writable.add(id(node))
+        return node
+
     def _copy(self, node: Node) -> Node:
+        if id(node) in self._writable:
+            return node
         self._track(node)
         new = Node(node.prefix)
         new.key = node.key
         new.value = node.value
         new.has_leaf = node.has_leaf
         new.edges = list(node.edges)
+        self._writable.add(id(new))
         return new
 
     # -- mutations --------------------------------------------------------
@@ -215,7 +227,7 @@ class Txn:
 
         child = node.get_edge(search[0])
         if child is None:
-            leaf = Node(search)
+            leaf = self._new_node(search)
             leaf.key = key
             leaf.value = value
             leaf.has_leaf = True
@@ -232,13 +244,13 @@ class Txn:
 
         # Split the child at the divergence point.
         self._track(child)
-        split = Node(search[:cp])
+        split = self._new_node(search[:cp])
         mod_child = self._copy(child)
         mod_child.prefix = child.prefix[cp:]
         split.set_edge(mod_child.prefix[0], mod_child)
         rest = search[cp:]
         if rest:
-            leaf = Node(rest)
+            leaf = self._new_node(rest)
             leaf.key = key
             leaf.value = value
             leaf.has_leaf = True
@@ -321,4 +333,5 @@ class Txn:
         for event in self._fire:
             event.set()
         self._fire = set()
+        self._writable = set()  # committed nodes are frozen from here on
         return tree

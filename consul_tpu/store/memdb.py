@@ -86,13 +86,31 @@ class MemDB:
         self.schemas: dict[str, TableSchema] = {s.name: s for s in schemas}
         # (table, index) -> committed Tree; index "" is the primary.
         self._trees: dict[tuple[str, str], Tree] = {}
+        self._write_active = False  # single-writer (go-memdb writer lock)
+        self._active_write: Optional["MemTxn"] = None
         for s in schemas:
             self._trees[(s.name, "id")] = Tree()
             for idx in s.indexes:
                 self._trees[(s.name, idx.name)] = Tree()
 
     def txn(self, write: bool = False) -> "MemTxn":
+        if write:
+            if self._write_active:
+                raise RuntimeError(
+                    "concurrent write transaction (memdb is single-writer)"
+                )
+            self._write_active = True
+            txn = MemTxn(self, True)
+            self._active_write = txn
+            return txn
         return MemTxn(self, write)
+
+    def abort_active(self) -> None:
+        """Abort a write txn abandoned by an exception so the writer
+        lock is never wedged (used by StateStore's write-method guard)."""
+        if self._active_write is not None and not self._active_write._done:
+            self._active_write.abort()
+        self._active_write = None
 
     def tree(self, table: str, index: str = "id") -> Tree:
         return self._trees[(table, index)]
@@ -106,6 +124,10 @@ class MemTxn:
     def __init__(self, db: MemDB, write: bool):
         self._db = db
         self._write = write
+        # Pin the committed roots at txn start: reads within this txn see
+        # one frozen view even if other (sync) commits land while an
+        # async caller holds the txn across awaits.
+        self._roots = dict(db._trees)
         self._staged: dict[tuple[str, str], Any] = {}  # -> iradix.Txn
         self.changes: list[Change] = []
         self._done = False
@@ -116,13 +138,13 @@ class MemTxn:
         if key in self._staged:
             txn = self._staged[key]
             return Tree(txn._root, txn._size)
-        return self._db._trees[key]
+        return self._roots[key]
 
     def _radix_txn(self, table: str, index: str = "id"):
         assert self._write, "read-only txn"
         key = (table, index)
         if key not in self._staged:
-            self._staged[key] = self._db._trees[key].txn()
+            self._staged[key] = self._roots[key].txn()
         return self._staged[key]
 
     @staticmethod
@@ -136,6 +158,19 @@ class MemTxn:
     def insert(self, table: str, rec: dict) -> None:
         schema = self._db.schemas[table]
         pk = schema.primary(rec)
+        # Unique-index violations must fail up front (go-memdb errors on
+        # them; silently overwriting would corrupt the index on delete).
+        for idx in schema.indexes:
+            if not idx.unique:
+                continue
+            new_k = self._sec_key(idx, rec, pk)
+            if new_k is None:
+                continue
+            holder = self._tree(table, idx.name).get(new_k)[0]
+            if holder is not None and schema.primary(holder) != pk:
+                raise ValueError(
+                    f"unique index {table}.{idx.name} violation on {new_k!r}"
+                )
         old, existed = self._radix_txn(table).insert(pk, rec)
         for idx in schema.indexes:
             rtxn = self._radix_txn(table, idx.name)
@@ -215,9 +250,13 @@ class MemTxn:
         self._done = True
         for (table, index), rtxn in self._staged.items():
             self._db._trees[(table, index)] = rtxn.commit()
+        if self._write:
+            self._db._write_active = False
         return self.changes
 
     def abort(self) -> None:
         self._done = True
         self._staged = {}
         self.changes = []
+        if self._write:
+            self._db._write_active = False

@@ -23,8 +23,8 @@ mirroring ``fsm/fsm.go:102``.
 
 from __future__ import annotations
 
-import time
-from typing import Any, Iterable, Optional
+import functools
+from typing import Optional
 
 from consul_tpu.store.memdb import (
     SEP,
@@ -105,6 +105,22 @@ def _schemas() -> list[TableSchema]:
 DUMP_TABLES = [s.name for s in _schemas() if s.name != "index"]
 
 
+def _writer(fn):
+    """Write-method guard: abort any staged txn if the method raises, so
+    a malformed request (e.g. a bad raft command replayed by the FSM)
+    can never wedge the single-writer lock."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        try:
+            return fn(self, *args, **kwargs)
+        except BaseException:
+            self.db.abort_active()
+            raise
+
+    return wrapper
+
+
 class StateStore:
     def __init__(self) -> None:
         self.db = MemDB(_schemas())
@@ -150,6 +166,7 @@ class StateStore:
     # catalog: nodes / services / checks  (state/catalog.go)
     # ------------------------------------------------------------------
 
+    @_writer
     def ensure_registration(self, idx: int, req: dict) -> None:
         """Atomic node+service+check(s) registration
         (``state/catalog.go:274`` EnsureRegistration)."""
@@ -157,7 +174,12 @@ class StateStore:
         self._ensure_node_txn(tx, idx, req)
         if req.get("service"):
             self._ensure_service_txn(tx, idx, req["node"], req["service"])
-        for check in req.get("checks", []) or ([req["check"]] if req.get("check") else []):
+        # Both the singular Check and the Checks list are honored
+        # (EnsureRegistration processes both).
+        checks = list(req.get("checks") or [])
+        if req.get("check"):
+            checks.append(req["check"])
+        for check in checks:
             self._ensure_check_txn(tx, idx, req["node"], check)
         tx.commit()
 
@@ -229,7 +251,8 @@ class StateStore:
         }
         if existing and all(
             existing[k] == rec[k]
-            for k in ("name", "status", "notes", "output", "service_id")
+            for k in ("name", "status", "notes", "output", "service_id",
+                      "service_name")
         ):
             return
         tx.insert("checks", rec)
@@ -239,6 +262,7 @@ class StateStore:
         if rec["status"] == HEALTH_CRITICAL:
             self._invalidate_sessions_for_check(tx, idx, node, cid)
 
+    @_writer
     def delete_node(self, idx: int, node: str) -> bool:
         """Remove a node and everything attached to it
         (``state/catalog.go`` DeleteNode)."""
@@ -262,6 +286,7 @@ class StateStore:
         tx.commit()
         return True
 
+    @_writer
     def delete_service(self, idx: int, node: str, service_id: str) -> bool:
         tx = self.db.txn(write=True)
         old = tx.delete("services", _b(node) + SEP + _b(service_id))
@@ -270,14 +295,19 @@ class StateStore:
             return False
         # Drop the service's checks too (catalog.go deleteServiceTxn),
         # invalidating sessions bound to them like an explicit delete.
+        dropped_checks = False
         for chk in tx.records("checks", _b(node) + SEP):
             if chk.get("service_id") == service_id:
                 tx.delete("checks", _b(node) + SEP + _b(chk["check_id"]))
                 self._invalidate_sessions_for_check(tx, idx, node, chk["check_id"])
-        self._bump(tx, idx, "services", "checks")
+                dropped_checks = True
+        self._bump(tx, idx, "services")
+        if dropped_checks:
+            self._bump(tx, idx, "checks")
         tx.commit()
         return True
 
+    @_writer
     def delete_check(self, idx: int, node: str, check_id: str) -> bool:
         tx = self.db.txn(write=True)
         old = tx.delete("checks", _b(node) + SEP + _b(check_id))
@@ -381,6 +411,7 @@ class StateStore:
     # KV (state/kvs.go, graveyard state/graveyard.go)
     # ------------------------------------------------------------------
 
+    @_writer
     def kv_set(self, idx: int, entry: dict) -> None:
         tx = self.db.txn(write=True)
         self._kv_set_txn(tx, idx, entry)
@@ -400,6 +431,7 @@ class StateStore:
         tx.insert("kvs", rec)
         self._bump(tx, idx, "kvs")
 
+    @_writer
     def kv_set_cas(self, idx: int, entry: dict, cas_index: int) -> bool:
         """Check-and-set: write only if modify_index matches (0 = only
         if absent) (``KVSSetCAS``)."""
@@ -448,6 +480,7 @@ class StateStore:
                 out.append(key)
         return idx, out
 
+    @_writer
     def kv_delete(self, idx: int, key: str) -> bool:
         tx = self.db.txn(write=True)
         old = tx.delete("kvs", _b(key))
@@ -459,6 +492,7 @@ class StateStore:
         tx.commit()
         return True
 
+    @_writer
     def kv_delete_cas(self, idx: int, key: str, cas_index: int) -> bool:
         tx = self.db.txn(write=True)
         existing = tx.get("kvs", _b(key))
@@ -471,6 +505,7 @@ class StateStore:
         tx.commit()
         return True
 
+    @_writer
     def kv_delete_tree(self, idx: int, prefix: str) -> int:
         tx = self.db.txn(write=True)
         doomed = tx.records("kvs", _b(prefix))
@@ -482,6 +517,7 @@ class StateStore:
         tx.commit()
         return len(doomed)
 
+    @_writer
     def kv_lock(self, idx: int, entry: dict, session_id: str) -> bool:
         """Acquire: sets session + bumps lock_index if unlocked
         (``KVSLock``, the Leader-Election primitive)."""
@@ -512,6 +548,7 @@ class StateStore:
         tx.commit()
         return True
 
+    @_writer
     def kv_unlock(self, idx: int, entry: dict, session_id: str) -> bool:
         tx = self.db.txn(write=True)
         existing = tx.get("kvs", _b(entry["key"]))
@@ -530,6 +567,7 @@ class StateStore:
         tx.commit()
         return True
 
+    @_writer
     def tombstone_reap(self, idx: int, up_to: int) -> int:
         """Tombstone GC (``state/graveyard.go`` ReapTxn, driven by the
         leader's tombstone GC loop)."""
@@ -544,6 +582,7 @@ class StateStore:
     # sessions (state/session.go)
     # ------------------------------------------------------------------
 
+    @_writer
     def session_create(self, idx: int, sess: dict) -> None:
         tx = self.db.txn(write=True)
         if tx.get("nodes", _b(sess["node"])) is None:
@@ -588,6 +627,7 @@ class StateStore:
             tx.records("sessions", _b(node) + SEP, index="node", ws=ws),
         )
 
+    @_writer
     def session_destroy(self, idx: int, sid: str) -> bool:
         tx = self.db.txn(write=True)
         sess = tx.get("sessions", _b(sid))
@@ -627,6 +667,7 @@ class StateStore:
     # coordinates (state/coordinate.go)
     # ------------------------------------------------------------------
 
+    @_writer
     def coordinate_batch_update(self, idx: int, updates: list[dict]) -> None:
         """Apply a CoordinateBatchUpdate raft entry
         (``fsm/commands_oss.go`` applyCoordinateBatchUpdate): updates for
@@ -636,13 +677,15 @@ class StateStore:
         for upd in updates:
             if tx.get("nodes", _b(upd["node"])) is None:
                 continue
+            pk = _b(upd["node"]) + SEP + _b(upd.get("segment", ""))
+            existing = tx.get("coordinates", pk)
             tx.insert(
                 "coordinates",
                 {
                     "node": upd["node"],
                     "segment": upd.get("segment", ""),
                     "coord": upd["coord"],
-                    "create_index": idx,
+                    "create_index": existing["create_index"] if existing else idx,
                     "modify_index": idx,
                 },
             )
@@ -663,6 +706,7 @@ class StateStore:
     # config entries / prepared queries (state/config_entries.go, prepared_query.go)
     # ------------------------------------------------------------------
 
+    @_writer
     def config_entry_set(self, idx: int, entry: dict) -> None:
         tx = self.db.txn(write=True)
         existing = tx.get("config_entries", _b(entry["kind"]) + SEP + _b(entry["name"]))
@@ -691,6 +735,7 @@ class StateStore:
             tx.records("config_entries", _b(kind) + SEP, ws=ws),
         )
 
+    @_writer
     def config_entry_delete(self, idx: int, kind: str, name: str) -> bool:
         tx = self.db.txn(write=True)
         if tx.delete("config_entries", _b(kind) + SEP + _b(name)) is None:
@@ -700,6 +745,7 @@ class StateStore:
         tx.commit()
         return True
 
+    @_writer
     def prepared_query_set(self, idx: int, query: dict) -> None:
         tx = self.db.txn(write=True)
         existing = tx.get("prepared_queries", _b(query["id"]))
@@ -734,6 +780,7 @@ class StateStore:
             tx.records("prepared_queries", ws=ws),
         )
 
+    @_writer
     def prepared_query_delete(self, idx: int, qid: str) -> bool:
         tx = self.db.txn(write=True)
         if tx.delete("prepared_queries", _b(qid)) is None:
@@ -747,6 +794,7 @@ class StateStore:
     # ACL tables (engine lives in consul_tpu.acl)
     # ------------------------------------------------------------------
 
+    @_writer
     def acl_token_set(self, idx: int, token: dict) -> None:
         tx = self.db.txn(write=True)
         existing = tx.get("acl_tokens", _b(token["secret_id"]))
@@ -764,6 +812,7 @@ class StateStore:
         tx = self.db.txn()
         return self.max_index("acl_tokens", tx=tx), tx.records("acl_tokens")
 
+    @_writer
     def acl_token_delete(self, idx: int, secret: str) -> bool:
         tx = self.db.txn(write=True)
         if tx.delete("acl_tokens", _b(secret)) is None:
@@ -773,6 +822,7 @@ class StateStore:
         tx.commit()
         return True
 
+    @_writer
     def acl_policy_set(self, idx: int, policy: dict) -> None:
         tx = self.db.txn(write=True)
         existing = tx.get("acl_policies", _b(policy["id"]))
@@ -790,6 +840,7 @@ class StateStore:
         tx = self.db.txn()
         return self.max_index("acl_policies", tx=tx), tx.records("acl_policies")
 
+    @_writer
     def acl_policy_delete(self, idx: int, pid: str) -> bool:
         tx = self.db.txn(write=True)
         if tx.delete("acl_policies", _b(pid)) is None:

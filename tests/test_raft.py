@@ -1,0 +1,266 @@
+"""Raft consensus tests over the in-memory transport.
+
+Mirrors the reference's raft test harness style (raft/testing.go:
+in-proc clusters over InmemTransport, SURVEY.md §4.2): elect, replicate,
+partition, heal, snapshot-install, membership change.
+"""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.consensus.raft import (
+    Entry,
+    FSM,
+    InmemRaftNet,
+    NotLeaderError,
+    RaftConfig,
+    RaftNode,
+)
+
+
+class DictFSM(FSM):
+    """Tiny KV FSM: entries are ("set", k, v); snapshot is the dict."""
+
+    def __init__(self):
+        self.data: dict = {}
+        self.applied: list = []
+
+    def apply(self, entry: Entry):
+        op, k, v = entry.data
+        assert op == "set"
+        self.data[k] = v
+        self.applied.append(entry.index)
+        return ("ok", k, v)
+
+    def snapshot(self):
+        return dict(self.data)
+
+    def restore(self, snap):
+        self.data = dict(snap)
+        self.applied = []
+
+
+def make_cluster(n, net=None, **cfg_kwargs):
+    net = net or InmemRaftNet()
+    ids = [f"s{i}" for i in range(n)]
+    nodes = []
+    for nid in ids:
+        fsm = DictFSM()
+        node = RaftNode(RaftConfig(node_id=nid, **cfg_kwargs), fsm, net, ids)
+        nodes.append(node)
+    return net, nodes
+
+
+async def wait_for_leader(nodes, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        leaders = [n for n in nodes if n.is_leader()]
+        if len(leaders) == 1:
+            followers_agree = all(
+                n.leader_id == leaders[0].id for n in nodes if n is not leaders[0]
+            )
+            if followers_agree:
+                return leaders[0]
+        await asyncio.sleep(0.02)
+    raise AssertionError(
+        f"no stable leader: {[(n.id, n.role, n.leader_id) for n in nodes]}"
+    )
+
+
+async def shutdown_all(nodes):
+    for n in nodes:
+        await n.shutdown()
+    await asyncio.sleep(0)
+
+
+class TestElection:
+    def test_single_node_self_elects_and_applies(self):
+        async def run():
+            net, nodes = make_cluster(1)
+            await nodes[0].start()
+            leader = await wait_for_leader(nodes)
+            res = await leader.apply(("set", "a", 1))
+            assert res == ("ok", "a", 1)
+            assert leader.fsm.data == {"a": 1}
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+    def test_three_node_elects_exactly_one_leader(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            assert sum(n.is_leader() for n in nodes) == 1
+            assert all(n.current_term == leader.current_term for n in nodes)
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+    def test_follower_apply_raises_not_leader_with_hint(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            follower = next(n for n in nodes if not n.is_leader())
+            with pytest.raises(NotLeaderError) as ei:
+                await follower.apply(("set", "x", 1))
+            assert ei.value.leader_id == leader.id
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+
+class TestReplication:
+    def test_writes_replicate_to_all_fsms(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            for i in range(20):
+                await leader.apply(("set", f"k{i}", i))
+            # Followers apply asynchronously on the next heartbeat.
+            await asyncio.sleep(0.3)
+            for n in nodes:
+                assert n.fsm.data == {f"k{i}": i for i in range(20)}
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+    def test_leader_partition_reelects_and_old_leader_steps_down(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            await leader.apply(("set", "before", 1))
+            rest = [n for n in nodes if n is not leader]
+            net.partition({leader.id}, {n.id for n in rest})
+            new_leader = await wait_for_leader(rest)
+            assert new_leader.id != leader.id
+            await new_leader.apply(("set", "after", 2))
+            # Old leader cannot commit in minority.
+            with pytest.raises((NotLeaderError, asyncio.TimeoutError)):
+                await leader.apply(("set", "lost", 3), timeout=0.5)
+            net.heal()
+            await asyncio.sleep(0.6)
+            assert not leader.is_leader() or leader.id == new_leader.id
+            # Everyone converges; the minority write never committed.
+            for n in nodes:
+                assert n.fsm.data.get("after") == 2
+                assert "lost" not in n.fsm.data
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+    def test_divergent_follower_log_is_overwritten(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            follower = next(n for n in nodes if not n.is_leader())
+            # Partition a follower, write on the majority side.
+            net.partition(
+                {follower.id}, {n.id for n in nodes if n is not follower}
+            )
+            for i in range(5):
+                await leader.apply(("set", f"m{i}", i))
+            net.heal()
+            await asyncio.sleep(0.5)
+            assert follower.fsm.data == leader.fsm.data
+            assert follower.last_index() == leader.last_index()
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+
+class TestSnapshot:
+    def test_log_compaction_and_install_snapshot(self):
+        async def run():
+            net, nodes = make_cluster(
+                3, snapshot_threshold=32, snapshot_trailing=8
+            )
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            follower = next(n for n in nodes if not n.is_leader())
+            net.partition(
+                {follower.id}, {n.id for n in nodes if n is not follower}
+            )
+            # Enough writes to trip compaction on the majority side.
+            for i in range(100):
+                await leader.apply(("set", f"k{i}", i))
+            await asyncio.sleep(0.2)
+            assert leader.snapshot_index > 0
+            assert len(leader.log) < 100
+            # Healing forces an InstallSnapshot (follower is behind horizon).
+            net.heal()
+            await asyncio.sleep(1.0)
+            assert follower.fsm.data == leader.fsm.data
+            assert follower.snapshot_index > 0
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+
+class TestMembership:
+    def test_add_voter_catches_up_and_votes(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            await leader.apply(("set", "seed", 1))
+
+            newcomer = RaftNode(
+                RaftConfig(node_id="s9"), DictFSM(), net, voters=["s9"]
+            )
+            newcomer.voters = []  # joins with no vote until config entry
+            await newcomer.start()
+            await leader.add_voter("s9")
+            await asyncio.sleep(0.5)
+            assert "s9" in leader.voters
+            assert newcomer.fsm.data.get("seed") == 1
+            await leader.apply(("set", "post", 2))
+            await asyncio.sleep(0.3)
+            assert newcomer.fsm.data.get("post") == 2
+            await shutdown_all(nodes + [newcomer])
+
+        asyncio.run(run())
+
+    def test_remove_server_shrinks_quorum(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            victim = next(n for n in nodes if not n.is_leader())
+            await leader.remove_server(victim.id)
+            await victim.shutdown()
+            # 2-node cluster still commits (quorum 2 of 2).
+            await leader.apply(("set", "still", 1))
+            assert leader.fsm.data["still"] == 1
+            await shutdown_all(nodes)
+
+        asyncio.run(run())
+
+
+class TestBarrier:
+    def test_barrier_sees_prior_commits(self):
+        async def run():
+            net, nodes = make_cluster(3)
+            for n in nodes:
+                await n.start()
+            leader = await wait_for_leader(nodes)
+            for i in range(5):
+                await leader.apply(("set", f"b{i}", i))
+            await leader.barrier()
+            assert len(leader.fsm.data) == 5
+            await shutdown_all(nodes)
+
+        asyncio.run(run())

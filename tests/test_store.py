@@ -127,6 +127,67 @@ class TestIradix:
 
 
 # ---------------------------------------------------------------------------
+# memdb
+# ---------------------------------------------------------------------------
+
+
+class TestMemDB:
+    def _db(self):
+        from consul_tpu.store import IndexSchema, MemDB, TableSchema
+
+        return MemDB(
+            [
+                TableSchema(
+                    "t",
+                    primary=lambda r: r["id"].encode(),
+                    indexes=(
+                        IndexSchema("u", key=lambda r: r["u"].encode(), unique=True),
+                    ),
+                )
+            ]
+        )
+
+    def test_concurrent_write_txns_rejected(self):
+        db = self._db()
+        a = db.txn(write=True)
+        with pytest.raises(RuntimeError):
+            db.txn(write=True)
+        a.abort()
+        db.txn(write=True).commit()  # lock released after abort
+
+    def test_unique_index_violation_raises(self):
+        db = self._db()
+        tx = db.txn(write=True)
+        tx.insert("t", {"id": "r1", "u": "K"})
+        with pytest.raises(ValueError):
+            tx.insert("t", {"id": "r2", "u": "K"})
+        # Same record updating itself is fine.
+        tx.insert("t", {"id": "r1", "u": "K"})
+        tx.commit()
+
+    def test_writer_lock_survives_failed_write(self):
+        s = StateStore()
+        with pytest.raises(KeyError):
+            s.ensure_registration(1, {"node": "n1", "service": {"id": "x"}})
+        # The abandoned txn must not wedge the single-writer lock.
+        s.kv_set(2, {"key": "ok", "value": b"1"})
+        assert s.kv_get("ok")[1]["value"] == b"1"
+
+    def test_read_txn_pins_roots(self):
+        db = self._db()
+        w = db.txn(write=True)
+        w.insert("t", {"id": "r1", "u": "a"})
+        w.commit()
+        reader = db.txn()
+        w2 = db.txn(write=True)
+        w2.insert("t", {"id": "r2", "u": "b"})
+        w2.commit()
+        # The reader's view is frozen at txn start.
+        assert len(reader.records("t")) == 1
+        assert len(db.txn().records("t")) == 2
+
+
+# ---------------------------------------------------------------------------
 # StateStore: catalog
 # ---------------------------------------------------------------------------
 
@@ -190,6 +251,20 @@ class TestCatalog:
         assert len(all_nodes) == 2
         _, healthy = s.check_service_nodes("api", passing_only=True)
         assert [h["service"]["id"] for h in healthy] == ["api1"]
+
+    def test_singular_check_and_checks_list_both_register(self):
+        s = StateStore()
+        _register(s, 1, node="n1")
+        s.ensure_registration(
+            2,
+            {
+                "node": "n1",
+                "checks": [{"check_id": "c1", "status": HEALTH_PASSING}],
+                "check": {"check_id": "c2", "status": HEALTH_PASSING},
+            },
+        )
+        _, checks = s.node_checks("n1")
+        assert sorted(c["check_id"] for c in checks) == ["c1", "c2"]
 
     def test_service_nodes_watch_covers_node_changes(self):
         async def run():
