@@ -1,0 +1,11 @@
+"""Agent plane: the consistency-plane node logic above the gossip layer.
+
+Equivalent of the reference's ``agent/`` + ``agent/consul/`` packages
+(SURVEY.md §2.2-2.3, layers L3-L6): FSM, RPC plumbing with blocking
+queries, Server (raft quorum member) and Client (RPC-forwarding thin
+agent), and the composition-root Agent with HTTP/DNS front ends.
+"""
+
+from consul_tpu.agent.fsm import ConsulFSM, MessageType
+
+__all__ = ["ConsulFSM", "MessageType"]

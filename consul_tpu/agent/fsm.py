@@ -1,0 +1,225 @@
+"""The replicated state machine: raft log entries → StateStore writes.
+
+Equivalent of the reference's ``agent/consul/fsm`` package: a dispatch
+table from message type to a command handler built at init
+(``fsm/fsm.go:19-120``), the command handlers themselves
+(``fsm/commands_oss.go:13-40``), and whole-store snapshot/restore
+(``fsm/snapshot_oss.go``).
+
+Raft entry payloads are ``{"type": MessageType, "body": {...}}`` dicts
+(the reference encodes the type as the first byte of the msgpack buffer,
+``structs.Encode``); bodies are msgpack-friendly dicts throughout.
+
+A message type OR'd with ``IGNORE_UNKNOWN_FLAG`` (bit 7) is skipped
+without error when this node doesn't understand it — the reference's
+forward-compatibility rule (``structs/structs.go`` IgnoreUnknownTypeFlag).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Any, Callable, Optional
+
+from consul_tpu.consensus.raft import FSM, Entry
+from consul_tpu.store.state import StateStore
+
+log = logging.getLogger("consul_tpu.fsm")
+
+IGNORE_UNKNOWN_FLAG = 128  # structs/structs.go IgnoreUnknownTypeFlag
+
+
+class MessageType(enum.IntEnum):
+    """Raft command types (``agent/structs/structs.go`` MessageType
+    consts; same numbering so snapshots stay comparable)."""
+
+    REGISTER = 0
+    DEREGISTER = 1
+    KVS = 2
+    SESSION = 3
+    ACL = 4  # deprecated legacy ACL path (unused, reserved)
+    TOMBSTONE = 5
+    COORDINATE_BATCH_UPDATE = 6
+    PREPARED_QUERY = 7
+    TXN = 8
+    AUTOPILOT = 9
+    AREA = 10
+    ACL_BOOTSTRAP = 11
+    INTENTION = 12
+    CONNECT_CA = 13
+    ACL_TOKEN_SET = 17
+    ACL_TOKEN_DELETE = 18
+    ACL_POLICY_SET = 19
+    ACL_POLICY_DELETE = 20
+    CONFIG_ENTRY = 22
+    FEDERATION_STATE = 27
+
+
+class ConsulFSM(FSM):
+    """Applies committed raft entries to a :class:`StateStore`.
+
+    The FSM is the ONLY writer to the store on a server, so every read
+    is a consistent snapshot at some raft index (``fsm/fsm.go:102``).
+    """
+
+    def __init__(self, store: Optional[StateStore] = None):
+        self.store = store or StateStore()
+        self._handlers: dict[int, Callable[[int, dict], Any]] = {
+            MessageType.REGISTER: self._apply_register,
+            MessageType.DEREGISTER: self._apply_deregister,
+            MessageType.KVS: self._apply_kvs,
+            MessageType.SESSION: self._apply_session,
+            MessageType.TOMBSTONE: self._apply_tombstone,
+            MessageType.COORDINATE_BATCH_UPDATE: self._apply_coordinates,
+            MessageType.PREPARED_QUERY: self._apply_prepared_query,
+            MessageType.TXN: self._apply_txn,
+            MessageType.AUTOPILOT: self._apply_autopilot,
+            MessageType.ACL_TOKEN_SET: self._apply_acl_token_set,
+            MessageType.ACL_TOKEN_DELETE: self._apply_acl_token_delete,
+            MessageType.ACL_POLICY_SET: self._apply_acl_policy_set,
+            MessageType.ACL_POLICY_DELETE: self._apply_acl_policy_delete,
+            MessageType.CONFIG_ENTRY: self._apply_config_entry,
+        }
+
+    # -- raft.FSM interface -------------------------------------------------
+
+    def apply(self, entry: Entry) -> Any:
+        msg_type = int(entry.data["type"])
+        body = entry.data.get("body", {})
+        handler = self._handlers.get(msg_type & ~IGNORE_UNKNOWN_FLAG)
+        if handler is None:
+            if msg_type & IGNORE_UNKNOWN_FLAG:
+                log.warning("ignoring unknown message type %d", msg_type)
+                return None
+            raise ValueError(f"unknown raft command type {msg_type}")
+        try:
+            return handler(entry.index, body)
+        except (ValueError, KeyError, TypeError) as e:
+            # Domain errors (bad registration, missing session, malformed
+            # body...) are a *result*, not an FSM failure: every replica
+            # deterministically computes the same error and the leader
+            # returns it to the caller (the reference returns the error
+            # as the Apply value).
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def snapshot(self) -> Any:
+        return self.store.snapshot()
+
+    def restore(self, snap: Any) -> None:
+        # The reference builds a NEW state store and abandons the old
+        # one so blocked queries wake and re-run (fsm.go Restore);
+        # StateStore.restore does both.
+        self.store.restore(snap)
+
+    # -- command handlers (fsm/commands_oss.go) -----------------------------
+
+    def _apply_register(self, idx: int, body: dict) -> Any:
+        self.store.ensure_registration(idx, body)
+        return True
+
+    def _apply_deregister(self, idx: int, body: dict) -> Any:
+        # Precedence mirrors applyDeregister: a service or check id
+        # limits the deregistration; otherwise the whole node goes.
+        node = body["node"]
+        if body.get("service_id"):
+            return self.store.delete_service(idx, node, body["service_id"])
+        if body.get("check_id"):
+            return self.store.delete_check(idx, node, body["check_id"])
+        return self.store.delete_node(idx, node)
+
+    def _apply_kvs(self, idx: int, body: dict) -> Any:
+        op = body["op"]
+        entry = body.get("entry") or {}
+        s = self.store
+        if op == "set":
+            s.kv_set(idx, entry)
+            return True
+        if op == "cas":
+            return s.kv_set_cas(idx, entry, int(entry.get("modify_index", 0)))
+        if op == "delete":
+            return s.kv_delete(idx, entry["key"])
+        if op == "delete-cas":
+            return s.kv_delete_cas(idx, entry["key"], int(entry.get("modify_index", 0)))
+        if op == "delete-tree":
+            return s.kv_delete_tree(idx, entry["key"])
+        if op == "lock":
+            return s.kv_lock(idx, entry, entry.get("session") or "")
+        if op == "unlock":
+            return s.kv_unlock(idx, entry, entry.get("session") or "")
+        raise ValueError(f"invalid KVS operation {op!r}")
+
+    def _apply_session(self, idx: int, body: dict) -> Any:
+        op = body["op"]
+        if op == "create":
+            self.store.session_create(idx, body["session"])
+            return body["session"]["id"]
+        if op == "destroy":
+            return self.store.session_destroy(idx, body["session"]["id"])
+        raise ValueError(f"invalid session operation {op!r}")
+
+    def _apply_tombstone(self, idx: int, body: dict) -> Any:
+        if body.get("op") != "reap":
+            raise ValueError(f"invalid tombstone operation {body.get('op')!r}")
+        return self.store.tombstone_reap(idx, int(body["index"]))
+
+    def _apply_coordinates(self, idx: int, body: dict) -> Any:
+        self.store.coordinate_batch_update(idx, body["updates"])
+        return True
+
+    def _apply_prepared_query(self, idx: int, body: dict) -> Any:
+        op = body["op"]
+        if op in ("create", "update"):
+            self.store.prepared_query_set(idx, body["query"])
+            return body["query"]["id"]
+        if op == "delete":
+            return self.store.prepared_query_delete(idx, body["query"]["id"])
+        raise ValueError(f"invalid prepared query operation {op!r}")
+
+    def _apply_txn(self, idx: int, body: dict) -> Any:
+        results, errors = self.store.txn_apply(idx, body["ops"])
+        return {"results": results, "errors": errors}
+
+    def _apply_autopilot(self, idx: int, body: dict) -> Any:
+        # Stored as a config entry of a reserved kind (the reference has
+        # a dedicated autopilot-config table; one-row table ≡ one entry).
+        cfg = dict(body["config"])
+        cfg["kind"] = "autopilot-config"
+        cfg["name"] = "global"
+        if body.get("cas"):
+            existing = self.store.config_entry_get("autopilot-config", "global")[1]
+            have = existing["modify_index"] if existing else 0
+            if have != int(body.get("modify_index", 0)):
+                return False
+        self.store.config_entry_set(idx, cfg)
+        return True
+
+    def _apply_acl_token_set(self, idx: int, body: dict) -> Any:
+        self.store.acl_token_set(idx, body["token"])
+        return True
+
+    def _apply_acl_token_delete(self, idx: int, body: dict) -> Any:
+        return self.store.acl_token_delete(idx, body["secret_id"])
+
+    def _apply_acl_policy_set(self, idx: int, body: dict) -> Any:
+        self.store.acl_policy_set(idx, body["policy"])
+        return True
+
+    def _apply_acl_policy_delete(self, idx: int, body: dict) -> Any:
+        return self.store.acl_policy_delete(idx, body["id"])
+
+    def _apply_config_entry(self, idx: int, body: dict) -> Any:
+        op = body["op"]
+        entry = body.get("entry") or {}
+        if op in ("set", "upsert"):
+            self.store.config_entry_set(idx, entry)
+            return True
+        if op == "cas":
+            existing = self.store.config_entry_get(entry["kind"], entry["name"])[1]
+            have = existing["modify_index"] if existing else 0
+            if have != int(body.get("modify_index", 0)):
+                return False
+            self.store.config_entry_set(idx, entry)
+            return True
+        if op == "delete":
+            return self.store.config_entry_delete(idx, entry["kind"], entry["name"])
+        raise ValueError(f"invalid config entry operation {op!r}")

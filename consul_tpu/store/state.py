@@ -480,55 +480,31 @@ class StateStore:
                 out.append(key)
         return idx, out
 
-    @_writer
-    def kv_delete(self, idx: int, key: str) -> bool:
-        tx = self.db.txn(write=True)
+    def _kv_delete_txn(self, tx: MemTxn, idx: int, key: str) -> bool:
+        """Delete one key, leaving a tombstone (kv_delete core)."""
         old = tx.delete("kvs", _b(key))
         if old is None:
-            tx.abort()
             return False
         tx.insert("tombstones", {"key": key, "index": idx})
         self._bump(tx, idx, "kvs", "tombstones")
-        tx.commit()
         return True
 
-    @_writer
-    def kv_delete_cas(self, idx: int, key: str, cas_index: int) -> bool:
-        tx = self.db.txn(write=True)
-        existing = tx.get("kvs", _b(key))
-        if existing is None or existing["modify_index"] != cas_index:
-            tx.abort()
-            return False
-        tx.delete("kvs", _b(key))
-        tx.insert("tombstones", {"key": key, "index": idx})
-        self._bump(tx, idx, "kvs", "tombstones")
-        tx.commit()
-        return True
-
-    @_writer
-    def kv_delete_tree(self, idx: int, prefix: str) -> int:
-        tx = self.db.txn(write=True)
+    def _kv_delete_tree_txn(self, tx: MemTxn, idx: int, prefix: str) -> int:
         doomed = tx.records("kvs", _b(prefix))
         for rec in doomed:
             tx.delete("kvs", _b(rec["key"]))
             tx.insert("tombstones", {"key": rec["key"], "index": idx})
         if doomed:
             self._bump(tx, idx, "kvs", "tombstones")
-        tx.commit()
         return len(doomed)
 
-    @_writer
-    def kv_lock(self, idx: int, entry: dict, session_id: str) -> bool:
-        """Acquire: sets session + bumps lock_index if unlocked
-        (``KVSLock``, the Leader-Election primitive)."""
-        tx = self.db.txn(write=True)
-        if tx.get("sessions", _b(session_id)) is None:
-            tx.abort()
+    def _kv_lock_txn(self, tx: MemTxn, idx: int, entry: dict, session_id: str) -> bool:
+        """Acquire core shared by kv_lock and the txn 'lock' verb."""
+        if not session_id or tx.get("sessions", _b(session_id)) is None:
             return False
         existing = tx.get("kvs", _b(entry["key"]))
         if existing and existing.get("session"):
             if existing["session"] != session_id:
-                tx.abort()
                 return False
             # Re-acquire by the same session: update value, keep lock_index.
             lock_index = existing["lock_index"]
@@ -545,15 +521,13 @@ class StateStore:
         }
         tx.insert("kvs", rec)
         self._bump(tx, idx, "kvs")
-        tx.commit()
         return True
 
-    @_writer
-    def kv_unlock(self, idx: int, entry: dict, session_id: str) -> bool:
-        tx = self.db.txn(write=True)
+    def _kv_unlock_txn(self, tx: MemTxn, idx: int, entry: dict, session_id: str) -> bool:
+        """Release core shared by kv_unlock and the txn 'unlock' verb:
+        updates value/flags from the entry like the reference's KVSUnlock."""
         existing = tx.get("kvs", _b(entry["key"]))
         if existing is None or existing.get("session") != session_id:
-            tx.abort()
             return False
         rec = dict(existing)
         rec.update(
@@ -564,6 +538,52 @@ class StateStore:
         )
         tx.insert("kvs", rec)
         self._bump(tx, idx, "kvs")
+        return True
+
+    @_writer
+    def kv_delete(self, idx: int, key: str) -> bool:
+        tx = self.db.txn(write=True)
+        if not self._kv_delete_txn(tx, idx, key):
+            tx.abort()
+            return False
+        tx.commit()
+        return True
+
+    @_writer
+    def kv_delete_cas(self, idx: int, key: str, cas_index: int) -> bool:
+        tx = self.db.txn(write=True)
+        existing = tx.get("kvs", _b(key))
+        if existing is None or existing["modify_index"] != cas_index:
+            tx.abort()
+            return False
+        self._kv_delete_txn(tx, idx, key)
+        tx.commit()
+        return True
+
+    @_writer
+    def kv_delete_tree(self, idx: int, prefix: str) -> int:
+        tx = self.db.txn(write=True)
+        n = self._kv_delete_tree_txn(tx, idx, prefix)
+        tx.commit()
+        return n
+
+    @_writer
+    def kv_lock(self, idx: int, entry: dict, session_id: str) -> bool:
+        """Acquire: sets session + bumps lock_index if unlocked
+        (``KVSLock``, the Leader-Election primitive)."""
+        tx = self.db.txn(write=True)
+        if not self._kv_lock_txn(tx, idx, entry, session_id):
+            tx.abort()
+            return False
+        tx.commit()
+        return True
+
+    @_writer
+    def kv_unlock(self, idx: int, entry: dict, session_id: str) -> bool:
+        tx = self.db.txn(write=True)
+        if not self._kv_unlock_txn(tx, idx, entry, session_id):
+            tx.abort()
+            return False
         tx.commit()
         return True
 
@@ -849,6 +869,130 @@ class StateStore:
         self._bump(tx, idx, "acl_policies")
         tx.commit()
         return True
+
+    # ------------------------------------------------------------------
+    # transactions (state/txn.go TxnRW / TxnRO)
+    # ------------------------------------------------------------------
+
+    @_writer
+    def txn_apply(self, idx: int, ops: list[dict]) -> tuple[list[dict], list[dict]]:
+        """Apply a list of operations atomically in ONE write txn
+        (``state/txn.go`` TxnRW → txnDispatch): all-or-nothing; on any
+        error the whole txn aborts and the per-op errors are returned.
+
+        Each op: ``{"kv": {"verb": ..., "entry": {...}}}`` using the KV
+        verbs of ``api/txn.go`` (set, cas, lock, unlock, get, get-tree,
+        check-index, check-session, check-not-exists, delete,
+        delete-tree, delete-cas).
+        """
+        tx = self.db.txn(write=True)
+        results: list[dict] = []
+        errors: list[dict] = []
+        for i, op in enumerate(ops):
+            kv = op.get("kv") if isinstance(op, dict) else None
+            if kv is None:
+                errors.append({"op_index": i, "what": "unknown operation type"})
+                continue
+            try:
+                err = self._txn_kv_op(tx, idx, kv, results)
+            except (KeyError, TypeError) as e:
+                err = f"malformed operation: {e!r}"
+            if err is not None:
+                errors.append({"op_index": i, "what": err})
+        if errors:
+            tx.abort()
+            return [], errors
+        tx.commit()
+        return results, []
+
+    def txn_read(self, ops: list[dict]) -> tuple[list[dict], list[dict]]:
+        """Read-only transaction against the committed snapshot
+        (``state/txn.go`` TxnRO: only get/get-tree/check-* verbs)."""
+        tx = self.db.txn()
+        results: list[dict] = []
+        errors: list[dict] = []
+        ro_verbs = {"get", "get-tree", "check-index", "check-session", "check-not-exists"}
+        for i, op in enumerate(ops):
+            kv = op.get("kv") if isinstance(op, dict) else None
+            if kv is None or kv.get("verb") not in ro_verbs:
+                errors.append({"op_index": i, "what": "not a read-only operation"})
+                continue
+            try:
+                err = self._txn_kv_op(tx, 0, kv, results)
+            except (KeyError, TypeError) as e:
+                err = f"malformed operation: {e!r}"
+            if err is not None:
+                errors.append({"op_index": i, "what": err})
+        return (results, errors) if not errors else ([], errors)
+
+    def _txn_kv_op(
+        self, tx: MemTxn, idx: int, kv: dict, results: list[dict]
+    ) -> Optional[str]:
+        """One KV verb inside a txn; appends to results, returns error
+        string or None (``state/txn.go`` txnKVS)."""
+        verb = kv["verb"]
+        entry = kv.get("entry") or {}
+        key = entry.get("key", "")
+        existing = tx.get("kvs", _b(key)) if key else None
+
+        if verb == "set":
+            self._kv_set_txn(tx, idx, entry)
+            results.append({"kv": tx.get("kvs", _b(key))})
+        elif verb == "cas":
+            cas = int(entry.get("modify_index", 0))
+            if cas == 0 and existing is not None:
+                return f"key {key!r} exists (cas index 0)"
+            if cas != 0 and (existing is None or existing["modify_index"] != cas):
+                return f"cas failed for key {key!r}"
+            self._kv_set_txn(tx, idx, entry)
+            results.append({"kv": tx.get("kvs", _b(key))})
+        elif verb == "lock":
+            sid = entry.get("session") or ""
+            if not self._kv_lock_txn(tx, idx, entry, sid):
+                return f"failed to lock key {key!r} with session {sid!r}"
+            results.append({"kv": tx.get("kvs", _b(key))})
+        elif verb == "unlock":
+            sid = entry.get("session") or ""
+            if not self._kv_unlock_txn(tx, idx, entry, sid):
+                return f"key {key!r} not locked by session {sid!r}"
+            results.append({"kv": tx.get("kvs", _b(key))})
+        elif verb == "get":
+            if existing is None:
+                return f"key {key!r} doesn't exist"
+            results.append({"kv": existing})
+        elif verb == "get-tree":
+            for rec in tx.records("kvs", _b(key)):
+                results.append({"kv": rec})
+        elif verb == "check-index":
+            want = int(entry.get("modify_index", 0))
+            if existing is None:
+                return f"key {key!r} doesn't exist"
+            if existing["modify_index"] != want:
+                return (
+                    f"current modify index ({existing['modify_index']}) "
+                    f"!= {want} for key {key!r}"
+                )
+        elif verb == "check-session":
+            sid = entry.get("session")
+            if existing is None:
+                return f"key {key!r} doesn't exist"
+            if existing.get("session") != sid:
+                return f"key {key!r} not held by session {sid!r}"
+        elif verb == "check-not-exists":
+            if existing is not None:
+                return f"key {key!r} exists"
+        elif verb == "delete":
+            self._kv_delete_txn(tx, idx, key)
+        elif verb == "delete-tree":
+            self._kv_delete_tree_txn(tx, idx, key)
+        elif verb == "delete-cas":
+            cas = int(entry.get("modify_index", 0))
+            if existing is None or existing["modify_index"] != cas:
+                return f"cas delete failed for key {key!r}"
+            self._kv_delete_txn(tx, idx, key)
+        else:
+            return f"unknown KV verb {verb!r}"
+        return None
 
     # ------------------------------------------------------------------
     # snapshot / restore (fsm/snapshot_oss.go style table dump)

@@ -20,3 +20,32 @@ import jax  # noqa: E402
 # interpreter start; this config update (before first backend use) is the
 # override that actually sticks.
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# Minimal async test support (no pytest-asyncio in the image): any
+# coroutine test function runs under asyncio.run with a fresh loop.
+# ---------------------------------------------------------------------------
+
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run test via asyncio.run")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        import asyncio
+
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,237 @@
+"""ConsulFSM: raft entry dispatch into the state store.
+
+Parity model: ``agent/consul/fsm/fsm_test.go`` — apply commands as raft
+entries, read back through the store, snapshot/restore round-trip,
+unknown-type handling (``fsm/fsm.go:102-120``).
+"""
+
+import pytest
+
+from consul_tpu.agent.fsm import IGNORE_UNKNOWN_FLAG, ConsulFSM, MessageType
+from consul_tpu.consensus.raft import ENTRY_COMMAND, Entry
+
+
+def ent(idx, msg_type, body):
+    return Entry(index=idx, term=1, type=ENTRY_COMMAND,
+                 data={"type": int(msg_type), "body": body})
+
+
+@pytest.fixture
+def fsm():
+    return ConsulFSM()
+
+
+def register(fsm, idx=1, node="n1", service=None, checks=None):
+    body = {"node": node, "address": "10.0.0.1"}
+    if service:
+        body["service"] = service
+    if checks:
+        body["checks"] = checks
+    return fsm.apply(ent(idx, MessageType.REGISTER, body))
+
+
+class TestCatalogCommands:
+    def test_register_and_read(self, fsm):
+        register(fsm, 1, service={"service": "web", "port": 80})
+        idx, nodes = fsm.store.nodes()
+        assert idx == 1 and nodes[0]["node"] == "n1"
+        _, svcs = fsm.store.service_nodes("web")
+        assert svcs and svcs[0]["port"] == 80
+
+    def test_deregister_service_only(self, fsm):
+        register(fsm, 1, service={"service": "web"})
+        fsm.apply(ent(2, MessageType.DEREGISTER,
+                      {"node": "n1", "service_id": "web"}))
+        _, svcs = fsm.store.service_nodes("web")
+        assert svcs == []
+        assert fsm.store.node("n1")[1] is not None
+
+    def test_deregister_node(self, fsm):
+        register(fsm, 1)
+        assert fsm.apply(ent(2, MessageType.DEREGISTER, {"node": "n1"})) is True
+        assert fsm.store.node("n1")[1] is None
+
+
+class TestKVSCommands:
+    def test_set_get_delete(self, fsm):
+        fsm.apply(ent(1, MessageType.KVS,
+                      {"op": "set", "entry": {"key": "a/b", "value": b"v"}}))
+        _, rec = fsm.store.kv_get("a/b")
+        assert rec["value"] == b"v" and rec["modify_index"] == 1
+        assert fsm.apply(ent(2, MessageType.KVS,
+                             {"op": "delete", "entry": {"key": "a/b"}})) is True
+        assert fsm.store.kv_get("a/b")[1] is None
+
+    def test_cas_semantics(self, fsm):
+        ok = fsm.apply(ent(1, MessageType.KVS,
+                           {"op": "cas",
+                            "entry": {"key": "k", "value": b"1", "modify_index": 0}}))
+        assert ok is True
+        stale = fsm.apply(ent(2, MessageType.KVS,
+                              {"op": "cas",
+                               "entry": {"key": "k", "value": b"2", "modify_index": 99}}))
+        assert stale is False
+        assert fsm.store.kv_get("k")[1]["value"] == b"1"
+
+    def test_invalid_op_is_domain_error(self, fsm):
+        out = fsm.apply(ent(1, MessageType.KVS, {"op": "bogus", "entry": {}}))
+        assert "error" in out
+
+
+class TestSessionCommands:
+    def test_create_and_destroy(self, fsm):
+        register(fsm, 1, checks=[{"check_id": "serfHealth", "status": "passing"}])
+        sid = fsm.apply(ent(2, MessageType.SESSION,
+                            {"op": "create",
+                             "session": {"id": "s1", "node": "n1"}}))
+        assert sid == "s1"
+        assert fsm.store.session_get("s1")[1] is not None
+        assert fsm.apply(ent(3, MessageType.SESSION,
+                             {"op": "destroy", "session": {"id": "s1"}})) is True
+
+    def test_create_without_node_is_domain_error(self, fsm):
+        out = fsm.apply(ent(1, MessageType.SESSION,
+                            {"op": "create",
+                             "session": {"id": "s1", "node": "ghost"}}))
+        assert "error" in out
+
+    def test_lock_released_on_destroy(self, fsm):
+        register(fsm, 1, checks=[{"check_id": "serfHealth", "status": "passing"}])
+        fsm.apply(ent(2, MessageType.SESSION,
+                      {"op": "create", "session": {"id": "s1", "node": "n1"}}))
+        assert fsm.apply(ent(3, MessageType.KVS,
+                             {"op": "lock",
+                              "entry": {"key": "lead", "value": b"n1",
+                                        "session": "s1"}})) is True
+        fsm.apply(ent(4, MessageType.SESSION,
+                      {"op": "destroy", "session": {"id": "s1"}}))
+        assert fsm.store.kv_get("lead")[1]["session"] is None
+
+
+class TestTxnCommand:
+    def test_atomic_all_or_nothing(self, fsm):
+        out = fsm.apply(ent(1, MessageType.TXN, {"ops": [
+            {"kv": {"verb": "set", "entry": {"key": "x", "value": b"1"}}},
+            {"kv": {"verb": "check-index", "entry": {"key": "ghost",
+                                                     "modify_index": 5}}},
+        ]}))
+        assert out["errors"] and out["results"] == []
+        assert fsm.store.kv_get("x")[1] is None  # rolled back
+
+    def test_malformed_op_is_per_op_error(self, fsm):
+        # Missing verb / missing key must abort cleanly, not crash the FSM
+        # or wedge the store's writer lock.
+        out = fsm.apply(ent(1, MessageType.TXN, {"ops": [
+            {"kv": {"entry": {"value": b"x"}}},
+        ]}))
+        assert out["errors"]
+        # Store still writable after the failed txn.
+        fsm.apply(ent(2, MessageType.KVS,
+                      {"op": "set", "entry": {"key": "ok", "value": b"1"}}))
+        assert fsm.store.kv_get("ok")[1]["value"] == b"1"
+
+    def test_txn_unlock_updates_value_like_kv_unlock(self, fsm):
+        register(fsm, 1, checks=[{"check_id": "serfHealth", "status": "passing"}])
+        fsm.apply(ent(2, MessageType.SESSION,
+                      {"op": "create", "session": {"id": "s1", "node": "n1"}}))
+        out = fsm.apply(ent(3, MessageType.TXN, {"ops": [
+            {"kv": {"verb": "lock",
+                    "entry": {"key": "lead", "value": b"mine", "session": "s1"}}},
+            {"kv": {"verb": "unlock",
+                    "entry": {"key": "lead", "value": b"released", "session": "s1"}}},
+        ]}))
+        assert out["errors"] == []
+        rec = fsm.store.kv_get("lead")[1]
+        assert rec["session"] is None and rec["value"] == b"released"
+
+    def test_txn_empty_delete_tree_keeps_index(self, fsm):
+        fsm.apply(ent(1, MessageType.KVS,
+                      {"op": "set", "entry": {"key": "a", "value": b"1"}}))
+        before = fsm.store.kv_get("a")[0]
+        out = fsm.apply(ent(2, MessageType.TXN, {"ops": [
+            {"kv": {"verb": "delete-tree", "entry": {"key": "nomatch/"}}},
+        ]}))
+        assert out["errors"] == []
+        assert fsm.store.kv_get("a")[0] == before  # no phantom index bump
+
+    def test_commit_and_results(self, fsm):
+        out = fsm.apply(ent(1, MessageType.TXN, {"ops": [
+            {"kv": {"verb": "set", "entry": {"key": "x", "value": b"1"}}},
+            {"kv": {"verb": "get", "entry": {"key": "x"}}},
+        ]}))
+        assert out["errors"] == []
+        assert out["results"][1]["kv"]["value"] == b"1"
+
+
+class TestOtherCommands:
+    def test_coordinate_batch(self, fsm):
+        register(fsm, 1)
+        fsm.apply(ent(2, MessageType.COORDINATE_BATCH_UPDATE, {"updates": [
+            {"node": "n1", "coord": {"vec": [0.0] * 8}},
+            {"node": "ghost", "coord": {"vec": [1.0] * 8}},  # skipped
+        ]}))
+        assert fsm.store.coordinate("n1") is not None
+        assert fsm.store.coordinate("ghost") is None
+
+    def test_prepared_query_lifecycle(self, fsm):
+        fsm.apply(ent(1, MessageType.PREPARED_QUERY,
+                      {"op": "create",
+                       "query": {"id": "q1", "name": "web", "service": {"service": "web"}}}))
+        assert fsm.store.prepared_query_get("q1")[1]["name"] == "web"
+        assert fsm.apply(ent(2, MessageType.PREPARED_QUERY,
+                             {"op": "delete", "query": {"id": "q1"}})) is True
+
+    def test_config_entry_cas(self, fsm):
+        fsm.apply(ent(1, MessageType.CONFIG_ENTRY,
+                      {"op": "set",
+                       "entry": {"kind": "service-defaults", "name": "web",
+                                 "protocol": "http"}}))
+        bad = fsm.apply(ent(2, MessageType.CONFIG_ENTRY,
+                            {"op": "cas", "modify_index": 42,
+                             "entry": {"kind": "service-defaults", "name": "web",
+                                       "protocol": "grpc"}}))
+        assert bad is False
+        good = fsm.apply(ent(3, MessageType.CONFIG_ENTRY,
+                             {"op": "cas", "modify_index": 1,
+                              "entry": {"kind": "service-defaults", "name": "web",
+                                        "protocol": "grpc"}}))
+        assert good is True
+
+    def test_acl_commands(self, fsm):
+        fsm.apply(ent(1, MessageType.ACL_POLICY_SET,
+                      {"policy": {"id": "p1", "name": "ro", "rules": ""}}))
+        fsm.apply(ent(2, MessageType.ACL_TOKEN_SET,
+                      {"token": {"secret_id": "t1", "policies": ["p1"]}}))
+        assert fsm.store.acl_token_get("t1")["policies"] == ["p1"]
+        assert fsm.apply(ent(3, MessageType.ACL_TOKEN_DELETE,
+                             {"secret_id": "t1"})) is True
+
+    def test_tombstone_reap(self, fsm):
+        fsm.apply(ent(1, MessageType.KVS,
+                      {"op": "set", "entry": {"key": "k", "value": b"v"}}))
+        fsm.apply(ent(2, MessageType.KVS, {"op": "delete", "entry": {"key": "k"}}))
+        reaped = fsm.apply(ent(3, MessageType.TOMBSTONE, {"op": "reap", "index": 2}))
+        assert reaped == 1
+
+
+class TestUnknownTypes:
+    def test_unknown_raises(self, fsm):
+        with pytest.raises(ValueError):
+            fsm.apply(ent(1, 99, {}))
+
+    def test_ignore_flag_skips(self, fsm):
+        assert fsm.apply(ent(1, 99 | IGNORE_UNKNOWN_FLAG, {})) is None
+
+
+class TestSnapshotRestore:
+    def test_round_trip(self, fsm):
+        register(fsm, 1, service={"service": "web", "port": 80})
+        fsm.apply(ent(2, MessageType.KVS,
+                      {"op": "set", "entry": {"key": "a", "value": b"1"}}))
+        snap = fsm.snapshot()
+
+        other = ConsulFSM()
+        other.restore(snap)
+        assert other.store.node("n1")[1]["address"] == "10.0.0.1"
+        idx, rec = other.store.kv_get("a")
+        assert rec["value"] == b"1" and idx == 2  # indexes preserved

@@ -1,0 +1,220 @@
+"""RPC plumbing: mux, concurrent muxed calls, errors, blocking queries.
+
+Parity model: the reference's rpc_test.go (conn mux byte routing,
+method-not-found errors) and blockingQuery semantics (rpc.go:759-861).
+"""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.agent.rpc import (
+    QueryOptions,
+    RPC_RAFT,
+    RPCClient,
+    RPCError,
+    RPCServer,
+    blocking_query,
+    snake,
+)
+from consul_tpu.net.transport import InMemoryNetwork
+from consul_tpu.store.state import StateStore
+
+
+class Echo:
+    async def say(self, body):
+        return {"echo": body["msg"]}
+
+    async def slow(self, body):
+        await asyncio.sleep(body["delay"])
+        return {"done": body["delay"]}
+
+    async def boom(self, body):
+        raise ValueError("kaboom")
+
+
+@pytest.fixture
+def net():
+    return InMemoryNetwork()
+
+
+async def start_server(net, name="srv"):
+    t = net.new_transport(name)
+    srv = RPCServer(t)
+    srv.register("Echo", Echo())
+    await srv.start()
+    return srv, t
+
+
+def test_snake_names():
+    assert snake("Apply") == "apply"
+    assert snake("ServiceNodes") == "service_nodes"
+    assert snake("ListKeys") == "list_keys"
+    assert snake("RPCAddr") == "rpc_addr"
+
+
+class TestMuxedRPC:
+    @pytest.mark.asyncio
+    async def test_call_roundtrip(self, net):
+        srv, _ = await start_server(net)
+        client = RPCClient(net.new_transport("cli"))
+        out = await client.call("srv", "Echo.Say", {"msg": "hi"})
+        assert out == {"echo": "hi"}
+        await client.shutdown()
+        await srv.shutdown()
+
+    @pytest.mark.asyncio
+    async def test_concurrent_calls_one_conn(self, net):
+        srv, _ = await start_server(net)
+        client = RPCClient(net.new_transport("cli"))
+        # The slow call is issued first but must not block the fast one:
+        # requests are multiplexed by seq on a single stream.
+        slow = asyncio.create_task(
+            client.call("srv", "Echo.Slow", {"delay": 0.2})
+        )
+        fast = await client.call("srv", "Echo.Say", {"msg": "fast"})
+        assert fast == {"echo": "fast"}
+        assert not slow.done()
+        assert await slow == {"done": 0.2}
+        assert len(client._conns) == 1
+        await client.shutdown()
+        await srv.shutdown()
+
+    @pytest.mark.asyncio
+    async def test_remote_error(self, net):
+        srv, _ = await start_server(net)
+        client = RPCClient(net.new_transport("cli"))
+        with pytest.raises(RPCError, match="kaboom"):
+            await client.call("srv", "Echo.Boom", {})
+        with pytest.raises(RPCError, match="can't find method"):
+            await client.call("srv", "Echo.Nope", {})
+        with pytest.raises(RPCError, match="can't find method"):
+            await client.call("srv", "Ghost.Say", {})
+        await client.shutdown()
+        await srv.shutdown()
+
+    @pytest.mark.asyncio
+    async def test_raft_mux_byte(self, net):
+        srv, _ = await start_server(net)
+        seen = []
+
+        async def raft_handler(method, body):
+            seen.append(method)
+            return {"term": 7}
+
+        srv.bind_raft(raft_handler)
+        raft_client = RPCClient(net.new_transport("peer"), rpc_type=RPC_RAFT)
+        out = await raft_client.call("srv", "AppendEntries", {"term": 7})
+        assert out == {"term": 7} and seen == ["AppendEntries"]
+        await raft_client.shutdown()
+        await srv.shutdown()
+
+    @pytest.mark.asyncio
+    async def test_call_timeout_keeps_connection(self, net):
+        # A timed-out long-poll must not tear down the shared muxed conn
+        # (other in-flight calls keep going).
+        srv, _ = await start_server(net)
+        client = RPCClient(net.new_transport("cli"))
+        inflight = asyncio.create_task(
+            client.call("srv", "Echo.Slow", {"delay": 0.3})
+        )
+        with pytest.raises(asyncio.TimeoutError):
+            await client.call("srv", "Echo.Slow", {"delay": 5}, timeout=0.1)
+        assert await inflight == {"done": 0.3}
+        assert await client.call("srv", "Echo.Say", {"msg": "alive"}) == {
+            "echo": "alive"
+        }
+        await client.shutdown()
+        await srv.shutdown()
+
+    @pytest.mark.asyncio
+    async def test_server_death_fails_pending(self, net):
+        srv, t = await start_server(net)
+        client = RPCClient(net.new_transport("cli"))
+        await client.call("srv", "Echo.Say", {"msg": "warm"})
+        task = asyncio.create_task(
+            client.call("srv", "Echo.Slow", {"delay": 5}, timeout=1.0)
+        )
+        await asyncio.sleep(0.05)
+        await srv.shutdown()
+        await t.shutdown()
+        with pytest.raises((ConnectionError, asyncio.TimeoutError)):
+            await task
+        await client.shutdown()
+
+
+class TestBlockingQuery:
+    @pytest.mark.asyncio
+    async def test_nonblocking_when_index_zero(self):
+        store = StateStore()
+        store.kv_set(3, {"key": "a", "value": b"1"})
+
+        def run(ws):
+            return store.kv_get("a", ws=ws)
+
+        meta, rec = await blocking_query(store, QueryOptions(), run)
+        assert meta.index == 3 and rec["value"] == b"1"
+
+    @pytest.mark.asyncio
+    async def test_write_wakes_blocked_reader(self):
+        store = StateStore()
+        store.kv_set(3, {"key": "a", "value": b"1"})
+
+        def run(ws):
+            return store.kv_get("a", ws=ws)
+
+        async def blocked():
+            return await blocking_query(
+                store, QueryOptions(min_query_index=3, max_query_time=5), run
+            )
+
+        task = asyncio.create_task(blocked())
+        await asyncio.sleep(0.05)
+        assert not task.done()
+        store.kv_set(4, {"key": "a", "value": b"2"})
+        meta, rec = await asyncio.wait_for(task, 2)
+        assert meta.index == 4 and rec["value"] == b"2"
+
+    @pytest.mark.asyncio
+    async def test_timeout_returns_unchanged_index(self):
+        store = StateStore()
+        store.kv_set(3, {"key": "a", "value": b"1"})
+
+        def run(ws):
+            return store.kv_get("a", ws=ws)
+
+        meta, _ = await asyncio.wait_for(
+            blocking_query(
+                store, QueryOptions(min_query_index=3, max_query_time=0.1), run
+            ),
+            2,
+        )
+        assert meta.index == 3
+
+    @pytest.mark.asyncio
+    async def test_index_floor_is_one(self):
+        store = StateStore()
+
+        def run(ws):
+            return store.kv_get("missing", ws=ws)
+
+        meta, rec = await blocking_query(store, QueryOptions(), run)
+        assert meta.index == 1 and rec is None
+
+    @pytest.mark.asyncio
+    async def test_store_abandon_wakes_reader(self):
+        store = StateStore()
+        store.kv_set(3, {"key": "a", "value": b"1"})
+
+        def run(ws):
+            return store.kv_get("a", ws=ws)
+
+        task = asyncio.create_task(
+            blocking_query(
+                store, QueryOptions(min_query_index=3, max_query_time=5), run
+            )
+        )
+        await asyncio.sleep(0.05)
+        store.abandon()  # snapshot restore path
+        meta, _ = await asyncio.wait_for(task, 2)
+        assert meta.index == 3
