@@ -1,0 +1,469 @@
+"""Server RPC endpoint services.
+
+Equivalent of the reference's ``agent/consul/*_endpoint.go`` files,
+registered like ``server_oss.go:8-23``.  Every method takes the msgpack
+request body and returns a msgpack-friendly dict; reads run through
+``blocking_query`` and return ``{"meta": QueryMeta, ...}``; writes
+forward to the leader and apply through raft.
+
+Wire method names match the reference (``KVS.Apply``,
+``Health.ServiceNodes``, ``Catalog.NodeServices`` ...) so a client of
+the reference finds the same RPC surface.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+from consul_tpu.agent.fsm import MessageType
+from consul_tpu.agent.rpc import QueryOptions, blocking_query
+from consul_tpu.store.state import HEALTH_CRITICAL, HEALTH_PASSING
+
+if TYPE_CHECKING:
+    from consul_tpu.agent.server import Server
+
+
+class _Endpoint:
+    def __init__(self, server: "Server"):
+        self.server = server
+
+    async def _read(self, method: str, body: dict, run: Callable):
+        """Common read path: forward unless stale, optional consistency
+        barrier, then blocking query (rpc.go blockingQuery users)."""
+        fwd = await self.server.forward(method, body, read=True)
+        if fwd is not None:
+            return fwd
+        opts = QueryOptions.from_body(body)
+        if opts.require_consistent:
+            await self.server.consistent_barrier()
+        meta, result = await blocking_query(self.server.store, opts, run)
+        out = {"meta": meta.to_body()}
+        out.update(result if isinstance(result, dict) else {})
+        return out
+
+    async def _write(self, method: str, msg_type: MessageType, body: dict):
+        fwd = await self.server.forward(method, body)
+        if fwd is not None:
+            return fwd
+        result = await self.server.raft_apply(msg_type, body)
+        return {"result": result, "index": self.server.store.max_index(
+            *_TABLES_BY_TYPE.get(msg_type, ("index",)))}
+
+
+_TABLES_BY_TYPE = {
+    MessageType.REGISTER: ("nodes", "services", "checks"),
+    MessageType.DEREGISTER: ("nodes", "services", "checks"),
+    MessageType.KVS: ("kvs", "tombstones"),
+    MessageType.SESSION: ("sessions",),
+    MessageType.PREPARED_QUERY: ("prepared_queries",),
+    MessageType.CONFIG_ENTRY: ("config_entries",),
+}
+
+
+class Status(_Endpoint):
+    """status_endpoint.go — cluster metadata, never forwarded."""
+
+    async def ping(self, body: dict) -> bool:
+        return True
+
+    async def leader(self, body: dict) -> dict:
+        return {"leader": self.server.leader_rpc_addr() or ""}
+
+    async def peers(self, body: dict) -> dict:
+        raft = self.server.raft
+        peers = []
+        if raft is not None:
+            for vid in raft.voters:
+                addr = self.server._raft_peer_addr(vid)
+                peers.append({"id": vid, "addr": addr or ""})
+        return {"peers": peers}
+
+
+class Catalog(_Endpoint):
+    """catalog_endpoint.go."""
+
+    async def register(self, body: dict):
+        return await self._write("Catalog.Register", MessageType.REGISTER, body)
+
+    async def deregister(self, body: dict):
+        return await self._write("Catalog.Deregister", MessageType.DEREGISTER, body)
+
+    async def list_nodes(self, body: dict):
+        return await self._read(
+            "Catalog.ListNodes", body,
+            lambda ws: _wrap(self.server.store.nodes(ws), "nodes"),
+        )
+
+    async def list_services(self, body: dict):
+        return await self._read(
+            "Catalog.ListServices", body,
+            lambda ws: _wrap(self.server.store.services(ws), "services"),
+        )
+
+    async def service_nodes(self, body: dict):
+        tag = body.get("tag")
+        return await self._read(
+            "Catalog.ServiceNodes", body,
+            lambda ws: _wrap(
+                self.server.store.service_nodes(body["service"], tag=tag, ws=ws),
+                "nodes",
+            ),
+        )
+
+    async def node_services(self, body: dict):
+        return await self._read(
+            "Catalog.NodeServices", body,
+            lambda ws: _wrap(
+                self.server.store.node_services(body["node"], ws=ws), "services"
+            ),
+        )
+
+
+class Health(_Endpoint):
+    """health_endpoint.go."""
+
+    async def node_checks(self, body: dict):
+        return await self._read(
+            "Health.NodeChecks", body,
+            lambda ws: _wrap(self.server.store.node_checks(body["node"], ws=ws),
+                             "checks"),
+        )
+
+    async def service_checks(self, body: dict):
+        return await self._read(
+            "Health.ServiceChecks", body,
+            lambda ws: _wrap(
+                self.server.store.service_checks(body["service"], ws=ws), "checks"
+            ),
+        )
+
+    async def checks_in_state(self, body: dict):
+        return await self._read(
+            "Health.ChecksInState", body,
+            lambda ws: _wrap(
+                self.server.store.checks_in_state(body["state"], ws=ws), "checks"
+            ),
+        )
+
+    async def service_nodes(self, body: dict):
+        """Nodes + service + checks, optionally only passing instances
+        (health_endpoint.go ServiceNodes w/ PassingOnly)."""
+        passing = bool(body.get("passing_only", body.get("passing", False)))
+        return await self._read(
+            "Health.ServiceNodes", body,
+            lambda ws: _wrap(
+                self.server.store.check_service_nodes(
+                    body["service"], tag=body.get("tag"),
+                    passing_only=passing, ws=ws,
+                ),
+                "nodes",
+            ),
+        )
+
+
+class KVS(_Endpoint):
+    """kvs_endpoint.go."""
+
+    async def apply(self, body: dict):
+        return await self._write("KVS.Apply", MessageType.KVS, body)
+
+    async def get(self, body: dict):
+        def run(ws):
+            idx, rec = self.server.store.kv_get(body["key"], ws=ws)
+            return idx, {"entries": [rec] if rec else []}
+
+        return await self._read("KVS.Get", body, run)
+
+    async def list(self, body: dict):
+        return await self._read(
+            "KVS.List", body,
+            lambda ws: _wrap(self.server.store.kv_list(body["key"], ws=ws),
+                             "entries"),
+        )
+
+    async def list_keys(self, body: dict):
+        return await self._read(
+            "KVS.ListKeys", body,
+            lambda ws: _wrap(
+                self.server.store.kv_keys(
+                    body["key"], body.get("separator", ""), ws=ws
+                ),
+                "keys",
+            ),
+        )
+
+
+class Session(_Endpoint):
+    """session_endpoint.go."""
+
+    async def apply(self, body: dict):
+        op = body.get("op")
+        if op == "create":
+            sess = dict(body.get("session") or {})
+            sess.setdefault("id", str(uuid.uuid4()))
+            body = {"op": "create", "session": sess}
+        out = await self._write("Session.Apply", MessageType.SESSION, body)
+        return out
+
+    async def get(self, body: dict):
+        def run(ws):
+            idx, rec = self.server.store.session_get(body["id"], ws=ws)
+            return idx, {"sessions": [rec] if rec else []}
+
+        return await self._read("Session.Get", body, run)
+
+    async def list(self, body: dict):
+        return await self._read(
+            "Session.List", body,
+            lambda ws: _wrap(self.server.store.session_list(ws=ws), "sessions"),
+        )
+
+    async def node_sessions(self, body: dict):
+        return await self._read(
+            "Session.NodeSessions", body,
+            lambda ws: _wrap(
+                self.server.store.node_sessions(body["node"], ws=ws), "sessions"
+            ),
+        )
+
+    async def renew(self, body: dict):
+        fwd = await self.server.forward("Session.Renew", body)
+        if fwd is not None:
+            return fwd
+        idx, sess = self.server.store.session_get(body["id"])
+        if sess is None:
+            return {"sessions": [], "meta": {"index": idx}}
+        from consul_tpu.agent.server import _parse_ttl
+
+        ttl = _parse_ttl(sess.get("ttl"))
+        if ttl > 0:
+            self.server.renew_session(sess["id"], ttl)
+        return {"sessions": [sess], "meta": {"index": idx}}
+
+
+class Coordinate(_Endpoint):
+    """coordinate_endpoint.go — updates are batched on the leader and
+    flushed as one raft entry per CoordinateUpdatePeriod."""
+
+    async def update(self, body: dict):
+        fwd = await self.server.forward("Coordinate.Update", body)
+        if fwd is not None:
+            return fwd
+        self.server.stage_coordinate_update(
+            body["node"], body.get("segment", ""), body["coord"]
+        )
+        return {"queued": True}
+
+    async def list_nodes(self, body: dict):
+        return await self._read(
+            "Coordinate.ListNodes", body,
+            lambda ws: _wrap(self.server.store.coordinates(ws=ws), "coordinates"),
+        )
+
+    async def node(self, body: dict):
+        def run(ws):
+            idx, _ = self.server.store.coordinates(ws=ws)
+            coord = self.server.store.coordinate(
+                body["node"], body.get("segment", "")
+            )
+            return idx, {"coord": coord}
+
+        return await self._read("Coordinate.Node", body, run)
+
+
+class Txn(_Endpoint):
+    """txn_endpoint.go — read-only op sets skip raft (Txn.Read)."""
+
+    async def apply(self, body: dict):
+        return await self._write("Txn.Apply", MessageType.TXN, body)
+
+    async def read(self, body: dict):
+        fwd = await self.server.forward("Txn.Read", body, read=True)
+        if fwd is not None:
+            return fwd
+        results, errors = self.server.store.txn_read(body["ops"])
+        return {"results": results, "errors": errors}
+
+
+class ConfigEntry(_Endpoint):
+    """config_endpoint.go."""
+
+    async def apply(self, body: dict):
+        return await self._write("ConfigEntry.Apply", MessageType.CONFIG_ENTRY, body)
+
+    async def get(self, body: dict):
+        def run(ws):
+            idx, rec = self.server.store.config_entry_get(
+                body["kind"], body["name"], ws=ws
+            )
+            return idx, {"entry": rec}
+
+        return await self._read("ConfigEntry.Get", body, run)
+
+    async def list(self, body: dict):
+        return await self._read(
+            "ConfigEntry.List", body,
+            lambda ws: _wrap(
+                self.server.store.config_entries_by_kind(body.get("kind"), ws=ws),
+                "entries",
+            ),
+        )
+
+
+class PreparedQuery(_Endpoint):
+    """prepared_query_endpoint.go — execute resolves the query into a
+    health-filtered node list (RTT ordering lands with the coordinate
+    work in consul_tpu.models.vivaldi)."""
+
+    async def apply(self, body: dict):
+        op = body.get("op")
+        if op in ("create", "update"):
+            q = dict(body.get("query") or {})
+            q.setdefault("id", str(uuid.uuid4()))
+            body = {"op": op, "query": q}
+        return await self._write(
+            "PreparedQuery.Apply", MessageType.PREPARED_QUERY, body
+        )
+
+    async def get(self, body: dict):
+        def run(ws):
+            idx, rec = self.server.store.prepared_query_get(body["id"], ws=ws)
+            return idx, {"queries": [rec] if rec else []}
+
+        return await self._read("PreparedQuery.Get", body, run)
+
+    async def list(self, body: dict):
+        return await self._read(
+            "PreparedQuery.List", body,
+            lambda ws: _wrap(self.server.store.prepared_query_list(ws=ws),
+                             "queries"),
+        )
+
+    async def execute(self, body: dict):
+        fwd = await self.server.forward("PreparedQuery.Execute", body, read=True)
+        if fwd is not None:
+            return fwd
+        query = self.server.store.prepared_query_resolve(body["query_id"])
+        if query is None:
+            return {"nodes": [], "service": "", "error": "query not found"}
+        service = query["service"]["service"]
+        idx, rows = self.server.store.check_service_nodes(service)
+        only_passing = bool(query["service"].get("only_passing", False))
+        out = []
+        for r in rows:
+            bad = [c for c in r["checks"] if c["status"] == HEALTH_CRITICAL]
+            if bad:
+                continue
+            if only_passing and any(
+                c["status"] != HEALTH_PASSING for c in r["checks"]
+            ):
+                continue
+            out.append(r)
+        limit = int(query.get("limit", 0) or body.get("limit", 0) or 0)
+        if limit:
+            out = out[:limit]
+        return {"nodes": out, "service": service, "meta": {"index": idx}}
+
+
+class Internal(_Endpoint):
+    """internal_endpoint.go — composite reads used by the UI/agent."""
+
+    async def node_info(self, body: dict):
+        def run(ws):
+            idx1, node = self.server.store.node(body["node"], ws=ws)
+            idx2, svcs = self.server.store.node_services(body["node"], ws=ws)
+            idx3, checks = self.server.store.node_checks(body["node"], ws=ws)
+            return max(idx1, idx2, idx3), {
+                "dump": [] if node is None else [
+                    {"node": node, "services": svcs, "checks": checks}
+                ]
+            }
+
+        return await self._read("Internal.NodeInfo", body, run)
+
+    async def node_dump(self, body: dict):
+        def run(ws):
+            idx, nodes = self.server.store.nodes(ws=ws)
+            # Watch + index across ALL three tables, or a blocking dump
+            # would sleep through service/check-only changes.
+            idx = max(idx, self.server.store.max_index("services", "checks"))
+            dump = []
+            for n in nodes:
+                _, svcs = self.server.store.node_services(n["node"], ws=ws)
+                _, checks = self.server.store.node_checks(n["node"], ws=ws)
+                dump.append({"node": n, "services": svcs, "checks": checks})
+            if ws is not None:
+                self.server.store.table_watch("services", ws)
+                self.server.store.table_watch("checks", ws)
+            return idx, {"dump": dump}
+
+        return await self._read("Internal.NodeDump", body, run)
+
+
+class Operator(_Endpoint):
+    """operator_raft_endpoint.go / operator_autopilot_endpoint.go."""
+
+    async def raft_get_configuration(self, body: dict):
+        raft = self.server.raft
+        servers = []
+        if raft is not None:
+            for vid in raft.voters:
+                servers.append({
+                    "id": vid,
+                    "address": self.server._raft_peer_addr(vid) or "",
+                    "leader": vid == raft.leader_id,
+                    "voter": True,
+                })
+        return {"servers": servers, "index": raft.commit_index if raft else 0}
+
+    async def raft_remove_peer_by_id(self, body: dict):
+        fwd = await self.server.forward("Operator.RaftRemovePeerByID", body)
+        if fwd is not None:
+            return fwd
+        if self.server.raft is None:
+            return {"removed": False}
+        await self.server.raft.remove_server(body["id"])
+        return {"removed": True}
+
+    async def server_health(self, body: dict):
+        """Autopilot-style health summary from serf + raft liveness."""
+        members = self.server._server_members()
+        raft = self.server.raft
+        healthy = [
+            m.name for m in members if m.status.name == "ALIVE"
+        ]
+        return {
+            "healthy": raft is not None and raft.leader_id is not None,
+            "servers": [
+                {
+                    "name": m.name,
+                    "serf_status": m.status.name.lower(),
+                    "voter": raft is not None and m.tags.get("id") in raft.voters,
+                }
+                for m in members
+            ],
+            "failure_tolerance": max(0, (len(healthy) - 1) // 2),
+        }
+
+
+def _wrap(idx_and_data: tuple[int, Any], key: str) -> tuple[int, dict]:
+    idx, data = idx_and_data
+    return idx, {key: data}
+
+
+def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
+    """The registry (server_oss.go:8-23)."""
+    return {
+        "Status": Status(server),
+        "Catalog": Catalog(server),
+        "Health": Health(server),
+        "KVS": KVS(server),
+        "Session": Session(server),
+        "Coordinate": Coordinate(server),
+        "Txn": Txn(server),
+        "ConfigEntry": ConfigEntry(server),
+        "PreparedQuery": PreparedQuery(server),
+        "Internal": Internal(server),
+        "Operator": Operator(server),
+    }

@@ -1,0 +1,545 @@
+"""The Server: raft quorum member owning the replicated catalog.
+
+Equivalent of ``agent/consul/server.go`` + ``leader.go`` (SURVEY.md
+§2.2): owns the raft node, FSM, state store, LAN serf pool, the RPC
+listener, and — when leader — the reconcile/GC/session loops.
+
+Wiring mirrored from the reference:
+
+  serf tags           server_serf.go:35-120 — servers advertise
+                      role/dc/id/expect and their RPC address in serf
+                      node meta; peers discover each other from tags
+  bootstrap           serf_server.go maybeBootstrap — wait until
+                      bootstrap_expect servers are visible, then all
+                      bootstrap the same deterministic voter set
+  raft-over-RPC       server.go raftLayer — raft traffic is stream
+                      type byte 1 on the shared RPC listener
+  leader loop         leader.go:52,153 monitorLeadership/leaderLoop —
+                      reconcile serf membership into the catalog,
+                      add/remove raft peers, tombstone GC, session TTLs
+  reconcile           leader.go:1075-1280 reconcileMember/
+                      handleAliveMember/handleFailedMember/
+                      handleLeftMember
+  coordinate batching coordinate_endpoint.go:48 — Coordinate.Update
+                      RPCs buffered and flushed as one raft entry per
+                      CoordinateUpdatePeriod
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Optional
+
+from consul_tpu.agent import endpoints as eps
+from consul_tpu.agent.fsm import ConsulFSM, MessageType
+from consul_tpu.agent.rpc import (
+    ERR_NO_LEADER,
+    RPC_RAFT,
+    RPCClient,
+    RPCError,
+    RPCServer,
+    RaftRPCAdapter,
+)
+from consul_tpu.consensus.raft import NotLeaderError, RaftConfig, RaftNode
+from consul_tpu.eventing.cluster import (
+    Cluster,
+    ClusterConfig,
+    Event,
+    EventType,
+    Member,
+    MemberStatus,
+)
+from consul_tpu.net.transport import Transport
+from consul_tpu.protocol import LAN, GossipProfile
+from consul_tpu.store.state import (
+    HEALTH_CRITICAL,
+    HEALTH_PASSING,
+    SERF_CHECK_ID,
+)
+
+log = logging.getLogger("consul_tpu.server")
+
+SERF_CHECK_NAME = "Serf Health Status"
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    node_name: str
+    datacenter: str = "dc1"
+    bootstrap_expect: int = 1
+    profile: GossipProfile = LAN
+    gossip_interval_scale: float = 1.0
+    # Leader cadences (leader.go / config.go defaults, scaled down for
+    # in-proc tests the same way the reference's test configs do).
+    reconcile_interval_s: float = 60.0
+    tombstone_ttl_s: float = 15 * 60.0
+    tombstone_granularity_s: float = 30.0
+    coordinate_update_period_s: float = 5.0
+    session_ttl_sweep_s: float = 1.0
+    # Raft timings forwarded to RaftConfig.
+    raft_heartbeat_s: float = 0.05
+    raft_election_min_s: float = 0.15
+    raft_election_max_s: float = 0.30
+
+
+class Server:
+    """One Consul server (``consul.Server``)."""
+
+    def __init__(
+        self,
+        config: ServerConfig,
+        gossip_transport: Transport,
+        rpc_transport: Transport,
+    ):
+        self.config = config
+        self.fsm = ConsulFSM()
+        self.store = self.fsm.store
+
+        # RPC plane (port 8300 analogue; serf rides gossip_transport).
+        self.rpc_transport = rpc_transport
+        self.rpc_server = RPCServer(rpc_transport)
+        self.rpc_client = RPCClient(rpc_transport)
+        self._raft_rpc_client = RPCClient(rpc_transport, rpc_type=RPC_RAFT)
+        self.raft_adapter = RaftRPCAdapter(
+            self._raft_rpc_client, self._raft_peer_addr
+        )
+        self.rpc_server.bind_raft(self.raft_adapter.handle)
+
+        # Gossip plane: LAN serf pool with server tags.
+        self.serf = Cluster(
+            ClusterConfig(
+                name=config.node_name,
+                tags={
+                    "role": "consul",
+                    "dc": config.datacenter,
+                    "id": config.node_name,
+                    "rpc_addr": rpc_transport.local_addr(),
+                    "expect": str(config.bootstrap_expect),
+                },
+                profile=config.profile,
+                interval_scale=config.gossip_interval_scale,
+                on_event=self._on_serf_event,
+            ),
+            gossip_transport,
+        )
+
+        self.raft: Optional[RaftNode] = None
+        self._leader_tasks: list[asyncio.Task] = []
+        self._tasks: list[asyncio.Task] = []
+        self._reconcile_wake = asyncio.Event()
+        self._coord_updates: dict[str, dict] = {}
+        self._session_deadlines: dict[str, float] = {}
+        self._tombstone_marks: list[tuple[float, int]] = []
+        self._shutdown = False
+
+        # RPC endpoint services (server_oss.go:8-23).
+        for name, ep in eps.build_endpoints(self).items():
+            self.rpc_server.register(name, ep)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.rpc_server.start()
+        await self.serf.start()
+        self._tasks.append(asyncio.create_task(self._serf_event_pump()))
+        self._maybe_bootstrap()
+
+    async def join(self, addrs: list[str]) -> int:
+        return await self.serf.join(addrs)
+
+    async def leave(self) -> None:
+        # Graceful departure (server.go Leave): demote ourselves from
+        # raft if possible, then leave serf.
+        if self.raft and self.raft.is_leader() and len(self.raft.voters) > 1:
+            try:
+                await self.raft.remove_server(self.node_id)
+            except Exception:  # noqa: BLE001 - best effort on the way out
+                pass
+        await self.serf.leave()
+
+    async def shutdown(self) -> None:
+        self._shutdown = True
+        for t in self._tasks + self._leader_tasks:
+            t.cancel()
+        if self.raft:
+            await self.raft.shutdown()
+        await self.serf.shutdown()
+        await self.rpc_client.shutdown()
+        await self._raft_rpc_client.shutdown()
+        await self.rpc_server.shutdown()
+
+    @property
+    def node_id(self) -> str:
+        return self.config.node_name
+
+    def is_leader(self) -> bool:
+        return self.raft is not None and self.raft.is_leader()
+
+    # ------------------------------------------------------------------
+    # bootstrap & raft peer discovery (server_serf.go maybeBootstrap)
+    # ------------------------------------------------------------------
+
+    def _server_members(self) -> list[Member]:
+        return [
+            m
+            for m in self.serf.members.values()
+            if m.tags.get("role") == "consul"
+            and m.tags.get("dc") == self.config.datacenter
+        ]
+
+    def _raft_peer_addr(self, node_id: str) -> Optional[str]:
+        for m in self._server_members():
+            if m.tags.get("id") == node_id:
+                return m.tags.get("rpc_addr")
+        return None
+
+    def _maybe_bootstrap(self) -> None:
+        if self.raft is not None:
+            return
+        expect = self.config.bootstrap_expect
+        servers = [
+            m for m in self._server_members() if m.status == MemberStatus.ALIVE
+        ]
+        if len(servers) < expect:
+            return
+        # Initial voter set = every server visible when the expect
+        # threshold is crossed (maybeBootstrap attempts a config with
+        # all discovered servers); sorted so simultaneous bootstrappers
+        # compute identical logs.  Servers joining later are added by
+        # the leader's reconcile (handleAliveMember → add_voter).
+        voters = sorted(m.tags["id"] for m in servers)
+        if self.node_id not in voters:
+            voters.append(self.node_id)
+        self.raft = RaftNode(
+            RaftConfig(
+                node_id=self.node_id,
+                heartbeat_interval=self.config.raft_heartbeat_s,
+                election_timeout_min=self.config.raft_election_min_s,
+                election_timeout_max=self.config.raft_election_max_s,
+            ),
+            self.fsm,
+            self.raft_adapter,
+            sorted(voters),
+        )
+        self.raft.leadership_listeners.append(self._on_leadership)
+        task = asyncio.create_task(self.raft.start())
+        self._tasks.append(task)
+        log.info("%s: raft bootstrapped with voters %s", self.node_id, voters)
+
+    # ------------------------------------------------------------------
+    # RPC helpers used by endpoints
+    # ------------------------------------------------------------------
+
+    def leader_rpc_addr(self) -> Optional[str]:
+        if self.raft is None or self.raft.leader_id is None:
+            return None
+        return self._raft_peer_addr(self.raft.leader_id)
+
+    async def forward(
+        self, method: str, body: dict, *, read: bool = False
+    ) -> Optional[dict]:
+        """Forward to the leader unless we are it (rpc.go:577-614).
+
+        Returns None when the caller should execute locally, else the
+        leader's response.  Only *reads* honor allow_stale — a write
+        carrying a recycled query-options dict must still reach the
+        leader (the reference's forward() checks info.IsRead()).
+        """
+        if read and body.get("allow_stale"):
+            return None
+        if self.raft is not None and self.raft.is_leader():
+            return None
+        addr = self.leader_rpc_addr()
+        if addr is None:
+            raise RPCError(ERR_NO_LEADER)
+        return await self.rpc_client.call(addr, method, body)
+
+    async def raft_apply(self, msg_type: MessageType, body: dict):
+        """Apply a command through raft (rpc.go:679 raftApply)."""
+        if self.raft is None:
+            raise RPCError(ERR_NO_LEADER)
+        try:
+            result = await self.raft.apply({"type": int(msg_type), "body": body})
+        except NotLeaderError as e:
+            raise RPCError(ERR_NO_LEADER) from e
+        if isinstance(result, dict) and "error" in result and len(result) == 1:
+            raise RPCError(result["error"])
+        return result
+
+    async def consistent_barrier(self) -> None:
+        """Leader linearizability fence for require_consistent reads
+        (the reference's VerifyLeader in blockingQuery preamble)."""
+        if self.raft is None:
+            raise RPCError(ERR_NO_LEADER)
+        try:
+            await self.raft.barrier()
+        except NotLeaderError as e:
+            raise RPCError(ERR_NO_LEADER) from e
+
+    # ------------------------------------------------------------------
+    # serf event plumbing
+    # ------------------------------------------------------------------
+
+    def _on_serf_event(self, event: Event) -> None:
+        if event.type in (
+            EventType.MEMBER_JOIN,
+            EventType.MEMBER_FAILED,
+            EventType.MEMBER_LEAVE,
+            EventType.MEMBER_REAP,
+            EventType.MEMBER_UPDATE,
+        ):
+            self._reconcile_wake.set()
+
+    async def _serf_event_pump(self) -> None:
+        """Server-side event loop (server_serf.go lanEventHandler):
+        membership changes trigger bootstrap checks and reconcile."""
+        while not self._shutdown:
+            await self.serf.events.get()
+            self._maybe_bootstrap()
+            self._reconcile_wake.set()
+
+    # ------------------------------------------------------------------
+    # leader loops (leader.go)
+    # ------------------------------------------------------------------
+
+    def _on_leadership(self, leader: bool) -> None:
+        if leader:
+            self._leader_tasks = [
+                asyncio.create_task(self._reconcile_loop()),
+                asyncio.create_task(self._tombstone_gc_loop()),
+                asyncio.create_task(self._session_ttl_loop()),
+                asyncio.create_task(self._coordinate_flush_loop()),
+            ]
+            self._reconcile_wake.set()
+        else:
+            for t in self._leader_tasks:
+                t.cancel()
+            self._leader_tasks = []
+            self._session_deadlines.clear()
+
+    async def _reconcile_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(
+                    self._reconcile_wake.wait(),
+                    timeout=self.config.reconcile_interval_s,
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._reconcile_wake.clear()
+            try:
+                await self._reconcile()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — a leader loop must
+                # survive transient apply timeouts / malformed tags; it
+                # retries on the next tick (leader.go leaderLoop).
+                log.warning("%s: reconcile failed: %s", self.node_id, e)
+
+    async def _reconcile(self) -> None:
+        """Fold serf membership into the catalog and the raft config
+        (leader.go:1075-1280).  The gossip plane is the source of truth
+        for node liveness; the catalog follows it."""
+        _, catalog_nodes = self.store.nodes()
+        known = {n["node"] for n in catalog_nodes}
+
+        for m in list(self.serf.members.values()):
+            if m.status == MemberStatus.ALIVE:
+                await self._handle_alive_member(m)
+            elif m.status == MemberStatus.FAILED:
+                await self._handle_failed_member(m)
+            elif m.status == MemberStatus.LEFT:
+                await self._handle_left_member(m)
+            known.discard(m.name)
+
+        # reconcileReaped: catalog nodes with a serfHealth check that
+        # serf no longer knows at all are deregistered.
+        for name in known:
+            _, checks = self.store.node_checks(name)
+            if any(c["check_id"] == SERF_CHECK_ID for c in checks):
+                await self.raft_apply(MessageType.DEREGISTER, {"node": name})
+
+    def _member_needs_update(self, m: Member, status: str) -> bool:
+        _, node = self.store.node(m.name)
+        if node is None or node.get("address") != m.addr:
+            return True
+        _, checks = self.store.node_checks(m.name)
+        serf_check = next(
+            (c for c in checks if c["check_id"] == SERF_CHECK_ID), None
+        )
+        return serf_check is None or serf_check["status"] != status
+
+    def _is_peer_server(self, m: Member) -> bool:
+        """Server of OUR datacenter (voter changes must never cross
+        DCs — _server_members applies the same filter)."""
+        return (
+            m.tags.get("role") == "consul"
+            and m.tags.get("dc") == self.config.datacenter
+            and bool(m.tags.get("id"))
+        )
+
+    async def _handle_alive_member(self, m: Member) -> None:
+        if self._is_peer_server(m) and self.raft is not None:
+            if m.tags["id"] not in self.raft.voters:
+                await self.raft.add_voter(m.tags["id"])
+        if not self._member_needs_update(m, HEALTH_PASSING):
+            return
+        await self.raft_apply(
+            MessageType.REGISTER,
+            {
+                "node": m.name,
+                "address": m.addr,
+                "node_meta": {"serf": "1"},
+                "check": {
+                    "check_id": SERF_CHECK_ID,
+                    "name": SERF_CHECK_NAME,
+                    "status": HEALTH_PASSING,
+                    "output": "Agent alive and reachable",
+                },
+            },
+        )
+
+    async def _handle_failed_member(self, m: Member) -> None:
+        if not self._member_needs_update(m, HEALTH_CRITICAL):
+            return
+        await self.raft_apply(
+            MessageType.REGISTER,
+            {
+                "node": m.name,
+                "address": m.addr,
+                "check": {
+                    "check_id": SERF_CHECK_ID,
+                    "name": SERF_CHECK_NAME,
+                    "status": HEALTH_CRITICAL,
+                    "output": "Agent not live or unreachable",
+                },
+            },
+        )
+
+    async def _handle_left_member(self, m: Member) -> None:
+        if m.name == self.node_id:
+            return  # never deregister ourselves (leader.go:1217)
+        if self._is_peer_server(m) and self.raft is not None:
+            if m.tags["id"] in self.raft.voters:
+                await self.raft.remove_server(m.tags["id"])
+        _, node = self.store.node(m.name)
+        if node is not None:
+            await self.raft_apply(MessageType.DEREGISTER, {"node": m.name})
+
+    async def _tombstone_gc_loop(self) -> None:
+        """Time-based tombstone reaping (leader.go:292 + tombstone GC):
+        the leader snapshots (now, kv index) marks and raft-applies a
+        reap for the index recorded tombstone_ttl ago."""
+        while True:
+            await asyncio.sleep(self.config.tombstone_granularity_s)
+            now = time.monotonic()
+            self._tombstone_marks.append((now, self.store.max_index("kvs", "tombstones")))
+            cutoff_idx = 0
+            keep: list[tuple[float, int]] = []
+            for ts, idx in self._tombstone_marks:
+                if now - ts >= self.config.tombstone_ttl_s:
+                    cutoff_idx = max(cutoff_idx, idx)
+                else:
+                    keep.append((ts, idx))
+            self._tombstone_marks = keep
+            if cutoff_idx:
+                try:
+                    await self.raft_apply(
+                        MessageType.TOMBSTONE, {"op": "reap", "index": cutoff_idx}
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — retry next tick
+                    log.warning("%s: tombstone reap failed: %s", self.node_id, e)
+                    self._tombstone_marks.append((0.0, cutoff_idx))
+
+    async def _session_ttl_loop(self) -> None:
+        """Invalidate sessions whose TTL lapsed without renewal
+        (session_ttl.go: timers at 2x TTL on the leader)."""
+        while True:
+            await asyncio.sleep(self.config.session_ttl_sweep_s)
+            now = time.monotonic()
+            _, sessions = self.store.session_list()
+            live = set()
+            for sess in sessions:
+                ttl = _parse_ttl(sess.get("ttl"))
+                if ttl <= 0:
+                    continue
+                sid = sess["id"]
+                live.add(sid)
+                deadline = self._session_deadlines.setdefault(sid, now + 2 * ttl)
+                if now >= deadline:
+                    try:
+                        await self.raft_apply(
+                            MessageType.SESSION,
+                            {"op": "destroy", "session": {"id": sid}},
+                        )
+                        self._session_deadlines.pop(sid, None)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — retry next sweep
+                        log.warning(
+                            "%s: session %s invalidation failed: %s",
+                            self.node_id, sid, e,
+                        )
+            for sid in list(self._session_deadlines):
+                if sid not in live:
+                    del self._session_deadlines[sid]
+
+    def renew_session(self, sid: str, ttl: float) -> None:
+        self._session_deadlines[sid] = time.monotonic() + 2 * ttl
+
+    # -- coordinates ---------------------------------------------------
+
+    def stage_coordinate_update(self, node: str, segment: str, coord: dict) -> None:
+        self._coord_updates[f"{node}\x00{segment}"] = {
+            "node": node,
+            "segment": segment,
+            "coord": coord,
+        }
+
+    async def _coordinate_flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.coordinate_update_period_s)
+            if not self._coord_updates:
+                continue
+            updates = list(self._coord_updates.values())
+            self._coord_updates.clear()
+            try:
+                await self.raft_apply(
+                    MessageType.COORDINATE_BATCH_UPDATE, {"updates": updates}
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — restage for next flush
+                log.warning("%s: coordinate flush failed: %s", self.node_id, e)
+                for u in updates:
+                    self._coord_updates.setdefault(
+                        f"{u['node']}\x00{u['segment']}", u
+                    )
+
+
+def _parse_ttl(ttl) -> float:
+    """'10s' / '1m' / numeric seconds → seconds (api session TTL)."""
+    if ttl in (None, ""):
+        return 0.0
+    if isinstance(ttl, (int, float)):
+        return float(ttl)
+    s = str(ttl)
+    try:
+        if s.endswith("ms"):
+            return float(s[:-2]) / 1000.0
+        if s.endswith("s"):
+            return float(s[:-1])
+        if s.endswith("m"):
+            return float(s[:-1]) * 60.0
+        if s.endswith("h"):
+            return float(s[:-1]) * 3600.0
+        return float(s)
+    except ValueError:
+        return 0.0

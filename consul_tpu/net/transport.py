@@ -71,6 +71,9 @@ class Stream(abc.ABC):
 # ----------------------------------------------------------------------
 
 
+_EOF = object()  # close sentinel on the queue
+
+
 class _QueueStream(Stream):
     def __init__(self):
         self._a_to_b: asyncio.Queue = asyncio.Queue()
@@ -89,12 +92,28 @@ class _QueueStream(Stream):
         await self._a_to_b.put(payload)
 
     async def recv(self, timeout: Optional[float] = None) -> bytes:
+        if self.closed:
+            raise ConnectionError("stream closed")
         if timeout is None:
-            return await self._b_to_a.get()
-        return await asyncio.wait_for(self._b_to_a.get(), timeout)
+            frame = await self._b_to_a.get()
+        else:
+            frame = await asyncio.wait_for(self._b_to_a.get(), timeout)
+        if frame is _EOF:
+            # Like a TCP FIN: the peer closed; wake any other blocked
+            # reader too, then surface the failure.
+            self.closed = True
+            self._b_to_a.put_nowait(_EOF)
+            raise ConnectionError("stream closed by peer")
+        return frame
 
     async def close(self) -> None:
+        if self.closed:
+            return
         self.closed = True
+        # Notify the peer's (possibly blocked) recv.
+        self._a_to_b.put_nowait(_EOF)
+        # And our own, in case another task is blocked on it.
+        self._b_to_a.put_nowait(_EOF)
 
 
 class InMemoryNetwork:

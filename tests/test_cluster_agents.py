@@ -1,0 +1,297 @@
+"""Full in-process server/client clusters over the in-memory network.
+
+Parity model: ``agent/consul/cluster_test.go`` + ``client_test.go`` —
+spin N servers, join LAN, wait for leader, drive RPCs through a client,
+kill the leader, watch failover (SURVEY.md §4.3).
+"""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.agent.client import Client, ClientConfig
+from consul_tpu.agent.server import Server, ServerConfig
+from consul_tpu.net.transport import InMemoryNetwork
+from consul_tpu.protocol import LAN
+
+
+def make_server(net, name, expect=3, **kw):
+    cfg = ServerConfig(
+        node_name=name,
+        bootstrap_expect=expect,
+        gossip_interval_scale=0.05,  # fast protocol for tests
+        reconcile_interval_s=0.2,
+        coordinate_update_period_s=0.1,
+        session_ttl_sweep_s=0.1,
+        **kw,
+    )
+    return Server(
+        cfg,
+        gossip_transport=net.new_transport(f"{name}:gossip"),
+        rpc_transport=net.new_transport(f"{name}:rpc"),
+    )
+
+
+def make_client(net, name):
+    cfg = ClientConfig(node_name=name, gossip_interval_scale=0.05)
+    return Client(
+        cfg,
+        gossip_transport=net.new_transport(f"{name}:gossip"),
+        rpc_transport=net.new_transport(f"{name}:rpc"),
+    )
+
+
+async def start_cluster(net, n=3):
+    servers = [make_server(net, f"s{i}", expect=n) for i in range(n)]
+    for s in servers:
+        await s.start()
+    for s in servers[1:]:
+        await s.join(["s0:gossip"])
+    await wait_for_leader(servers)
+    return servers
+
+
+async def wait_for_leader(servers, timeout=10.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        leaders = [s for s in servers if s.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"no leader: {[(s.node_id, s.raft and s.raft.role) for s in servers]}"
+    )
+
+
+async def shutdown_all(*nodes):
+    for n in nodes:
+        await n.shutdown()
+    await asyncio.sleep(0)
+
+
+async def wait_until(pred, timeout=5.0, msg="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        r = pred()
+        if asyncio.iscoroutine(r):
+            r = await r
+        if r:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestServerCluster:
+    async def test_three_servers_elect_and_replicate(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+
+        out = await leader.rpc_client.call(
+            f"{leader.node_id}:rpc", "KVS.Apply",
+            {"op": "set", "entry": {"key": "a", "value": b"1"}},
+        )
+        assert out["result"] is True
+
+        # Replicated to every server's store (follower stale read).
+        await wait_until(
+            lambda: all(
+                s.store.kv_get("a")[1] is not None for s in servers
+            ),
+            msg="kv replicated to all followers",
+        )
+        await shutdown_all(*servers)
+
+    async def test_follower_forwards_write_to_leader(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        follower = next(s for s in servers if not s.is_leader())
+
+        out = await follower.rpc_client.call(
+            f"{follower.node_id}:rpc", "KVS.Apply",
+            {"op": "set", "entry": {"key": "fwd", "value": b"x"}},
+        )
+        assert out["result"] is True
+        leader = next(s for s in servers if s.is_leader())
+        assert leader.store.kv_get("fwd")[1]["value"] == b"x"
+        await shutdown_all(*servers)
+
+    async def test_serf_membership_reconciled_into_catalog(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+
+        await wait_until(
+            lambda: len(leader.store.nodes()[1]) == 3,
+            msg="all serf members registered in catalog",
+        )
+        _, checks = leader.store.node_checks("s1")
+        assert checks and checks[0]["check_id"] == "serfHealth"
+        assert checks[0]["status"] == "passing"
+        await shutdown_all(*servers)
+
+    async def test_leader_failover(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+        rest = [s for s in servers if s is not leader]
+
+        await leader.shutdown()
+        new_leader = await wait_for_leader(rest, timeout=10)
+        out = await new_leader.rpc_client.call(
+            f"{new_leader.node_id}:rpc", "KVS.Apply",
+            {"op": "set", "entry": {"key": "post-failover", "value": b"ok"}},
+        )
+        assert out["result"] is True
+        await shutdown_all(*rest)
+
+
+class TestClientAgent:
+    async def test_client_discovers_servers_and_rpcs(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        client = make_client(net, "c0")
+        await client.start()
+        await client.join(["s0:gossip"])
+
+        await wait_until(
+            lambda: len(client.routers.servers()) == 3,
+            msg="client sees 3 servers via serf tags",
+        )
+
+        out = await client.rpc(
+            "KVS.Apply", {"op": "set", "entry": {"key": "via-client", "value": b"v"}}
+        )
+        assert out["result"] is True
+        got = await client.rpc("KVS.Get", {"key": "via-client"})
+        assert got["entries"][0]["value"] == b"v"
+        assert got["meta"]["index"] >= 1
+        await shutdown_all(client, *servers)
+
+    async def test_client_blocking_query_wakes_on_write(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        client = make_client(net, "c0")
+        await client.start()
+        await client.join(["s0:gossip"])
+        await wait_until(lambda: client.routers.servers(), msg="servers known")
+
+        await client.rpc(
+            "KVS.Apply", {"op": "set", "entry": {"key": "w", "value": b"1"}}
+        )
+        got = await client.rpc("KVS.Get", {"key": "w"})
+        idx = got["meta"]["index"]
+
+        async def blocked():
+            return await client.rpc(
+                "KVS.Get",
+                {"key": "w", "min_query_index": idx, "max_query_time": 5},
+                timeout=10,
+            )
+
+        task = asyncio.create_task(blocked())
+        await asyncio.sleep(0.1)
+        assert not task.done()
+        await client.rpc(
+            "KVS.Apply", {"op": "set", "entry": {"key": "w", "value": b"2"}}
+        )
+        got2 = await asyncio.wait_for(task, 5)
+        assert got2["entries"][0]["value"] == b"2"
+        await shutdown_all(client, *servers)
+
+    async def test_catalog_health_session_flow(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        client = make_client(net, "c0")
+        await client.start()
+        await client.join(["s0:gossip"])
+        await wait_until(lambda: client.routers.servers(), msg="servers known")
+
+        # Register a service + check via Catalog.Register.
+        out = await client.rpc("Catalog.Register", {
+            "node": "web-1", "address": "10.1.1.1",
+            "service": {"service": "web", "port": 80, "tags": ["v1"]},
+            "checks": [
+                {"check_id": "serfHealth", "status": "passing"},
+                {"check_id": "web-http", "service_id": "web",
+                 "status": "passing"},
+            ],
+        })
+        assert out["result"] is True
+
+        nodes = await client.rpc("Health.ServiceNodes",
+                                 {"service": "web", "passing_only": True})
+        assert len(nodes["nodes"]) == 1
+        assert nodes["nodes"][0]["service"]["port"] == 80
+
+        svc = await client.rpc("Catalog.ServiceNodes",
+                               {"service": "web", "tag": "v1"})
+        assert len(svc["nodes"]) == 1
+        none = await client.rpc("Catalog.ServiceNodes",
+                                {"service": "web", "tag": "v9"})
+        assert none["nodes"] == []
+
+        # Session + lock through the full stack.
+        sess = await client.rpc("Session.Apply", {
+            "op": "create", "session": {"node": "web-1", "ttl": "10s"},
+        })
+        sid = sess["result"]
+        lock = await client.rpc("KVS.Apply", {
+            "op": "lock",
+            "entry": {"key": "svc/leader", "value": b"web-1", "session": sid},
+        })
+        assert lock["result"] is True
+        rec = await client.rpc("KVS.Get", {"key": "svc/leader"})
+        assert rec["entries"][0]["session"] == sid
+        await shutdown_all(client, *servers)
+
+    async def test_session_ttl_expires_without_renew(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+        client = make_client(net, "c0")
+        await client.start()
+        await client.join(["s0:gossip"])
+        await wait_until(lambda: client.routers.servers(), msg="servers known")
+
+        await client.rpc("Catalog.Register", {
+            "node": "n-ttl", "address": "10.2.2.2",
+            "checks": [{"check_id": "serfHealth", "status": "passing"}],
+        })
+        sess = await client.rpc("Session.Apply", {
+            "op": "create",
+            "session": {"node": "n-ttl", "ttl": "0.2s"},
+        })
+        sid = sess["result"]
+        assert leader.store.session_get(sid)[1] is not None
+        # TTL x2 + sweep interval: should be destroyed by the leader.
+        await wait_until(
+            lambda: leader.store.session_get(sid)[1] is None,
+            timeout=5,
+            msg="session invalidated after TTL",
+        )
+        await shutdown_all(client, *servers)
+
+
+class TestCoordinateBatching:
+    async def test_updates_flush_in_one_batch(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        client = make_client(net, "c0")
+        await client.start()
+        await client.join(["s0:gossip"])
+        await wait_until(lambda: client.routers.servers(), msg="servers known")
+
+        await client.rpc("Catalog.Register",
+                         {"node": "n1", "address": "10.0.0.1"})
+        await client.rpc("Coordinate.Update", {
+            "node": "n1", "coord": {"vec": [0.1] * 8, "height": 1e-5,
+                                    "adjustment": 0.0, "error": 1.5},
+        })
+        await wait_until(
+            lambda: any(
+                s.store.coordinate("n1") is not None for s in servers
+            ),
+            msg="coordinate flushed via raft batch",
+        )
+        await shutdown_all(client, *servers)
