@@ -6,11 +6,14 @@ queries, Server (raft quorum member) and Client (RPC-forwarding thin
 agent), and the composition-root Agent with HTTP/DNS front ends.
 """
 
+from consul_tpu.agent.agent import Agent, AgentConfig
 from consul_tpu.agent.client import Client, ClientConfig
 from consul_tpu.agent.fsm import ConsulFSM, MessageType
 from consul_tpu.agent.server import Server, ServerConfig
 
 __all__ = [
+    "Agent",
+    "AgentConfig",
     "Client",
     "ClientConfig",
     "ConsulFSM",

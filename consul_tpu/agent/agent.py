@@ -1,0 +1,266 @@
+"""The Agent: composition root tying the delegate, local state, checks
+and user events together.
+
+Equivalent of ``agent/agent.go`` (SURVEY.md §2.3): every node runs an
+Agent; 3-5 run with a Server delegate (raft quorum), the rest with a
+Client delegate.  The agent owns
+
+  delegate            agent.go:121-123,167 — ``*consul.Server`` or
+                      ``*consul.Client`` behind one RPC interface
+  local state + AE    agent/local + agent/ae — the agent's services/
+                      checks, anti-entropy synced into the catalog
+  check executors     agent/checks — TTL/script/TCP/HTTP runners
+                      feeding local state
+  user events         agent/user_event.go:78-139 — serf events with a
+                      dedup ring, exposed to the API/watches
+  coordinate publish  agent keeps the server's Vivaldi coordinate
+                      fresh via Coordinate.Update (ping piggyback in
+                      the reference; a periodic task here)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import uuid
+from typing import Callable, Optional, Union
+
+from consul_tpu.agent.checks import CheckRunner, CheckTTL, build_check_runner
+from consul_tpu.agent.client import Client, ClientConfig
+from consul_tpu.agent.local import LocalState, StateSyncer
+from consul_tpu.agent.server import Server, ServerConfig
+from consul_tpu.eventing.cluster import Event, EventType
+from consul_tpu.net.transport import Transport
+from consul_tpu.protocol import LAN, GossipProfile
+
+log = logging.getLogger("consul_tpu.agent")
+
+USER_EVENT_BUFFER = 256  # user_event.go agent-side ring
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    node_name: str
+    datacenter: str = "dc1"
+    server: bool = True
+    bootstrap_expect: int = 1
+    profile: GossipProfile = LAN
+    gossip_interval_scale: float = 1.0
+    advertise_addr: str = ""
+    sync_interval_s: float = 60.0
+    sync_retry_interval_s: float = 15.0  # ae.go retryFailIntv
+    # Test-speed knobs forwarded to the Server delegate.
+    reconcile_interval_s: float = 60.0
+    coordinate_update_period_s: float = 5.0
+    session_ttl_sweep_s: float = 1.0
+
+
+@dataclasses.dataclass
+class UserEvent:
+    id: str
+    name: str
+    payload: bytes
+    ltime: int
+
+
+class Agent:
+    """One Consul agent (``agent.Agent``)."""
+
+    def __init__(
+        self,
+        config: AgentConfig,
+        gossip_transport: Transport,
+        rpc_transport: Optional[Transport] = None,
+    ):
+        self.config = config
+        if config.server:
+            if rpc_transport is None:
+                raise ValueError("server agents need an rpc transport")
+            self.delegate: Union[Server, Client] = Server(
+                ServerConfig(
+                    node_name=config.node_name,
+                    datacenter=config.datacenter,
+                    bootstrap_expect=config.bootstrap_expect,
+                    profile=config.profile,
+                    gossip_interval_scale=config.gossip_interval_scale,
+                    reconcile_interval_s=config.reconcile_interval_s,
+                    coordinate_update_period_s=config.coordinate_update_period_s,
+                    session_ttl_sweep_s=config.session_ttl_sweep_s,
+                ),
+                gossip_transport,
+                rpc_transport,
+            )
+        else:
+            if rpc_transport is None:
+                raise ValueError("client agents need an rpc transport")
+            self.delegate = Client(
+                ClientConfig(
+                    node_name=config.node_name,
+                    datacenter=config.datacenter,
+                    profile=config.profile,
+                    gossip_interval_scale=config.gossip_interval_scale,
+                ),
+                gossip_transport,
+                rpc_transport,
+            )
+
+        addr = config.advertise_addr or gossip_transport.local_addr()
+        self.local = LocalState(config.node_name, self.rpc, address=addr)
+        self.syncer = StateSyncer(
+            self.local,
+            cluster_size=lambda: len(self.serf.members) or 1,
+            sync_interval_s=config.sync_interval_s,
+            retry_interval_s=config.sync_retry_interval_s,
+        )
+        self.checks: dict[str, CheckRunner] = {}
+        self.events: list[UserEvent] = []  # dedup ring, newest last
+        self._event_seen: set[tuple[int, str]] = set()
+        self.event_handlers: list[Callable[[UserEvent], None]] = []
+        self._event_wake = asyncio.Event()
+
+        # Chain onto the serf event stream without stealing the
+        # delegate's own handler (server reconcile wake).
+        serf_cfg = self.serf.config
+        prev = serf_cfg.on_event
+
+        def chained(event: Event) -> None:
+            if prev is not None:
+                prev(event)
+            self._on_serf_event(event)
+
+        serf_cfg.on_event = chained
+
+    # ------------------------------------------------------------------
+
+    @property
+    def serf(self):
+        return self.delegate.serf
+
+    def is_server(self) -> bool:
+        return isinstance(self.delegate, Server)
+
+    async def rpc(self, method: str, body: dict):
+        """The one RPC entry point (agent.go:1296 a.RPC): servers
+        execute locally, clients forward (SURVEY.md §3.4)."""
+        if isinstance(self.delegate, Server):
+            ep_name, _, verb = method.partition(".")
+            ep = self.delegate.rpc_server._endpoints.get(ep_name)
+            if ep is None:
+                raise ValueError(f"unknown RPC service {ep_name}")
+            from consul_tpu.agent.rpc import snake
+
+            return await getattr(ep, snake(verb))(body)
+        return await self.delegate.rpc(method, body)
+
+    async def start(self) -> None:
+        await self.delegate.start()
+        self.syncer.start()
+
+    async def join(self, addrs: list[str]) -> int:
+        return await self.delegate.join(addrs)
+
+    async def leave(self) -> None:
+        await self.delegate.leave()
+
+    async def shutdown(self) -> None:
+        self.syncer.stop()
+        for runner in self.checks.values():
+            runner.stop()
+        await self.delegate.shutdown()
+
+    # ------------------------------------------------------------------
+    # service & check registration (agent.go AddService/AddCheck)
+    # ------------------------------------------------------------------
+
+    def add_service(self, service: dict, checks: Optional[list[dict]] = None) -> None:
+        sid = service.get("id") or service["service"]
+        self.local.add_service(service)
+        for i, defn in enumerate(checks or []):
+            defn = dict(defn)
+            defn.setdefault("check_id", f"service:{sid}" + (f":{i+1}" if i else ""))
+            defn["service_id"] = sid
+            defn.setdefault("service_name", service["service"])
+            self.add_check(defn)
+
+    def remove_service(self, service_id: str) -> bool:
+        for cid, runner in list(self.checks.items()):
+            entry = self.local.checks.get(cid)
+            if entry and entry.check.get("service_id") == service_id:
+                runner.stop()
+                del self.checks[cid]
+        return self.local.remove_service(service_id)
+
+    def add_check(self, defn: dict) -> None:
+        cid = defn.get("check_id") or defn.get("name")
+        runner = build_check_runner(defn, self._notify_check)
+        record = {
+            k: v
+            for k, v in defn.items()
+            if k in ("check_id", "name", "notes", "status", "service_id",
+                     "service_name")
+        }
+        record.setdefault("name", cid)
+        self.local.add_check(record)
+        if runner is not None:
+            old = self.checks.pop(cid, None)
+            if old:
+                old.stop()
+            self.checks[cid] = runner
+            runner.start()
+
+    def remove_check(self, check_id: str) -> bool:
+        runner = self.checks.pop(check_id, None)
+        if runner:
+            runner.stop()
+        return self.local.remove_check(check_id)
+
+    def update_ttl_check(self, check_id: str, status: str, output: str = "") -> bool:
+        """Agent TTL endpoints (pass/warn/fail)."""
+        runner = self.checks.get(check_id)
+        if not isinstance(runner, CheckTTL):
+            return False
+        runner.set_status(status, output)
+        return True
+
+    def _notify_check(self, check_id: str, status: str, output: str) -> None:
+        self.local.update_check(check_id, status, output)
+
+    # ------------------------------------------------------------------
+    # user events (agent/user_event.go)
+    # ------------------------------------------------------------------
+
+    async def fire_event(self, name: str, payload: bytes = b"") -> str:
+        """Fire a user event into the gossip plane
+        (user_event.go:78 UserEvent → serf.UserEvent)."""
+        await self.serf.user_event(name, payload)
+        return str(uuid.uuid4())
+
+    def _on_serf_event(self, event: Event) -> None:
+        if event.type != EventType.USER:
+            return
+        key = (event.ltime, event.name)
+        if key in self._event_seen:
+            return  # agent-side dedup ring (user_event.go:118-130)
+        self._event_seen.add(key)
+        ue = UserEvent(
+            id=str(uuid.uuid4()),
+            name=event.name,
+            payload=event.payload,
+            ltime=event.ltime,
+        )
+        self.events.append(ue)
+        if len(self.events) > USER_EVENT_BUFFER:
+            dropped = self.events.pop(0)
+            self._event_seen.discard((dropped.ltime, dropped.name))
+        self._event_wake.set()
+        self._event_wake = asyncio.Event()
+        for handler in self.event_handlers:
+            try:
+                handler(ue)
+            except Exception:  # noqa: BLE001
+                log.exception("user event handler failed")
+
+    def event_wake_handle(self) -> asyncio.Event:
+        """Current wake event for long-polling /v1/event/list."""
+        return self._event_wake

@@ -12,9 +12,15 @@ import asyncio
 import dataclasses
 import logging
 import random
+import time
 from typing import Optional
 
-from consul_tpu.agent.rpc import ERR_NO_LEADER, RPCClient, RPCError
+from consul_tpu.agent.rpc import (
+    ERR_NO_LEADER,
+    RPCClient,
+    RPCError,
+    rpc_timeout_for,
+)
 from consul_tpu.eventing.cluster import Cluster, ClusterConfig, MemberStatus
 from consul_tpu.net.transport import Transport
 from consul_tpu.protocol import LAN, GossipProfile
@@ -34,15 +40,28 @@ class ClientConfig:
     tags: dict = dataclasses.field(default_factory=dict)
 
 
+REBALANCE_INTERVAL_S = 120.0  # router/manager.go clientRPCMinReuseDuration
+
+
 class ServerManager:
     """Tracks known servers from serf tags, rotates through them
-    (router/manager.go:44-190: rebalance + cycle-on-failure)."""
+    (router/manager.go:44-190): sticky preferred server, cycled on
+    failure and periodically re-shuffled so client load spreads over
+    servers added later."""
 
-    def __init__(self, serf: Cluster, datacenter: str, seed: int = 0):
+    def __init__(
+        self,
+        serf: Cluster,
+        datacenter: str,
+        seed: int = 0,
+        rebalance_interval_s: float = REBALANCE_INTERVAL_S,
+    ):
         self.serf = serf
         self.datacenter = datacenter
         self._rng = random.Random(seed)
         self._preferred: Optional[str] = None  # rpc addr
+        self.rebalance_interval_s = rebalance_interval_s
+        self._next_rebalance = 0.0
 
     def servers(self) -> list[dict]:
         out = []
@@ -65,9 +84,11 @@ class ServerManager:
         if not servers:
             return None
         addrs = [s["rpc_addr"] for s in servers]
-        if self._preferred in addrs:
+        now = time.monotonic()
+        if self._preferred in addrs and now < self._next_rebalance:
             return self._preferred
         self._preferred = self._rng.choice(addrs)
+        self._next_rebalance = now + self.rebalance_interval_s
         return self._preferred
 
     def notify_failed(self, addr: str) -> None:
@@ -111,10 +132,12 @@ class Client:
         await self.rpc_client.shutdown()
         await self.serf.shutdown()
 
-    async def rpc(self, method: str, body: dict, timeout: float = 30.0):
+    async def rpc(self, method: str, body: dict, timeout: float = 0.0):
         """Forward an RPC to a server, retrying with jitter across
         servers on connection failure or missing leader
-        (client.go:237-280 RPC retry loop)."""
+        (client.go:237-280 RPC retry loop).  With no explicit timeout
+        the budget follows the query's blocking wait."""
+        timeout = timeout or rpc_timeout_for(body)
         last_error: Exception = RPCError("no known consul servers")
         for attempt in range(RPC_RETRIES):
             addr = self.routers.pick()

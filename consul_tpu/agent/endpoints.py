@@ -56,6 +56,7 @@ _TABLES_BY_TYPE = {
     MessageType.DEREGISTER: ("nodes", "services", "checks"),
     MessageType.KVS: ("kvs", "tombstones"),
     MessageType.SESSION: ("sessions",),
+    MessageType.TXN: ("kvs", "tombstones"),
     MessageType.PREPARED_QUERY: ("prepared_queries",),
     MessageType.CONFIG_ENTRY: ("config_entries",),
 }

@@ -77,6 +77,19 @@ def snake(name: str) -> str:
     return _CAMEL_RE.sub("_", name).lower()
 
 
+def rpc_timeout_for(body: dict, default: float = 30.0) -> float:
+    """Client-side wait budget for a call that may long-poll server-side
+    (pool.go RPC deadline = query wait + jitter + RPCHoldTimeout): a
+    blocking query must be given its full max_query_time plus the
+    server's jitter (1/16) and a grace window, or a follower/client
+    forwarding it would time out before the leader answers."""
+    if int(body.get("min_query_index", 0) or 0) <= 0:
+        return default
+    wait = float(body.get("max_query_time", 0.0) or 0.0) or DEFAULT_QUERY_TIME
+    wait = min(wait, MAX_QUERY_TIME)
+    return wait + wait / JITTER_FRACTION + 5.0
+
+
 @dataclasses.dataclass
 class QueryOptions:
     """Client-supplied read options (structs.QueryOptions)."""

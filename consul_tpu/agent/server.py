@@ -42,6 +42,7 @@ from consul_tpu.agent.rpc import (
     RPCError,
     RPCServer,
     RaftRPCAdapter,
+    rpc_timeout_for,
 )
 from consul_tpu.consensus.raft import NotLeaderError, RaftConfig, RaftNode
 from consul_tpu.eventing.cluster import (
@@ -257,7 +258,9 @@ class Server:
         addr = self.leader_rpc_addr()
         if addr is None:
             raise RPCError(ERR_NO_LEADER)
-        return await self.rpc_client.call(addr, method, body)
+        return await self.rpc_client.call(
+            addr, method, body, timeout=rpc_timeout_for(body)
+        )
 
     async def raft_apply(self, msg_type: MessageType, body: dict):
         """Apply a command through raft (rpc.go:679 raftApply)."""

@@ -1,0 +1,55 @@
+"""Shared async test helpers (one canonical copy for all suites)."""
+
+import asyncio
+
+
+async def wait_until(pred, timeout=30.0, step=0.02):
+    """Poll ``pred()`` until truthy; returns True/False (never raises) so
+    callers can also assert that something does NOT happen."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(step)
+    return False
+
+
+async def wait_for(pred, timeout=10.0, step=0.05, msg="condition"):
+    """Like wait_until but raises with a message on timeout; accepts
+    sync or async predicates."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        r = pred()
+        if asyncio.iscoroutine(r):
+            r = await r
+        if r:
+            return
+        await asyncio.sleep(step)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _leader_id(n):
+    if hasattr(n, "leader_id"):  # RaftNode
+        return n.leader_id
+    raft = getattr(n, "raft", None)  # Server / Agent delegate
+    return raft.leader_id if raft is not None else None
+
+
+async def wait_for_leader(nodes, timeout=10.0):
+    """One stable leader that every node agrees on; works for RaftNode
+    and Server collections."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        leaders = [n for n in nodes if n.is_leader()]
+        if len(leaders) == 1:
+            want = _leader_id(leaders[0])
+            if all(_leader_id(n) == want for n in nodes):
+                return leaders[0]
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        "no stable leader: "
+        + str([(getattr(n, "id", getattr(n, "node_id", "?")), _leader_id(n))
+               for n in nodes])
+    )

@@ -1,0 +1,212 @@
+"""Agent composition: local state anti-entropy, checks, user events.
+
+Parity model: ``agent/local/state_test.go`` (sync full/changes),
+``agent/checks/check_test.go`` (TTL expiry), ``agent/user_event.go``
+dedup, ``ae/ae.go`` scale function.
+"""
+
+import asyncio
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.agent.agent import Agent, AgentConfig
+from consul_tpu.agent.local import sync_scale_factor
+from consul_tpu.net.transport import InMemoryNetwork
+from consul_tpu.store.state import HEALTH_CRITICAL, HEALTH_PASSING
+
+
+def make_agent(net, name, server=True, expect=1, **kw):
+    cfg = AgentConfig(
+        node_name=name,
+        server=server,
+        bootstrap_expect=expect,
+        gossip_interval_scale=0.05,
+        sync_interval_s=0.3,
+        sync_retry_interval_s=0.2,
+        reconcile_interval_s=0.2,
+        **kw,
+    )
+    return Agent(
+        cfg,
+        gossip_transport=net.new_transport(f"{name}:gossip"),
+        rpc_transport=net.new_transport(f"{name}:rpc"),
+    )
+
+
+
+
+def test_sync_scale_factor():
+    # ae/ae.go:25-38 — 1.0 below threshold, +log2 above.
+    assert sync_scale_factor(1) == 1.0
+    assert sync_scale_factor(128) == 1.0
+    assert sync_scale_factor(256) == 2.0
+    assert sync_scale_factor(1024) == 4.0
+
+
+class TestAntiEntropy:
+    async def test_service_syncs_into_catalog(self):
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0")
+        await a.start()
+        a.add_service({"service": "web", "port": 80, "tags": ["v1"]})
+        store = a.delegate.store
+        await wait_until(
+            lambda: store.service_nodes("web")[1],
+            msg="service pushed by anti-entropy",
+        )
+        svc = store.service_nodes("web")[1][0]
+        assert svc["port"] == 80 and svc["node"] == "a0"
+        await a.shutdown()
+
+    async def test_remove_service_deregisters(self):
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0")
+        await a.start()
+        a.add_service({"service": "web", "port": 80})
+        store = a.delegate.store
+        await wait_until(lambda: store.service_nodes("web")[1], msg="registered")
+        a.remove_service("web")
+        await wait_until(
+            lambda: not store.service_nodes("web")[1],
+            msg="service deregistered after removal",
+        )
+        await a.shutdown()
+
+    async def test_full_sync_is_idempotent_no_spurious_writes(self):
+        # Regression: normalization mismatch (None vs '') used to mark
+        # every entry dirty and re-register the world each interval.
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0")
+        await a.start()
+        a.add_service({"service": "web", "port": 80})
+        store = a.delegate.store
+        await wait_until(
+            lambda: store.service_nodes("web")[1], msg="registered"
+        )
+        await a.local.sync_full()  # settle
+        idx_before = store.max_index("services", "checks")
+        for _ in range(3):
+            await a.local.sync_full()
+        assert store.max_index("services", "checks") == idx_before
+        assert all(e.in_sync for e in a.local.services.values())
+        await a.shutdown()
+
+    async def test_remote_only_service_purged_on_full_sync(self):
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0")
+        await a.start()
+        await wait_until(lambda: a.delegate.is_leader(), msg="leader")
+        # An old incarnation left a stray service in the catalog.
+        await a.rpc("Catalog.Register", {
+            "node": "a0", "address": "x",
+            "service": {"service": "ghost", "id": "ghost"},
+        })
+        store = a.delegate.store
+        assert store.service_nodes("ghost")[1]
+        await wait_until(
+            lambda: not store.service_nodes("ghost")[1],
+            msg="stray service purged by next full sync",
+        )
+        await a.shutdown()
+
+
+class TestChecks:
+    async def test_ttl_check_lifecycle(self):
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0")
+        await a.start()
+        a.add_service(
+            {"service": "web", "port": 80},
+            checks=[{"ttl": "0.4s"}],
+        )
+        store = a.delegate.store
+        # Starts critical (no heartbeat yet) — reference default.
+        await wait_until(
+            lambda: any(
+                c["check_id"] == "service:web"
+                for c in store.node_checks("a0")[1]
+            ),
+            msg="ttl check registered",
+        )
+
+        assert a.update_ttl_check("service:web", HEALTH_PASSING, "all good")
+        await wait_until(
+            lambda: any(
+                c["check_id"] == "service:web" and c["status"] == HEALTH_PASSING
+                for c in store.node_checks("a0")[1]
+            ),
+            msg="check passing after heartbeat",
+        )
+
+        # Stop heartbeating: TTL flips it critical.
+        await wait_until(
+            lambda: any(
+                c["check_id"] == "service:web" and c["status"] == HEALTH_CRITICAL
+                for c in store.node_checks("a0")[1]
+            ),
+            msg="check critical after TTL lapse",
+        )
+        await a.shutdown()
+
+    async def test_monitor_check_runs_command(self):
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0")
+        await a.start()
+        a.add_check({"check_id": "always-ok", "script": "true", "interval": "0.1s"})
+        store = a.delegate.store
+        await wait_until(
+            lambda: any(
+                c["check_id"] == "always-ok" and c["status"] == HEALTH_PASSING
+                for c in store.node_checks("a0")[1]
+            ),
+            msg="script check passing",
+        )
+        await a.shutdown()
+
+
+class TestUserEvents:
+    async def test_fire_and_receive_with_dedup(self):
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0", expect=1)
+        b = make_agent(net, "b0", server=False)
+        await a.start()
+        await b.start()
+        await b.join(["a0:gossip"])
+        await wait_until(
+            lambda: len(b.serf.members) == 2, msg="gossip converged"
+        )
+
+        await a.fire_event("deploy", b"v1.2.3")
+        await wait_until(
+            lambda: any(e.name == "deploy" for e in b.events),
+            msg="event reached the other agent",
+        )
+        ev = next(e for e in b.events if e.name == "deploy")
+        assert ev.payload == b"v1.2.3"
+        count = sum(1 for e in b.events if e.name == "deploy")
+        await asyncio.sleep(0.3)  # rebroadcasts keep gossiping
+        assert sum(1 for e in b.events if e.name == "deploy") == count  # deduped
+        await b.shutdown()
+        await a.shutdown()
+
+    async def test_client_agent_rpc_via_server(self):
+        net = InMemoryNetwork()
+        a = make_agent(net, "a0", expect=1)
+        b = make_agent(net, "b0", server=False)
+        await a.start()
+        await b.start()
+        await b.join(["a0:gossip"])
+        await wait_until(
+            lambda: b.delegate.routers.servers(), msg="client found server"
+        )
+        b.add_service({"service": "db", "port": 5432})
+        await wait_until(
+            lambda: a.delegate.store.service_nodes("db")[1],
+            msg="client service synced through server",
+        )
+        node = a.delegate.store.service_nodes("db")[1][0]["node"]
+        assert node == "b0"
+        await b.shutdown()
+        await a.shutdown()

@@ -9,6 +9,9 @@ import asyncio
 
 import pytest
 
+from helpers import wait_for as wait_until
+from helpers import wait_for_leader
+
 from consul_tpu.agent.client import Client, ClientConfig
 from consul_tpu.agent.server import Server, ServerConfig
 from consul_tpu.net.transport import InMemoryNetwork
@@ -51,34 +54,10 @@ async def start_cluster(net, n=3):
     return servers
 
 
-async def wait_for_leader(servers, timeout=10.0):
-    deadline = asyncio.get_running_loop().time() + timeout
-    while asyncio.get_running_loop().time() < deadline:
-        leaders = [s for s in servers if s.is_leader()]
-        if len(leaders) == 1:
-            return leaders[0]
-        await asyncio.sleep(0.05)
-    raise AssertionError(
-        f"no leader: {[(s.node_id, s.raft and s.raft.role) for s in servers]}"
-    )
-
-
 async def shutdown_all(*nodes):
     for n in nodes:
         await n.shutdown()
     await asyncio.sleep(0)
-
-
-async def wait_until(pred, timeout=5.0, msg="condition"):
-    deadline = asyncio.get_running_loop().time() + timeout
-    while asyncio.get_running_loop().time() < deadline:
-        r = pred()
-        if asyncio.iscoroutine(r):
-            r = await r
-        if r:
-            return
-        await asyncio.sleep(0.05)
-    raise AssertionError(f"timeout waiting for {msg}")
 
 
 class TestServerCluster:
@@ -207,12 +186,14 @@ class TestClientAgent:
         await client.join(["s0:gossip"])
         await wait_until(lambda: client.routers.servers(), msg="servers known")
 
-        # Register a service + check via Catalog.Register.
+        # Register an EXTERNAL node (no serfHealth — such nodes are
+        # exempt from the leader's reconcileReaped pass, like external
+        # services in the reference).
         out = await client.rpc("Catalog.Register", {
             "node": "web-1", "address": "10.1.1.1",
             "service": {"service": "web", "port": 80, "tags": ["v1"]},
             "checks": [
-                {"check_id": "serfHealth", "status": "passing"},
+                {"check_id": "web-alive", "status": "passing"},
                 {"check_id": "web-http", "service_id": "web",
                  "status": "passing"},
             ],
@@ -231,9 +212,12 @@ class TestClientAgent:
                                 {"service": "web", "tag": "v9"})
         assert none["nodes"] == []
 
-        # Session + lock through the full stack.
+        # Session + lock through the full stack (explicit check set:
+        # this external node has no serfHealth).
         sess = await client.rpc("Session.Apply", {
-            "op": "create", "session": {"node": "web-1", "ttl": "10s"},
+            "op": "create",
+            "session": {"node": "web-1", "ttl": "10s",
+                        "checks": ["web-alive"]},
         })
         sid = sess["result"]
         lock = await client.rpc("KVS.Apply", {
@@ -254,13 +238,15 @@ class TestClientAgent:
         await client.join(["s0:gossip"])
         await wait_until(lambda: client.routers.servers(), msg="servers known")
 
+        # External node (no serfHealth): stays in the catalog so the
+        # session can only vanish through the leader's TTL sweep — the
+        # code actually under test here.
         await client.rpc("Catalog.Register", {
             "node": "n-ttl", "address": "10.2.2.2",
-            "checks": [{"check_id": "serfHealth", "status": "passing"}],
         })
         sess = await client.rpc("Session.Apply", {
             "op": "create",
-            "session": {"node": "n-ttl", "ttl": "0.2s"},
+            "session": {"node": "n-ttl", "ttl": "0.2s", "checks": []},
         })
         sid = sess["result"]
         assert leader.store.session_get(sid)[1] is not None

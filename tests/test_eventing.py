@@ -3,6 +3,8 @@ tags, intents — over the in-memory network at 50x speed."""
 
 import asyncio
 
+from helpers import wait_until
+
 from consul_tpu.eventing import (
     Cluster,
     ClusterConfig,
@@ -37,16 +39,6 @@ async def make_cluster(net, n, tags=None, **kw):
     for c in out[1:]:
         assert await c.join(["mem://e0"]) == 1
     return out
-
-
-async def wait_until(pred, timeout=30.0, step=0.02):
-    loop = asyncio.get_running_loop()
-    deadline = loop.time() + timeout
-    while loop.time() < deadline:
-        if pred():
-            return True
-        await asyncio.sleep(step)
-    return False
 
 
 async def collect_events(cluster, etype, bucket):

@@ -6,6 +6,8 @@ import asyncio
 
 import pytest
 
+from helpers import wait_until
+
 from consul_tpu.net import (
     InMemoryNetwork,
     Memberlist,
@@ -33,16 +35,6 @@ async def make_cluster(net, n, joined=True, **cfg_kw):
         for m in nodes[1:]:
             assert await m.join(["mem://n0"]) == 1
     return nodes
-
-
-async def wait_until(pred, timeout=30.0, step=0.02):
-    loop = asyncio.get_running_loop()
-    deadline = loop.time() + timeout
-    while loop.time() < deadline:
-        if pred():
-            return True
-        await asyncio.sleep(step)
-    return False
 
 
 async def stop_all(nodes):

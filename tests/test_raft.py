@@ -52,20 +52,7 @@ def make_cluster(n, net=None, **cfg_kwargs):
     return net, nodes
 
 
-async def wait_for_leader(nodes, timeout=5.0):
-    deadline = asyncio.get_running_loop().time() + timeout
-    while asyncio.get_running_loop().time() < deadline:
-        leaders = [n for n in nodes if n.is_leader()]
-        if len(leaders) == 1:
-            followers_agree = all(
-                n.leader_id == leaders[0].id for n in nodes if n is not leaders[0]
-            )
-            if followers_agree:
-                return leaders[0]
-        await asyncio.sleep(0.02)
-    raise AssertionError(
-        f"no stable leader: {[(n.id, n.role, n.leader_id) for n in nodes]}"
-    )
+from helpers import wait_for_leader  # noqa: E402 — canonical copy
 
 
 async def shutdown_all(nodes):
