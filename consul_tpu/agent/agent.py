@@ -115,6 +115,7 @@ class Agent:
         )
         self.checks: dict[str, CheckRunner] = {}
         self.events: list[UserEvent] = []  # dedup ring, newest last
+        self.event_index = 0  # monotonic, the X-Consul-Index for /event/list
         self._event_seen: set[tuple[int, str]] = set()
         self.event_handlers: list[Callable[[UserEvent], None]] = []
         self._event_wake = asyncio.Event()
@@ -144,13 +145,7 @@ class Agent:
         """The one RPC entry point (agent.go:1296 a.RPC): servers
         execute locally, clients forward (SURVEY.md §3.4)."""
         if isinstance(self.delegate, Server):
-            ep_name, _, verb = method.partition(".")
-            ep = self.delegate.rpc_server._endpoints.get(ep_name)
-            if ep is None:
-                raise ValueError(f"unknown RPC service {ep_name}")
-            from consul_tpu.agent.rpc import snake
-
-            return await getattr(ep, snake(verb))(body)
+            return await self.delegate.rpc_server.dispatch_local(method, body)
         return await self.delegate.rpc(method, body)
 
     async def start(self) -> None:
@@ -250,6 +245,7 @@ class Agent:
             ltime=event.ltime,
         )
         self.events.append(ue)
+        self.event_index += 1
         if len(self.events) > USER_EVENT_BUFFER:
             dropped = self.events.pop(0)
             self._event_seen.discard((dropped.ltime, dropped.name))

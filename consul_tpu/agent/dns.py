@@ -1,0 +1,421 @@
+"""DNS interface: service discovery over the DNS protocol.
+
+Equivalent of ``agent/dns.go`` (the miekg/dns server on :8600): node
+lookups (``<node>.node.<dc>.consul``), service lookups
+(``[<tag>.]<service>.service[.<dc>].consul``) with only-passing
+filtering, RFC 2782 SRV names (``_svc._tag.service.consul``), prepared
+query lookups (``<name>.query.consul``), SOA/NS, A/AAAA/SRV/TXT answer
+synthesis, shuffled answers, and UDP truncation with the TC bit.
+
+The wire codec is hand-rolled (RFC 1035 §4) — the image has no DNS
+library.  Compression pointers are emitted for repeated names.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import struct
+from typing import Optional
+
+from consul_tpu.agent.agent import Agent
+
+log = logging.getLogger("consul_tpu.dns")
+
+# RR types/classes (RFC 1035 + 3596).
+TYPE_A = 1
+TYPE_NS = 2
+TYPE_SOA = 6
+TYPE_PTR = 12
+TYPE_TXT = 16
+TYPE_AAAA = 28
+TYPE_SRV = 33
+TYPE_ANY = 255
+CLASS_IN = 1
+
+RCODE_OK = 0
+RCODE_NXDOMAIN = 3
+RCODE_NOTIMPL = 4
+
+UDP_PAYLOAD_MAX = 512  # pre-EDNS budget (dns.go truncation)
+MAX_ANSWERS = 32  # dns.go a-record limit analogue
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+class DNSQuestion:
+    def __init__(self, name: str, qtype: int, qclass: int):
+        self.name = name
+        self.qtype = qtype
+        self.qclass = qclass
+
+
+class DNSRecord:
+    def __init__(self, name: str, rtype: int, ttl: int, rdata: bytes):
+        self.name = name
+        self.rtype = rtype
+        self.ttl = ttl
+        self.rdata = rdata
+
+
+def _encode_name(name: str, offsets: dict[str, int], pos: int) -> bytes:
+    """RFC 1035 name encoding with compression pointers."""
+    labels = [l for l in name.rstrip(".").split(".") if l]
+    out = b""
+    for i in range(len(labels)):
+        suffix = ".".join(labels[i:])
+        if suffix in offsets:
+            return out + struct.pack(">H", 0xC000 | offsets[suffix])
+        if pos + len(out) < 0x3FFF:
+            offsets[suffix] = pos + len(out)
+        label = labels[i].encode()
+        out += bytes([len(label)]) + label
+    return out + b"\x00"
+
+
+def _decode_name(buf: bytes, pos: int) -> tuple[str, int]:
+    labels = []
+    jumped = False
+    end = pos
+    hops = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated name")
+        length = buf[pos]
+        if length & 0xC0 == 0xC0:
+            if hops > 32:
+                raise ValueError("compression loop")
+            hops += 1
+            ptr = struct.unpack(">H", buf[pos:pos + 2])[0] & 0x3FFF
+            if not jumped:
+                end = pos + 2
+            pos = ptr
+            jumped = True
+            continue
+        pos += 1
+        if length == 0:
+            break
+        labels.append(buf[pos:pos + length].decode(errors="replace"))
+        pos += length
+    if not jumped:
+        end = pos
+    return ".".join(labels), end
+
+
+def parse_query(buf: bytes) -> tuple[int, list[DNSQuestion]]:
+    txid, flags, qd, _an, _ns, _ar = struct.unpack(">HHHHHH", buf[:12])
+    pos = 12
+    questions = []
+    for _ in range(qd):
+        name, pos = _decode_name(buf, pos)
+        qtype, qclass = struct.unpack(">HH", buf[pos:pos + 4])
+        pos += 4
+        questions.append(DNSQuestion(name, qtype, qclass))
+    return txid, questions
+
+
+def build_query(txid: int, name: str, qtype: int = TYPE_A) -> bytes:
+    """Client-side query encoder (used by tests and the CLI resolver)."""
+    header = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)  # RD
+    return header + _rd_name(name) + struct.pack(">HH", qtype, CLASS_IN)
+
+
+def parse_response(buf: bytes) -> tuple[int, int, list[DNSRecord]]:
+    """Decode (txid, rcode, answers) — rdata left raw."""
+    txid, flags, qd, an, _ns, _ar = struct.unpack(">HHHHHH", buf[:12])
+    pos = 12
+    for _ in range(qd):
+        _, pos = _decode_name(buf, pos)
+        pos += 4
+    answers = []
+    for _ in range(an):
+        name, pos = _decode_name(buf, pos)
+        rtype, _rclass, ttl, rdlen = struct.unpack(">HHIH", buf[pos:pos + 10])
+        pos += 10
+        answers.append(DNSRecord(name, rtype, ttl, buf[pos:pos + rdlen]))
+        pos += rdlen
+    return txid, flags & 0xF, answers
+
+
+def build_response(
+    txid: int,
+    questions: list[DNSQuestion],
+    answers: list[DNSRecord],
+    authority: list[DNSRecord],
+    rcode: int,
+    truncate_to: Optional[int] = UDP_PAYLOAD_MAX,
+) -> bytes:
+    flags = 0x8480 | (rcode & 0xF)  # QR|AA|RD-echo
+    out = bytearray()
+    offsets: dict[str, int] = {}
+
+    def emit_q(q: DNSQuestion) -> bytes:
+        return _encode_name(q.name, offsets, 12 + len(out)) + struct.pack(
+            ">HH", q.qtype, q.qclass
+        )
+
+    def emit_rr(r: DNSRecord) -> bytes:
+        head = _encode_name(r.name, offsets, 12 + len(out))
+        return head + struct.pack(
+            ">HHIH", r.rtype, CLASS_IN, r.ttl, len(r.rdata)
+        ) + r.rdata
+
+    for q in questions:
+        out += emit_q(q)
+    n_ans = 0
+    truncated = False
+    for r in answers:
+        rr = emit_rr(r)
+        if truncate_to and 12 + len(out) + len(rr) > truncate_to:
+            truncated = True
+            break
+        out += rr
+        n_ans += 1
+    n_auth = 0
+    if not truncated:
+        for r in authority:
+            rr = emit_rr(r)
+            if truncate_to and 12 + len(out) + len(rr) > truncate_to:
+                break
+            out += rr
+            n_auth += 1
+    if truncated:
+        flags |= 0x0200  # TC
+    header = struct.pack(
+        ">HHHHHH", txid, flags, len(questions), n_ans, n_auth, 0
+    )
+    return header + bytes(out)
+
+
+def _rd_a(ip: str) -> bytes:
+    try:
+        return bytes(int(p) for p in ip.split("."))
+    except ValueError:
+        return b"\x7f\x00\x00\x01"
+
+
+def _rd_name(name: str) -> bytes:
+    out = b""
+    for label in name.rstrip(".").split("."):
+        out += bytes([len(label)]) + label.encode()
+    return out + b"\x00"
+
+
+def _rd_srv(prio: int, weight: int, port: int, target: str) -> bytes:
+    return struct.pack(">HHH", prio, weight, port) + _rd_name(target)
+
+
+def _rd_txt(text: str) -> bytes:
+    data = text.encode()[:255]
+    return bytes([len(data)]) + data
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+
+class DNSServer:
+    """agent/dns.go DNSServer: dispatch on the .consul name space."""
+
+    def __init__(self, agent: Agent, domain: str = "consul",
+                 node_ttl: int = 0, only_passing: bool = True,
+                 seed: int = 0):
+        self.agent = agent
+        self.domain = domain.strip(".").lower()
+        self.node_ttl = node_ttl
+        self.only_passing = only_passing
+        self._rng = random.Random(seed)
+        self._udp: Optional[asyncio.DatagramTransport] = None
+        self._inflight: set[asyncio.Task] = set()
+        self.addr = ""
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        loop = asyncio.get_running_loop()
+        server = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                # Hold a strong reference until done, or the loop's weak
+                # ref lets the in-flight resolution be GC'd mid-query.
+                task = asyncio.ensure_future(
+                    server._handle(self.transport, data, addr)
+                )
+                server._inflight.add(task)
+                task.add_done_callback(server._inflight.discard)
+
+        self._udp, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(host, port)
+        )
+        h, p = self._udp.get_extra_info("sockname")[:2]
+        self.addr = f"{h}:{p}"
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._udp:
+            self._udp.close()
+
+    async def _handle(self, transport, data: bytes, addr) -> None:
+        try:
+            txid, questions = parse_query(data)
+        except (ValueError, struct.error):
+            return
+        try:
+            resp = await self.answer(txid, questions)
+        except Exception:  # noqa: BLE001
+            log.exception("dns handler failed")
+            resp = build_response(txid, questions, [], [], RCODE_NOTIMPL)
+        transport.sendto(resp, addr)
+
+    # -- resolution (dns.go:427 handleQuery → dispatch) -----------------
+
+    async def answer(self, txid: int, questions: list[DNSQuestion]) -> bytes:
+        if not questions:
+            return build_response(txid, [], [], [], RCODE_NXDOMAIN)
+        q = questions[0]
+        name = q.name.lower().rstrip(".")
+        labels = name.split(".")
+        domain_labels = self.domain.split(".")
+        # Label-boundary match: "web.service.notconsul" and
+        # "anythingconsul" are NOT ours (dns.go trimDomain).
+        if labels[-len(domain_labels):] != domain_labels:
+            return build_response(txid, questions, [], [], RCODE_NXDOMAIN)
+        core = labels[: -len(domain_labels)]
+        answers: list[DNSRecord] = []
+        rcode = RCODE_OK
+
+        try:
+            if not core or core == [""]:
+                answers = [self._soa()]
+            elif core[-1] == "node" or (len(core) >= 2 and core[-2] == "node"):
+                answers = await self._node_lookup(core, q)
+            elif "service" in core:
+                answers = await self._service_lookup(core, q)
+            elif core[-1] == "query":
+                answers = await self._query_lookup(core, q)
+            else:
+                rcode = RCODE_NXDOMAIN
+        except LookupError:
+            rcode = RCODE_NXDOMAIN
+
+        if not answers and rcode == RCODE_OK:
+            rcode = RCODE_NXDOMAIN
+        authority = [] if answers else [self._soa()]
+        return build_response(txid, questions, answers, authority, rcode)
+
+    def _soa(self) -> DNSRecord:
+        """dns.go soa(): ns.<domain> authority record."""
+        rdata = (
+            _rd_name(f"ns.{self.domain}")
+            + _rd_name(f"hostmaster.{self.domain}")
+            + struct.pack(">IIIII", 1, 3600, 600, 86400, 0)
+        )
+        return DNSRecord(self.domain, TYPE_SOA, 0, rdata)
+
+    async def _node_lookup(self, core: list[str], q: DNSQuestion) -> list[DNSRecord]:
+        """<node>.node[.<dc>].consul (dns.go nodeLookup)."""
+        idx = core.index("node") if "node" in core else len(core) - 1
+        node = ".".join(core[:idx])
+        out = await self.agent.rpc(
+            "Internal.NodeInfo", {"node": node, "allow_stale": True}
+        )
+        dump = out.get("dump") or []
+        if not dump:
+            raise LookupError(node)
+        addr = dump[0]["node"].get("address", "")
+        recs = [DNSRecord(q.name, TYPE_A, self.node_ttl, _rd_a(addr))]
+        if q.qtype == TYPE_TXT:
+            meta = dump[0]["node"].get("meta", {})
+            recs = [
+                DNSRecord(q.name, TYPE_TXT, self.node_ttl,
+                          _rd_txt(f"{k}={v}"))
+                for k, v in meta.items()
+            ] or [DNSRecord(q.name, TYPE_TXT, self.node_ttl, _rd_txt(""))]
+        return recs
+
+    async def _service_lookup(self, core: list[str], q: DNSQuestion) -> list[DNSRecord]:
+        """[<tag>.]<service>.service[.<dc>] and RFC 2782
+        _<service>._<proto> forms (dns.go serviceLookup)."""
+        svc_idx = core.index("service")
+        head = core[:svc_idx]
+        tag = None
+        if len(head) == 1:
+            service = head[0]
+        elif len(head) == 2:
+            tag, service = head[0], head[1]
+        else:
+            raise LookupError(".".join(core))
+        if service.startswith("_"):  # RFC 2782: _svc._tag
+            service = service[1:]
+            if tag and tag.startswith("_"):
+                tag = tag[1:]
+        # RFC 2782 ordering puts service first: _web._tcp → head is
+        # [_web, _tcp] so swap after underscore stripping.
+        if tag and head[0].startswith("_"):
+            service, tag = head[0][1:], head[1].lstrip("_")
+            if tag == "tcp" or tag == "udp":
+                tag = None
+        body = {"service": service, "allow_stale": True,
+                "passing_only": self.only_passing}
+        if tag:
+            body["tag"] = tag
+        out = await self.agent.rpc("Health.ServiceNodes", body)
+        rows = out.get("nodes") or []
+        if not rows:
+            raise LookupError(service)
+        self._rng.shuffle(rows)
+        rows = rows[:MAX_ANSWERS]
+        recs = []
+        for row in rows:
+            svc = row["service"]
+            ip = svc.get("address") or svc.get("node_address") or ""
+            if q.qtype == TYPE_SRV:
+                target = f"{svc['node']}.node.{self.domain}."
+                recs.append(DNSRecord(
+                    q.name, TYPE_SRV, self.node_ttl,
+                    _rd_srv(1, 1, int(svc.get("port", 0)), target),
+                ))
+                recs.append(DNSRecord(
+                    target.rstrip("."), TYPE_A, self.node_ttl, _rd_a(ip)
+                ))
+            else:
+                recs.append(DNSRecord(q.name, TYPE_A, self.node_ttl,
+                                      _rd_a(ip)))
+        return recs
+
+    async def _query_lookup(self, core: list[str], q: DNSQuestion) -> list[DNSRecord]:
+        """<name-or-id>.query.consul (dns.go preparedQueryLookup)."""
+        name = ".".join(core[:-1])
+        out = await self.agent.rpc(
+            "PreparedQuery.Execute", {"query_id": name, "allow_stale": True}
+        )
+        if out.get("error"):
+            raise LookupError(name)
+        rows = out.get("nodes") or []
+        if not rows:
+            raise LookupError(name)
+        self._rng.shuffle(rows)
+        recs = []
+        for row in rows[:MAX_ANSWERS]:
+            svc = row["service"]
+            ip = svc.get("address") or svc.get("node_address") or ""
+            if q.qtype == TYPE_SRV:
+                target = f"{svc['node']}.node.{self.domain}."
+                recs.append(DNSRecord(
+                    q.name, TYPE_SRV, self.node_ttl,
+                    _rd_srv(1, 1, int(svc.get("port", 0)), target),
+                ))
+                recs.append(DNSRecord(
+                    target.rstrip("."), TYPE_A, self.node_ttl, _rd_a(ip)
+                ))
+            else:
+                recs.append(DNSRecord(q.name, TYPE_A, self.node_ttl,
+                                      _rd_a(ip)))
+        return recs

@@ -1,0 +1,758 @@
+"""The HTTP API: ``/v1/...`` endpoints over a hand-rolled asyncio
+HTTP/1.1 server (no web framework in the image).
+
+Equivalent of ``agent/http.go`` + the ``agent/*_endpoint.go`` handlers
+registered in ``http_register.go:1-125``.  Behaviors kept from the
+reference:
+
+  blocking queries    ?index=N&wait=10s → min_query_index/max_query_time;
+                      results carry X-Consul-Index /
+                      X-Consul-KnownLeader / X-Consul-LastContact
+                      (http.go setMeta)
+  consistency modes   ?stale / ?consistent (http.go parseConsistency)
+  KV flags            ?recurse ?keys ?separator ?raw ?cas ?flags
+                      ?acquire ?release (kvs_endpoint.go)
+  JSON shape          CamelCase keys with ID/TTL/... acronyms upper-cased
+                      (structs' JSON tags); KV Value base64-encoded
+  errors              405 with Allow header, 404 unknown route,
+                      400 malformed input, 500 with error text
+
+The server binds a plain TCP port; send requests with any HTTP client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import re
+import urllib.parse
+from typing import Any, Callable, Optional
+
+from consul_tpu.agent.agent import Agent
+from consul_tpu.agent.rpc import RPCError
+from consul_tpu.agent.server import _parse_ttl
+from consul_tpu.version import __version__
+
+log = logging.getLogger("consul_tpu.http")
+
+_ACRONYMS = {
+    "Id": "ID", "Ttl": "TTL", "Dns": "DNS", "Http": "HTTP", "Tcp": "TCP",
+    "Rpc": "RPC", "Wan": "WAN", "Lan": "LAN", "Cas": "CAS", "Acl": "ACL",
+}
+
+
+def _camel_key(key: str) -> str:
+    parts = [p.capitalize() for p in key.split("_")]
+    parts = [_ACRONYMS.get(p, p) for p in parts]
+    return "".join(parts)
+
+
+class KeyedMap(dict):
+    """A dict whose keys are DATA (service names, check ids, kv keys),
+    not struct fields — camelize leaves the keys alone."""
+
+
+def camelize(obj: Any) -> Any:
+    """snake_case dict keys → the reference's CamelCase JSON shape."""
+    if isinstance(obj, KeyedMap):
+        return {k: camelize(v) for k, v in obj.items()}
+    if isinstance(obj, dict):
+        return {_camel_key(k): camelize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [camelize(v) for v in obj]
+    if isinstance(obj, bytes):
+        return base64.b64encode(obj).decode()
+    return obj
+
+
+class HTTPRequest:
+    def __init__(self, method: str, path: str, query: dict, headers: dict,
+                 body: bytes):
+        self.method = method
+        self.path = path
+        self.query = query  # first-value dict
+        self.headers = headers
+        self.body = body
+
+    def flag(self, name: str) -> bool:
+        """?stale style presence flag (http.go parseQuery)."""
+        return name in self.query
+
+    def json(self) -> Any:
+        if not self.body:
+            return {}
+        return json.loads(self.body)
+
+    def query_options(self) -> dict:
+        """Blocking/consistency params → RPC body fields
+        (http.go parseWait/parseConsistency)."""
+        opts: dict = {}
+        if "index" in self.query:
+            opts["min_query_index"] = int(self.query["index"])
+        if "wait" in self.query:
+            opts["max_query_time"] = _parse_ttl(self.query["wait"])
+        if self.flag("stale"):
+            opts["allow_stale"] = True
+        if self.flag("consistent"):
+            opts["require_consistent"] = True
+        return opts
+
+
+class HTTPResponse:
+    def __init__(self, status: int = 200, body: Any = None,
+                 headers: Optional[dict] = None, raw: Optional[bytes] = None):
+        self.status = status
+        self.body = body
+        self.headers = headers or {}
+        self.raw = raw
+
+
+def _meta_headers(meta: Optional[dict]) -> dict:
+    if not meta:
+        return {}
+    return {
+        "X-Consul-Index": str(meta.get("index", 0)),
+        "X-Consul-KnownLeader": "true" if meta.get("known_leader", True) else "false",
+        "X-Consul-LastContact": str(int(meta.get("last_contact", 0))),
+    }
+
+
+class HTTPApi:
+    """Routing + handlers (http.go:105-115 wrap/handle)."""
+
+    def __init__(self, agent: Agent):
+        self.agent = agent
+        # (method, regex) -> handler(req, match) routes, first match wins.
+        self.routes: list[tuple[str, re.Pattern, Callable]] = []
+        self._register_routes()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.addr = ""
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(self._handle_conn, host, port)
+        h, p = self._server.sockets[0].getsockname()[:2]
+        self.addr = f"{h}:{p}"
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- HTTP plumbing --------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                resp = await self._dispatch(req)
+                await self._write_response(writer, req, resp)
+                if req.headers.get("connection", "").lower() == "close":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("http connection handler failed")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _read_request(self, reader) -> Optional[HTTPRequest]:
+        try:
+            request_line = await reader.readline()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return None
+        if not request_line:
+            return None
+        try:
+            method, target, _version = request_line.decode().split()
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode().partition(":")
+            headers[name.strip().lower()] = value.strip()
+        body = b""
+        if "content-length" in headers:
+            body = await reader.readexactly(int(headers["content-length"]))
+        parsed = urllib.parse.urlsplit(target)
+        query = {
+            k: v[0] for k, v in urllib.parse.parse_qs(
+                parsed.query, keep_blank_values=True
+            ).items()
+        }
+        # Go's net/http serves the decoded URL.Path; %2F in a KV key
+        # must reach the store as '/'.
+        path = urllib.parse.unquote(parsed.path)
+        return HTTPRequest(method, path, query, headers, body)
+
+    async def _write_response(self, writer, req: HTTPRequest,
+                              resp: HTTPResponse) -> None:
+        if resp.raw is not None:
+            payload = resp.raw
+            ctype = "application/octet-stream"
+        else:
+            out = camelize(resp.body)
+            indent = 4 if req.flag("pretty") else None
+            payload = (json.dumps(out, indent=indent) + "\n").encode()
+            ctype = "application/json"
+        status_text = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                       405: "Method Not Allowed",
+                       500: "Internal Server Error"}.get(resp.status, "OK")
+        head = [f"HTTP/1.1 {resp.status} {status_text}",
+                f"Content-Type: {ctype}",
+                f"Content-Length: {len(payload)}"]
+        for k, v in resp.headers.items():
+            head.append(f"{k}: {v}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
+        await writer.drain()
+
+    async def _dispatch(self, req: HTTPRequest) -> HTTPResponse:
+        path_matched = False
+        for method, pattern, handler in self.routes:
+            m = pattern.match(req.path)
+            if not m:
+                continue
+            path_matched = True
+            if method != req.method:
+                continue
+            try:
+                return await handler(req, m)
+            except RPCError as e:
+                return HTTPResponse(500, {"error": str(e)})
+            except (ValueError, KeyError, json.JSONDecodeError) as e:
+                return HTTPResponse(400, {"error": f"{type(e).__name__}: {e}"})
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                log.exception("handler error for %s %s", req.method, req.path)
+                return HTTPResponse(500, {"error": str(e)})
+        if path_matched:
+            allowed = sorted({m for m, p, _ in self.routes if p.match(req.path)})
+            return HTTPResponse(405, {"error": "method not allowed"},
+                                headers={"Allow": ", ".join(allowed)})
+        return HTTPResponse(404, {"error": f"no handler for {req.path}"})
+
+    # -- route table (http_register.go) --------------------------------
+
+    def _route(self, method: str, pattern: str, handler: Callable) -> None:
+        self.routes.append((method, re.compile(pattern + r"$"), handler))
+
+    def _register_routes(self) -> None:
+        r = self._route
+        # status
+        r("GET", r"/v1/status/leader", self.status_leader)
+        r("GET", r"/v1/status/peers", self.status_peers)
+        # agent
+        r("GET", r"/v1/agent/self", self.agent_self)
+        r("GET", r"/v1/agent/members", self.agent_members)
+        r("GET", r"/v1/agent/services", self.agent_services)
+        r("GET", r"/v1/agent/checks", self.agent_checks)
+        r("PUT", r"/v1/agent/join/(?P<addr>.+)", self.agent_join)
+        r("PUT", r"/v1/agent/leave", self.agent_leave)
+        r("PUT", r"/v1/agent/service/register", self.agent_service_register)
+        r("PUT", r"/v1/agent/service/deregister/(?P<sid>.+)",
+          self.agent_service_deregister)
+        r("PUT", r"/v1/agent/check/register", self.agent_check_register)
+        r("PUT", r"/v1/agent/check/deregister/(?P<cid>.+)",
+          self.agent_check_deregister)
+        r("PUT", r"/v1/agent/check/pass/(?P<cid>.+)", self.agent_check_pass)
+        r("PUT", r"/v1/agent/check/warn/(?P<cid>.+)", self.agent_check_warn)
+        r("PUT", r"/v1/agent/check/fail/(?P<cid>.+)", self.agent_check_fail)
+        # catalog
+        r("GET", r"/v1/catalog/datacenters", self.catalog_datacenters)
+        r("GET", r"/v1/catalog/nodes", self.catalog_nodes)
+        r("GET", r"/v1/catalog/services", self.catalog_services)
+        r("GET", r"/v1/catalog/service/(?P<svc>.+)", self.catalog_service)
+        r("GET", r"/v1/catalog/node/(?P<node>.+)", self.catalog_node)
+        r("PUT", r"/v1/catalog/register", self.catalog_register)
+        r("PUT", r"/v1/catalog/deregister", self.catalog_deregister)
+        # health
+        r("GET", r"/v1/health/node/(?P<node>.+)", self.health_node)
+        r("GET", r"/v1/health/checks/(?P<svc>.+)", self.health_checks)
+        r("GET", r"/v1/health/service/(?P<svc>.+)", self.health_service)
+        r("GET", r"/v1/health/state/(?P<state>.+)", self.health_state)
+        # kv
+        r("GET", r"/v1/kv/(?P<key>.*)", self.kv_get)
+        r("PUT", r"/v1/kv/(?P<key>.*)", self.kv_put)
+        r("DELETE", r"/v1/kv/(?P<key>.*)", self.kv_delete)
+        # sessions
+        r("PUT", r"/v1/session/create", self.session_create)
+        r("PUT", r"/v1/session/destroy/(?P<sid>.+)", self.session_destroy)
+        r("PUT", r"/v1/session/renew/(?P<sid>.+)", self.session_renew)
+        r("GET", r"/v1/session/info/(?P<sid>.+)", self.session_info)
+        r("GET", r"/v1/session/node/(?P<node>.+)", self.session_node)
+        r("GET", r"/v1/session/list", self.session_list)
+        # events
+        r("PUT", r"/v1/event/fire/(?P<name>.+)", self.event_fire)
+        r("GET", r"/v1/event/list", self.event_list)
+        # coordinates
+        r("GET", r"/v1/coordinate/nodes", self.coordinate_nodes)
+        r("GET", r"/v1/coordinate/node/(?P<node>.+)", self.coordinate_node)
+        # prepared queries
+        r("POST", r"/v1/query", self.query_create)
+        r("GET", r"/v1/query/(?P<qid>[^/]+)/execute", self.query_execute)
+        r("GET", r"/v1/query/(?P<qid>[^/]+)", self.query_get)
+        r("PUT", r"/v1/query/(?P<qid>[^/]+)", self.query_update)
+        r("DELETE", r"/v1/query/(?P<qid>[^/]+)", self.query_delete)
+        r("GET", r"/v1/query", self.query_list)
+        # txn
+        r("PUT", r"/v1/txn", self.txn)
+        # config entries
+        r("PUT", r"/v1/config", self.config_apply)
+        r("GET", r"/v1/config/(?P<kind>[^/]+)/(?P<name>.+)", self.config_get)
+        r("GET", r"/v1/config/(?P<kind>[^/]+)", self.config_list)
+        r("DELETE", r"/v1/config/(?P<kind>[^/]+)/(?P<name>.+)",
+          self.config_delete)
+        # operator
+        r("GET", r"/v1/operator/raft/configuration", self.operator_raft)
+        r("GET", r"/v1/operator/autopilot/health", self.operator_health)
+
+    # -- helpers --------------------------------------------------------
+
+    async def _rpc_read(self, req: HTTPRequest, method: str, body: dict,
+                        key: str, unwrap_single: bool = False) -> HTTPResponse:
+        body.update(req.query_options())
+        out = await self.agent.rpc(method, body)
+        meta = out.get("meta")
+        data = out.get(key)
+        if unwrap_single:
+            data = data[0] if data else None
+            if data is None:
+                return HTTPResponse(404, None, headers=_meta_headers(meta))
+        return HTTPResponse(200, data, headers=_meta_headers(meta))
+
+    # -- status ---------------------------------------------------------
+
+    async def status_leader(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Status.Leader", {})
+        return HTTPResponse(200, out.get("leader", ""))
+
+    async def status_peers(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Status.Peers", {})
+        return HTTPResponse(200, [p["addr"] for p in out.get("peers", [])])
+
+    # -- agent ----------------------------------------------------------
+
+    async def agent_self(self, req, m) -> HTTPResponse:
+        cfg = self.agent.config
+        return HTTPResponse(200, {
+            "config": {
+                "datacenter": cfg.datacenter,
+                "node_name": cfg.node_name,
+                "server": cfg.server,
+                "version": __version__,
+            },
+            "member": {
+                "name": cfg.node_name,
+                "addr": self.agent.serf.memberlist.transport.local_addr(),
+                "tags": self.agent.serf.config.tags,
+            },
+        })
+
+    async def agent_members(self, req, m) -> HTTPResponse:
+        members = [
+            {
+                "name": mem.name,
+                "addr": mem.addr,
+                "tags": mem.tags,
+                "status": int(mem.status),
+            }
+            for mem in self.agent.serf.members.values()
+        ]
+        return HTTPResponse(200, members)
+
+    async def agent_services(self, req, m) -> HTTPResponse:
+        return HTTPResponse(200, KeyedMap({
+            e.service["id"]: e.service for e in
+            self.agent.local.services.values() if not e.deleted
+        }))
+
+    async def agent_checks(self, req, m) -> HTTPResponse:
+        return HTTPResponse(200, KeyedMap({
+            e.check["check_id"]: e.check for e in
+            self.agent.local.checks.values() if not e.deleted
+        }))
+
+    async def agent_join(self, req, m) -> HTTPResponse:
+        n = await self.agent.join([m.group("addr")])
+        return HTTPResponse(200, {"num_joined": n})
+
+    async def agent_leave(self, req, m) -> HTTPResponse:
+        await self.agent.leave()
+        return HTTPResponse(200, {})
+
+    async def agent_service_register(self, req, m) -> HTTPResponse:
+        defn = _decamelize(req.json())
+        checks = defn.pop("checks", None) or (
+            [defn.pop("check")] if defn.get("check") else []
+        )
+        svc = {k: v for k, v in defn.items()
+               if k in ("id", "service", "name", "tags", "port", "address",
+                        "meta")}
+        if "name" in svc:
+            svc["service"] = svc.pop("name")
+        self.agent.add_service(svc, checks)
+        return HTTPResponse(200, {})
+
+    async def agent_service_deregister(self, req, m) -> HTTPResponse:
+        self.agent.remove_service(m.group("sid"))
+        return HTTPResponse(200, {})
+
+    async def agent_check_register(self, req, m) -> HTTPResponse:
+        defn = _decamelize(req.json())
+        if "name" in defn and "check_id" not in defn:
+            defn["check_id"] = defn["name"]
+        self.agent.add_check(defn)
+        return HTTPResponse(200, {})
+
+    async def agent_check_deregister(self, req, m) -> HTTPResponse:
+        self.agent.remove_check(m.group("cid"))
+        return HTTPResponse(200, {})
+
+    async def _ttl_update(self, req, m, status: str) -> HTTPResponse:
+        note = req.query.get("note", "")
+        if not self.agent.update_ttl_check(m.group("cid"), status, note):
+            return HTTPResponse(404, {"error": "unknown TTL check"})
+        return HTTPResponse(200, {})
+
+    async def agent_check_pass(self, req, m) -> HTTPResponse:
+        return await self._ttl_update(req, m, "passing")
+
+    async def agent_check_warn(self, req, m) -> HTTPResponse:
+        return await self._ttl_update(req, m, "warning")
+
+    async def agent_check_fail(self, req, m) -> HTTPResponse:
+        return await self._ttl_update(req, m, "critical")
+
+    # -- catalog ---------------------------------------------------------
+
+    async def catalog_datacenters(self, req, m) -> HTTPResponse:
+        return HTTPResponse(200, [self.agent.config.datacenter])
+
+    async def catalog_nodes(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(req, "Catalog.ListNodes", {}, "nodes")
+
+    async def catalog_services(self, req, m) -> HTTPResponse:
+        body: dict = {}
+        body.update(req.query_options())
+        out = await self.agent.rpc("Catalog.ListServices", body)
+        return HTTPResponse(200, KeyedMap(out.get("services") or {}),
+                            headers=_meta_headers(out.get("meta")))
+
+    async def catalog_service(self, req, m) -> HTTPResponse:
+        body = {"service": m.group("svc")}
+        if "tag" in req.query:
+            body["tag"] = req.query["tag"]
+        return await self._rpc_read(req, "Catalog.ServiceNodes", body, "nodes")
+
+    async def catalog_node(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "Internal.NodeInfo", {"node": m.group("node")}, "dump",
+            unwrap_single=True,
+        )
+
+    async def catalog_register(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Catalog.Register", _decamelize(req.json()))
+        return HTTPResponse(200, out.get("result", True))
+
+    async def catalog_deregister(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Catalog.Deregister", _decamelize(req.json()))
+        return HTTPResponse(200, out.get("result", True))
+
+    # -- health ----------------------------------------------------------
+
+    async def health_node(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "Health.NodeChecks", {"node": m.group("node")}, "checks"
+        )
+
+    async def health_checks(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "Health.ServiceChecks", {"service": m.group("svc")}, "checks"
+        )
+
+    async def health_service(self, req, m) -> HTTPResponse:
+        body = {"service": m.group("svc"),
+                "passing_only": req.flag("passing")}
+        if "tag" in req.query:
+            body["tag"] = req.query["tag"]
+        return await self._rpc_read(req, "Health.ServiceNodes", body, "nodes")
+
+    async def health_state(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "Health.ChecksInState", {"state": m.group("state")}, "checks"
+        )
+
+    # -- kv --------------------------------------------------------------
+
+    async def kv_get(self, req, m) -> HTTPResponse:
+        key = m.group("key")
+        body: dict = {"key": key}
+        body.update(req.query_options())
+        if req.flag("keys"):
+            body["separator"] = req.query.get("separator", "")
+            out = await self.agent.rpc("KVS.ListKeys", body)
+            return HTTPResponse(200, out.get("keys", []),
+                                headers=_meta_headers(out.get("meta")))
+        method = "KVS.List" if req.flag("recurse") else "KVS.Get"
+        out = await self.agent.rpc(method, body)
+        entries = out.get("entries", [])
+        if not entries:
+            return HTTPResponse(404, None,
+                                headers=_meta_headers(out.get("meta")))
+        if req.flag("raw") and not req.flag("recurse"):
+            return HTTPResponse(200, None, raw=entries[0].get("value", b""),
+                                headers=_meta_headers(out.get("meta")))
+        return HTTPResponse(200, entries, headers=_meta_headers(out.get("meta")))
+
+    async def kv_put(self, req, m) -> HTTPResponse:
+        key = m.group("key")
+        entry: dict = {"key": key, "value": req.body,
+                       "flags": int(req.query.get("flags", 0))}
+        if "acquire" in req.query:
+            op = "lock"
+            entry["session"] = req.query["acquire"]
+        elif "release" in req.query:
+            op = "unlock"
+            entry["session"] = req.query["release"]
+        elif "cas" in req.query:
+            op = "cas"
+            entry["modify_index"] = int(req.query["cas"])
+        else:
+            op = "set"
+        out = await self.agent.rpc("KVS.Apply", {"op": op, "entry": entry})
+        result = out.get("result")
+        return HTTPResponse(200, True if result is True or op == "set" else result)
+
+    async def kv_delete(self, req, m) -> HTTPResponse:
+        key = m.group("key")
+        if req.flag("recurse"):
+            body = {"op": "delete-tree", "entry": {"key": key}}
+        elif "cas" in req.query:
+            body = {"op": "delete-cas",
+                    "entry": {"key": key,
+                              "modify_index": int(req.query["cas"])}}
+        else:
+            body = {"op": "delete", "entry": {"key": key}}
+        out = await self.agent.rpc("KVS.Apply", body)
+        result = out.get("result")
+        return HTTPResponse(200, result if isinstance(result, bool) else True)
+
+    # -- sessions ---------------------------------------------------------
+
+    async def session_create(self, req, m) -> HTTPResponse:
+        sess = _decamelize(req.json())
+        sess.setdefault("node", self.agent.config.node_name)
+        out = await self.agent.rpc("Session.Apply",
+                                   {"op": "create", "session": sess})
+        return HTTPResponse(200, {"id": out["result"]})
+
+    async def session_destroy(self, req, m) -> HTTPResponse:
+        await self.agent.rpc("Session.Apply", {
+            "op": "destroy", "session": {"id": m.group("sid")},
+        })
+        return HTTPResponse(200, True)
+
+    async def session_renew(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Session.Renew", {"id": m.group("sid")})
+        sessions = out.get("sessions", [])
+        if not sessions:
+            return HTTPResponse(404, {"error": "session not found"})
+        return HTTPResponse(200, sessions)
+
+    async def session_info(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "Session.Get", {"id": m.group("sid")}, "sessions"
+        )
+
+    async def session_node(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "Session.NodeSessions", {"node": m.group("node")}, "sessions"
+        )
+
+    async def session_list(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(req, "Session.List", {}, "sessions")
+
+    # -- events -----------------------------------------------------------
+
+    async def event_fire(self, req, m) -> HTTPResponse:
+        eid = await self.agent.fire_event(m.group("name"), req.body)
+        return HTTPResponse(200, {"id": eid, "name": m.group("name")})
+
+    async def event_list(self, req, m) -> HTTPResponse:
+        """Supports blocking on new events via ?index&wait
+        (event_endpoint.go eventList long-poll)."""
+        name = req.query.get("name")
+        min_index = int(req.query.get("index", 0))
+        if min_index:
+            wait = _parse_ttl(req.query.get("wait", "")) or 300.0
+            deadline = asyncio.get_running_loop().time() + wait
+            while self.agent.event_index <= min_index:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    break
+                handle = self.agent.event_wake_handle()
+                try:
+                    await asyncio.wait_for(handle.wait(), remaining)
+                except asyncio.TimeoutError:
+                    break
+        events = [
+            {"id": e.id, "name": e.name, "payload": e.payload,
+             "l_time": e.ltime}
+            for e in self.agent.events
+            if name is None or e.name == name
+        ]
+        return HTTPResponse(
+            200, events,
+            headers={"X-Consul-Index": str(self.agent.event_index)},
+        )
+
+    # -- coordinates -------------------------------------------------------
+
+    async def coordinate_nodes(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(req, "Coordinate.ListNodes", {},
+                                    "coordinates")
+
+    async def coordinate_node(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Coordinate.Node",
+                                   {"node": m.group("node")})
+        coord = out.get("coord")
+        if coord is None:
+            return HTTPResponse(404, None)
+        return HTTPResponse(200, [{"node": m.group("node"), "coord": coord}])
+
+    # -- prepared queries ---------------------------------------------------
+
+    async def query_create(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("PreparedQuery.Apply", {
+            "op": "create", "query": _decamelize(req.json()),
+        })
+        return HTTPResponse(200, {"id": out["result"]})
+
+    async def query_get(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "PreparedQuery.Get", {"id": m.group("qid")}, "queries"
+        )
+
+    async def query_update(self, req, m) -> HTTPResponse:
+        q = _decamelize(req.json())
+        q["id"] = m.group("qid")
+        await self.agent.rpc("PreparedQuery.Apply", {"op": "update", "query": q})
+        return HTTPResponse(200, {})
+
+    async def query_delete(self, req, m) -> HTTPResponse:
+        await self.agent.rpc("PreparedQuery.Apply", {
+            "op": "delete", "query": {"id": m.group("qid")},
+        })
+        return HTTPResponse(200, {})
+
+    async def query_list(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(req, "PreparedQuery.List", {}, "queries")
+
+    async def query_execute(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("PreparedQuery.Execute",
+                                   {"query_id": m.group("qid")})
+        if out.get("error"):
+            return HTTPResponse(404, {"error": out["error"]})
+        return HTTPResponse(200, {"nodes": out["nodes"],
+                                  "service": out["service"]},
+                            headers=_meta_headers(out.get("meta")))
+
+    # -- txn ----------------------------------------------------------------
+
+    async def txn(self, req, m) -> HTTPResponse:
+        raw_ops = req.json()
+        ops = []
+        for op in raw_ops:
+            op = _decamelize(op)
+            kv = op.get("kv")
+            if kv and isinstance(kv.get("value"), str):
+                kv = dict(kv)
+                kv_entry = {k: v for k, v in kv.items() if k != "verb"}
+                kv_entry["value"] = base64.b64decode(kv["value"])
+                op = {"kv": {"verb": kv["verb"], "entry": kv_entry}}
+            elif kv and "entry" not in kv:
+                op = {"kv": {"verb": kv.pop("verb"), "entry": kv}}
+            ops.append(op)
+        out = await self.agent.rpc("Txn.Apply", {"ops": ops})
+        result = out.get("result", out)
+        status = 200 if not result.get("errors") else 409
+        return HTTPResponse(status, result)
+
+    # -- config entries ------------------------------------------------------
+
+    async def config_apply(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ConfigEntry.Apply", {
+            "op": "set", "entry": _decamelize(req.json()),
+        })
+        return HTTPResponse(200, out.get("result", True))
+
+    async def config_get(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ConfigEntry.Get", {
+            "kind": m.group("kind"), "name": m.group("name"),
+            **req.query_options(),
+        })
+        if out.get("entry") is None:
+            return HTTPResponse(404, None,
+                                headers=_meta_headers(out.get("meta")))
+        return HTTPResponse(200, out["entry"],
+                            headers=_meta_headers(out.get("meta")))
+
+    async def config_list(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(
+            req, "ConfigEntry.List", {"kind": m.group("kind")}, "entries"
+        )
+
+    async def config_delete(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ConfigEntry.Apply", {
+            "op": "delete",
+            "entry": {"kind": m.group("kind"), "name": m.group("name")},
+        })
+        return HTTPResponse(200, out.get("result", True))
+
+    # -- operator ------------------------------------------------------------
+
+    async def operator_raft(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Operator.RaftGetConfiguration", {})
+        return HTTPResponse(200, out)
+
+    async def operator_health(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Operator.ServerHealth", {})
+        return HTTPResponse(200, out)
+
+
+_CAMEL_SPLIT = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def _decamelize(obj: Any) -> Any:
+    """CamelCase request JSON → snake_case bodies; ID/TTL handled."""
+    if isinstance(obj, dict):
+        return {_snake_key(k): _decamelize(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decamelize(v) for v in obj]
+    return obj
+
+
+def _snake_key(key: str) -> str:
+    for acro, camel in (("ID", "Id"), ("TTL", "Ttl"), ("DNS", "Dns"),
+                        ("HTTP", "Http"), ("TCP", "Tcp")):
+        key = key.replace(acro, camel)
+    return _CAMEL_SPLIT.sub("_", key).lower()

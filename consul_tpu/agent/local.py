@@ -209,13 +209,17 @@ class LocalState:
         for sid, entry in list(self.services.items()):
             if entry.deleted:
                 await self._deregister(service_id=sid)
-                del self.services[sid]
+                # The id may have been re-registered while the RPC was
+                # in flight — only drop the entry we deregistered.
+                if self.services.get(sid) is entry:
+                    del self.services[sid]
             elif not entry.in_sync:
                 await self._register_service(entry)
         for cid, entry in list(self.checks.items()):
             if entry.deleted:
                 await self._deregister(check_id=cid)
-                del self.checks[cid]
+                if self.checks.get(cid) is entry:
+                    del self.checks[cid]
             elif not entry.in_sync:
                 await self._register_check(entry)
 

@@ -303,6 +303,11 @@ class RPCServer:
             for t in pending:
                 t.cancel()
 
+    async def dispatch_local(self, method: str, body: dict) -> Any:
+        """In-process dispatch to a registered endpoint — the server
+        agent's own RPC entry point (no wire round-trip)."""
+        return await self._dispatch_consul(method, body)
+
     async def _dispatch_consul(self, method: str, body: dict) -> Any:
         service, _, verb = method.partition(".")
         endpoint = self._endpoints.get(service)

@@ -1,0 +1,371 @@
+"""HTTP API + DNS interface tests.
+
+Parity model: ``agent/http_test.go`` / ``agent/kvs_endpoint_test.go``
+(status codes, blocking headers, KV flags) and ``agent/dns_test.go``
+(node/service/SRV lookups, NXDOMAIN, only-passing filtering).
+"""
+
+import asyncio
+import base64
+import contextlib
+import json
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.agent.agent import Agent, AgentConfig
+from consul_tpu.agent.dns import (
+    DNSServer,
+    TYPE_A,
+    TYPE_SRV,
+    build_query,
+    parse_response,
+)
+from consul_tpu.agent.http import HTTPApi
+from consul_tpu.net.transport import InMemoryNetwork
+
+
+async def http_call(addr, method, path, body=b"", headers=None):
+    """Minimal HTTP/1.1 client: returns (status, headers, parsed-json|bytes)."""
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.open_connection(host, int(port))
+    lines = [f"{method} {path} HTTP/1.1", f"Host: {host}",
+             f"Content-Length: {len(body)}", "Connection: close"]
+    for k, v in (headers or {}).items():
+        lines.append(f"{k}: {v}")
+    writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    head_lines = head.decode().split("\r\n")
+    status = int(head_lines[0].split()[1])
+    hdrs = {}
+    for line in head_lines[1:]:
+        k, _, v = line.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    if hdrs.get("content-type", "").startswith("application/json"):
+        data = json.loads(payload) if payload.strip() else None
+    else:
+        data = payload
+    return status, hdrs, data
+
+
+@contextlib.asynccontextmanager
+async def dev_stack():
+    """One dev-mode server agent with HTTP + DNS attached (the
+    ``consul agent -dev`` analogue)."""
+    net = InMemoryNetwork()
+    agent = Agent(
+        AgentConfig(node_name="dev", bootstrap_expect=1,
+                    gossip_interval_scale=0.05, sync_interval_s=0.3,
+                    sync_retry_interval_s=0.2, reconcile_interval_s=0.2),
+        gossip_transport=net.new_transport("dev:gossip"),
+        rpc_transport=net.new_transport("dev:rpc"),
+    )
+    await agent.start()
+    await wait_until(lambda: agent.delegate.is_leader(), msg="leader")
+    api = HTTPApi(agent)
+    addr = await api.start()
+    dns = DNSServer(agent)
+    dns_addr = await dns.start()
+    try:
+        yield agent, addr, dns, dns_addr
+    finally:
+        await api.stop()
+        await dns.stop()
+        await agent.shutdown()
+
+
+async def dns_query(dns_addr, name, qtype=TYPE_A):
+    host, port = dns_addr.rsplit(":", 1)
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(build_query(7, name, qtype))
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=(host, int(port))
+    )
+    try:
+        raw = await asyncio.wait_for(fut, 5)
+    finally:
+        transport.close()
+    return parse_response(raw)
+
+
+class TestHTTPKV:
+    async def test_put_get_delete_roundtrip(self):
+        async with dev_stack() as (_, addr, _, _):
+            status, _, ok = await http_call(addr, "PUT", "/v1/kv/app/config",
+                                            b"hello")
+            assert status == 200 and ok is True
+            status, hdrs, data = await http_call(addr, "GET", "/v1/kv/app/config")
+            assert status == 200
+            assert int(hdrs["x-consul-index"]) >= 1
+            assert base64.b64decode(data[0]["Value"]) == b"hello"
+            assert data[0]["Key"] == "app/config"
+
+            status, _, raw = await http_call(addr, "GET", "/v1/kv/app/config?raw")
+            assert status == 200 and raw == b"hello"
+
+            status, _, _ = await http_call(addr, "DELETE", "/v1/kv/app/config")
+            assert status == 200
+            status, _, _ = await http_call(addr, "GET", "/v1/kv/app/config")
+            assert status == 404
+
+    async def test_recurse_keys_and_cas(self):
+        async with dev_stack() as (_, addr, _, _):
+            for k, v in [("a/1", b"x"), ("a/2", b"y"), ("b/1", b"z")]:
+                await http_call(addr, "PUT", f"/v1/kv/{k}", v)
+            status, _, data = await http_call(addr, "GET", "/v1/kv/a?recurse")
+            assert status == 200 and [e["Key"] for e in data] == ["a/1", "a/2"]
+            status, _, keys = await http_call(addr, "GET",
+                                              "/v1/kv/?keys&separator=/")
+            assert status == 200 and keys == ["a/", "b/"]
+
+            _, _, entry = await http_call(addr, "GET", "/v1/kv/a/1")
+            idx = entry[0]["ModifyIndex"]
+            status, _, ok = await http_call(addr, "PUT", f"/v1/kv/a/1?cas={idx}",
+                                            b"new")
+            assert ok is True
+            status, _, ok = await http_call(addr, "PUT", "/v1/kv/a/1?cas=1",
+                                            b"stale")
+            assert ok is False
+
+    async def test_percent_encoded_key(self):
+        # Standard clients encode '/' in keys as %2F; the server must
+        # decode the path like Go's net/http does.
+        async with dev_stack() as (_, addr, _, _):
+            status, _, ok = await http_call(addr, "PUT",
+                                            "/v1/kv/app%2Fconfig", b"v")
+            assert status == 200 and ok is True
+            status, _, data = await http_call(addr, "GET", "/v1/kv/app/config")
+            assert status == 200 and data[0]["Key"] == "app/config"
+
+    async def test_blocking_query_via_http(self):
+        async with dev_stack() as (_, addr, _, _):
+            await http_call(addr, "PUT", "/v1/kv/watch", b"v1")
+            _, hdrs, _ = await http_call(addr, "GET", "/v1/kv/watch")
+            idx = hdrs["x-consul-index"]
+
+            async def blocked():
+                return await http_call(
+                    addr, "GET", f"/v1/kv/watch?index={idx}&wait=5s"
+                )
+
+            task = asyncio.create_task(blocked())
+            await asyncio.sleep(0.1)
+            assert not task.done()
+            await http_call(addr, "PUT", "/v1/kv/watch", b"v2")
+            status, hdrs2, data = await asyncio.wait_for(task, 5)
+            assert base64.b64decode(data[0]["Value"]) == b"v2"
+            assert int(hdrs2["x-consul-index"]) > int(idx)
+
+
+class TestHTTPCatalogHealthAgent:
+    async def test_service_register_and_health(self):
+        async with dev_stack() as (agent, addr, _, _):
+            body = json.dumps({
+                "Name": "web", "Port": 8080, "Tags": ["v1"],
+                "Check": {"TTL": "10s"},
+            }).encode()
+            status, _, _ = await http_call(addr, "PUT",
+                                           "/v1/agent/service/register", body)
+            assert status == 200
+            status, _, _ = await http_call(addr, "PUT",
+                                           "/v1/agent/check/pass/service:web")
+            assert status == 200
+            await wait_until(
+                lambda: agent.delegate.store.service_nodes("web")[1],
+                msg="synced to catalog",
+            )
+            status, _, nodes = await http_call(addr, "GET",
+                                               "/v1/health/service/web?passing")
+            assert status == 200 and len(nodes) == 1
+            assert nodes[0]["Service"]["Port"] == 8080
+            status, _, svcs = await http_call(addr, "GET", "/v1/catalog/services")
+            assert "web" in svcs
+
+            status, _, data = await http_call(addr, "GET", "/v1/catalog/node/dev")
+            assert status == 200 and data["Node"]["Node"] == "dev"
+
+    async def test_status_and_members(self):
+        async with dev_stack() as (_, addr, _, _):
+            status, _, leader = await http_call(addr, "GET", "/v1/status/leader")
+            assert status == 200 and leader  # dev server is its own leader
+            status, _, members = await http_call(addr, "GET", "/v1/agent/members")
+            assert [m["Name"] for m in members] == ["dev"]
+            status, _, self_info = await http_call(addr, "GET", "/v1/agent/self")
+            assert self_info["Config"]["NodeName"] == "dev"
+
+    async def test_session_and_lock_over_http(self):
+        async with dev_stack() as (_, addr, _, _):
+            status, _, sess = await http_call(
+                addr, "PUT", "/v1/session/create",
+                json.dumps({"TTL": "10s"}).encode(),
+            )
+            assert status == 200
+            sid = sess["ID"]
+            status, _, ok = await http_call(
+                addr, "PUT", f"/v1/kv/locks/x?acquire={sid}", b"me")
+            assert ok is True
+            status, _, data = await http_call(addr, "GET", "/v1/kv/locks/x")
+            assert data[0]["Session"] == sid
+            status, _, ok = await http_call(
+                addr, "PUT", f"/v1/kv/locks/x?release={sid}", b"")
+            assert ok is True
+
+    async def test_txn_endpoint(self):
+        async with dev_stack() as (_, addr, _, _):
+            ops = [
+                {"KV": {"Verb": "set", "Key": "t/1",
+                        "Value": base64.b64encode(b"v").decode()}},
+                {"KV": {"Verb": "get", "Key": "t/1"}},
+            ]
+            status, _, out = await http_call(addr, "PUT", "/v1/txn",
+                                             json.dumps(ops).encode())
+            assert status == 200
+            assert out["Errors"] == []
+            assert len(out["Results"]) == 2
+
+    async def test_unknown_route_and_method(self):
+        async with dev_stack() as (_, addr, _, _):
+            status, _, _ = await http_call(addr, "GET", "/v1/nope")
+            assert status == 404
+            status, hdrs, _ = await http_call(addr, "DELETE", "/v1/status/leader")
+            assert status == 405 and "GET" in hdrs.get("allow", "")
+
+    async def test_event_fire_and_list(self):
+        async with dev_stack() as (agent, addr, _, _):
+            status, _, out = await http_call(addr, "PUT", "/v1/event/fire/deploy",
+                                             b"payload")
+            assert status == 200 and out["Name"] == "deploy"
+
+            async def listed():
+                _, _, events = await http_call(
+                    addr, "GET", "/v1/event/list?name=deploy"
+                )
+                return events
+
+            await wait_until(
+                lambda: listed(), msg="event propagated through serf loopback"
+            )
+            status, hdrs, events = await http_call(
+                addr, "GET", "/v1/event/list?name=deploy"
+            )
+            assert status == 200 and events
+            assert base64.b64decode(events[0]["Payload"]) == b"payload"
+            idx = int(hdrs["x-consul-index"])
+            assert idx >= 1
+
+            # Long-poll: blocks until the next event fires.
+            async def blocked():
+                return await http_call(
+                    addr, "GET", f"/v1/event/list?index={idx}&wait=5s"
+                )
+
+            task = asyncio.create_task(blocked())
+            await asyncio.sleep(0.1)
+            assert not task.done()
+            await http_call(addr, "PUT", "/v1/event/fire/deploy2", b"x")
+            status, hdrs2, events2 = await asyncio.wait_for(task, 5)
+            assert int(hdrs2["x-consul-index"]) > idx
+            assert any(e["Name"] == "deploy2" for e in events2)
+
+
+class TestDNS:
+    async def test_node_lookup(self):
+        async with dev_stack() as (agent, addr, dns, dns_addr):
+            await http_call(addr, "PUT", "/v1/catalog/register",
+                            json.dumps({"Node": "db-1",
+                                        "Address": "10.9.9.9"}).encode())
+            txid, rcode, answers = await dns_query(dns_addr, "db-1.node.consul")
+            assert txid == 7 and rcode == 0
+            assert answers[0].rtype == TYPE_A
+            assert bytes(answers[0].rdata) == bytes([10, 9, 9, 9])
+
+    async def test_service_lookup_filters_unhealthy(self):
+        async with dev_stack() as (agent, addr, dns, dns_addr):
+            reg = {
+                "Node": "web-1", "Address": "10.0.0.1",
+                "Service": {"Service": "web", "Port": 80},
+                "Checks": [{"CheckID": "web-alive", "ServiceID": "web",
+                            "Status": "passing"}],
+            }
+            await http_call(addr, "PUT", "/v1/catalog/register",
+                            json.dumps(reg).encode())
+            bad = {
+                "Node": "web-2", "Address": "10.0.0.2",
+                "Service": {"Service": "web", "Port": 80},
+                "Checks": [{"CheckID": "web-alive", "ServiceID": "web",
+                            "Status": "critical"}],
+            }
+            await http_call(addr, "PUT", "/v1/catalog/register",
+                            json.dumps(bad).encode())
+
+            _, rcode, answers = await dns_query(dns_addr, "web.service.consul")
+            assert rcode == 0
+            ips = {bytes(a.rdata) for a in answers if a.rtype == TYPE_A}
+            assert bytes([10, 0, 0, 1]) in ips
+            assert bytes([10, 0, 0, 2]) not in ips  # critical filtered
+
+    async def test_srv_records(self):
+        async with dev_stack() as (agent, addr, dns, dns_addr):
+            reg = {
+                "Node": "api-1", "Address": "10.1.0.1",
+                "Service": {"Service": "api", "Port": 9090},
+            }
+            await http_call(addr, "PUT", "/v1/catalog/register",
+                            json.dumps(reg).encode())
+            _, rcode, answers = await dns_query(dns_addr, "api.service.consul",
+                                                TYPE_SRV)
+            assert rcode == 0
+            srv = next(a for a in answers if a.rtype == TYPE_SRV)
+            import struct as _s
+
+            prio, weight, port = _s.unpack(">HHH", srv.rdata[:6])
+            assert port == 9090
+            extra_a = [a for a in answers if a.rtype == TYPE_A]
+            assert extra_a and extra_a[0].name.startswith("api-1.node")
+
+    async def test_nxdomain(self):
+        async with dev_stack() as (_, addr, _, dns_addr):
+            _, rcode, answers = await dns_query(dns_addr, "ghost.service.consul")
+            assert rcode == 3 and answers == []
+            _, rcode, _ = await dns_query(dns_addr, "example.com")
+            assert rcode == 3
+            # Label-boundary: a different zone sharing the suffix string
+            # is NOT ours.
+            await http_call(addr, "PUT", "/v1/catalog/register",
+                            json.dumps({"Node": "x", "Address": "10.0.0.9",
+                                        "Service": {"Service": "web"}}).encode())
+            _, rcode, _ = await dns_query(dns_addr, "web.service.notconsul")
+            assert rcode == 3
+            _, rcode, _ = await dns_query(dns_addr, "anythingconsul")
+            assert rcode == 3
+
+    async def test_prepared_query_lookup(self):
+        async with dev_stack() as (agent, addr, dns, dns_addr):
+            reg = {
+                "Node": "cache-1", "Address": "10.3.0.1",
+                "Service": {"Service": "cache", "Port": 6379},
+            }
+            await http_call(addr, "PUT", "/v1/catalog/register",
+                            json.dumps(reg).encode())
+            status, _, out = await http_call(
+                addr, "POST", "/v1/query",
+                json.dumps({"Name": "cache-q",
+                            "Service": {"Service": "cache"}}).encode(),
+            )
+            assert status == 200
+            _, rcode, answers = await dns_query(dns_addr, "cache-q.query.consul")
+            assert rcode == 0
+            assert bytes(answers[0].rdata) == bytes([10, 3, 0, 1])
