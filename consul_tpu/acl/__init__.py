@@ -1,0 +1,23 @@
+"""ACL engine: policy parsing, radix enforcement, token resolution.
+
+Equivalent of the reference's ``acl/`` package plus the server-side
+resolver in ``agent/consul/acl.go`` (SURVEY.md §2.2).
+"""
+
+from consul_tpu.acl.engine import (
+    ACLResolver,
+    Authorizer,
+    DENY_ALL,
+    MANAGE_ALL,
+    Policy,
+    parse_policy,
+)
+
+__all__ = [
+    "ACLResolver",
+    "Authorizer",
+    "DENY_ALL",
+    "MANAGE_ALL",
+    "Policy",
+    "parse_policy",
+]

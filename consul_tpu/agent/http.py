@@ -363,7 +363,7 @@ class HTTPApi:
             "member": {
                 "name": cfg.node_name,
                 "addr": self.agent.serf.memberlist.transport.local_addr(),
-                "tags": self.agent.serf.config.tags,
+                "tags": KeyedMap(self.agent.serf.config.tags),
             },
         })
 
@@ -372,7 +372,8 @@ class HTTPApi:
             {
                 "name": mem.name,
                 "addr": mem.addr,
-                "tags": mem.tags,
+                # Serf tag names are data, not struct fields.
+                "tags": KeyedMap(mem.tags),
                 "status": int(mem.status),
             }
             for mem in self.agent.serf.members.values()

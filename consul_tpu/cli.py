@@ -1,0 +1,474 @@
+"""The command-line interface: ``python -m consul_tpu.cli <command>``.
+
+Equivalent of the reference's ``command/`` registry
+(``command/registry.go:16``, ~60 subcommands on top of the ``api/``
+client).  Implemented commands: agent, members, join, leave,
+force-leave, kv (get/put/delete/export/import), catalog
+(datacenters/nodes/services), event, watch, exec-lock (lock), session
+(list/destroy), info, rtt, operator raft list-peers, services
+(register/deregister), monitor, version.
+
+Every command except ``agent`` talks to a running agent over HTTP
+(``-http-addr``, default 127.0.0.1:8500), exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import math
+import signal
+import sys
+from typing import Optional
+
+from consul_tpu.api import ConsulClient, parse_watch
+from consul_tpu.api.client import QueryOptions
+from consul_tpu.version import __version__
+
+DEFAULT_HTTP = "127.0.0.1:8500"
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not hasattr(args, "fn"):
+        parser.print_help()
+        return 1
+    try:
+        return asyncio.run(args.fn(args)) or 0
+    except KeyboardInterrupt:
+        return 130
+    except Exception as e:  # noqa: BLE001 — CLI surface: print, nonzero
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="consul-tpu")
+    sub = p.add_subparsers(dest="command")
+
+    def cmd(name, fn, help=""):
+        sp = sub.add_parser(name, help=help)
+        sp.set_defaults(fn=fn)
+        sp.add_argument("-http-addr", default=DEFAULT_HTTP)
+        return sp
+
+    # agent ---------------------------------------------------------------
+    sp = sub.add_parser("agent", help="run an agent")
+    sp.set_defaults(fn=cmd_agent)
+    sp.add_argument("-dev", action="store_true",
+                    help="single-server dev mode")
+    sp.add_argument("-server", action="store_true")
+    sp.add_argument("-node", default="")
+    sp.add_argument("-datacenter", default="dc1")
+    sp.add_argument("-bootstrap-expect", type=int, default=1)
+    sp.add_argument("-join", action="append", default=[])
+    sp.add_argument("-bind", default="127.0.0.1")
+    sp.add_argument("-serf-port", type=int, default=0)
+    sp.add_argument("-rpc-port", type=int, default=0)
+    sp.add_argument("-http-port", type=int, default=8500)
+    sp.add_argument("-dns-port", type=int, default=8600)
+
+    # cluster membership --------------------------------------------------
+    cmd("members", cmd_members, "list gossip pool members")
+    sp = cmd("join", cmd_join, "join an agent to a cluster")
+    sp.add_argument("addresses", nargs="+")
+    cmd("leave", cmd_leave, "gracefully leave the cluster")
+    cmd("info", cmd_info, "agent runtime info")
+
+    # kv -------------------------------------------------------------------
+    sp = cmd("kv", cmd_kv, "key/value store ops")
+    sp.add_argument("verb", choices=["get", "put", "delete", "export",
+                                     "import"])
+    sp.add_argument("key", nargs="?", default="")
+    sp.add_argument("value", nargs="?", default=None)
+    sp.add_argument("-recurse", action="store_true")
+    sp.add_argument("-keys", action="store_true")
+    sp.add_argument("-detailed", action="store_true")
+
+    # catalog --------------------------------------------------------------
+    sp = cmd("catalog", cmd_catalog, "catalog queries")
+    sp.add_argument("what", choices=["datacenters", "nodes", "services"])
+
+    # events / watch -------------------------------------------------------
+    sp = cmd("event", cmd_event, "fire a user event")
+    sp.add_argument("-name", required=True)
+    sp.add_argument("payload", nargs="?", default="")
+    sp = cmd("watch", cmd_watch, "watch a view for changes")
+    sp.add_argument("-type", required=True, dest="wtype")
+    sp.add_argument("-key", default="")
+    sp.add_argument("-prefix", default="")
+    sp.add_argument("-service", default="")
+    sp.add_argument("-tag", default="")
+    sp.add_argument("-state", default="")
+    sp.add_argument("-name", default="")
+    sp.add_argument("-passingonly", action="store_true")
+    sp.add_argument("-once", action="store_true",
+                    help="print first result and exit")
+
+    # sessions / locks ----------------------------------------------------
+    sp = cmd("session", cmd_session, "session ops")
+    sp.add_argument("verb", choices=["list", "destroy", "info"])
+    sp.add_argument("sid", nargs="?", default="")
+    sp = cmd("lock", cmd_lock, "run a command while holding a lock")
+    sp.add_argument("prefix")
+    sp.add_argument("shell_command")
+
+    # ops ------------------------------------------------------------------
+    sp = cmd("operator", cmd_operator, "cluster operator tools")
+    sp.add_argument("subsystem", choices=["raft"])
+    sp.add_argument("action", choices=["list-peers"])
+    sp = cmd("rtt", cmd_rtt, "estimate RTT between nodes")
+    sp.add_argument("node1")
+    sp.add_argument("node2", nargs="?", default="")
+    sp = cmd("services", cmd_services, "register/deregister agent services")
+    sp.add_argument("verb", choices=["register", "deregister"])
+    sp.add_argument("arg", help="JSON definition file (or '-'), or id")
+    sp = cmd("monitor", cmd_monitor, "stream user events")
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# agent
+# ---------------------------------------------------------------------------
+
+
+async def cmd_agent(args) -> int:
+    from consul_tpu.agent import Agent, AgentConfig
+    from consul_tpu.agent.dns import DNSServer
+    from consul_tpu.agent.http import HTTPApi
+    from consul_tpu.net.transport import UDPTransport
+
+    node = args.node or ("dev" if args.dev else "node")
+    server_mode = args.server or args.dev
+
+    gossip = UDPTransport(args.bind, args.serf_port)
+    rpc = UDPTransport(args.bind, args.rpc_port)
+    await gossip.start()
+    await rpc.start()
+    agent = Agent(
+        AgentConfig(
+            node_name=node,
+            datacenter=args.datacenter,
+            server=server_mode,
+            bootstrap_expect=1 if args.dev else args.bootstrap_expect,
+        ),
+        gossip_transport=gossip,
+        rpc_transport=rpc,
+    )
+    await agent.start()
+    api = HTTPApi(agent)
+    http_addr = await api.start(args.bind, args.http_port)
+    dns = DNSServer(agent)
+    dns_addr = await dns.start(args.bind, args.dns_port)
+
+    print("==> consul-tpu agent running!")
+    print(f"         Node name: {node}")
+    print(f"        Datacenter: {args.datacenter}")
+    print(f"            Server: {server_mode}")
+    print(f"         HTTP addr: {http_addr}")
+    print(f"          DNS addr: {dns_addr} (udp)")
+    print(f"        Gossip via: {gossip.local_addr()}")
+    print(f"          RPC addr: {rpc.local_addr()}")
+    sys.stdout.flush()
+
+    if args.join:
+        n = await agent.join(args.join)
+        print(f"==> Joined {n} node(s)")
+        sys.stdout.flush()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+    await stop.wait()
+    print("==> Caught signal, gracefully leaving")
+    await agent.leave()
+    await api.stop()
+    await dns.stop()
+    await agent.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# client commands
+# ---------------------------------------------------------------------------
+
+
+def _client(args) -> ConsulClient:
+    return ConsulClient(args.http_addr)
+
+
+async def cmd_members(args) -> int:
+    members = await _client(args).agent.members()
+    status_names = {0: "none", 1: "alive", 2: "leaving", 3: "left",
+                    4: "failed"}
+    rows = [("Node", "Address", "Status", "Type", "DC")]
+    for m in sorted(members, key=lambda m: m["Name"]):
+        tags = m.get("Tags", {})
+        rows.append((
+            m["Name"], m["Addr"],
+            status_names.get(m["Status"], str(m["Status"])),
+            "server" if tags.get("role") == "consul" else "client",
+            tags.get("dc", ""),
+        ))
+    _print_table(rows)
+    return 0
+
+
+async def cmd_join(args) -> int:
+    c = _client(args)
+    for addr in args.addresses:
+        out = await c.agent.join(addr)
+        print(f"Successfully joined cluster by contacting "
+              f"{out.get('NumJoined', 0)} nodes.")
+    return 0
+
+
+async def cmd_leave(args) -> int:
+    await _client(args).agent.leave()
+    print("Graceful leave complete")
+    return 0
+
+
+async def cmd_info(args) -> int:
+    c = _client(args)
+    self_info = await c.agent.self()
+    leader = await c.status.leader()
+    peers = await c.status.peers()
+    print(json.dumps({"agent": self_info, "leader": leader,
+                      "peers": peers}, indent=2, default=str))
+    return 0
+
+
+async def cmd_kv(args) -> int:
+    c = _client(args)
+    if args.verb == "get":
+        if args.keys:
+            keys, _ = await c.kv.keys(args.key)
+            print("\n".join(keys))
+        elif args.recurse:
+            entries, _ = await c.kv.list(args.key)
+            for e in entries:
+                print(f"{e['Key']}:{e['Value'].decode(errors='replace')}")
+        else:
+            entry, _ = await c.kv.get(args.key)
+            if entry is None:
+                print(f"Error! No key exists at: {args.key}", file=sys.stderr)
+                return 1
+            if args.detailed:
+                print(json.dumps(
+                    {k: v for k, v in entry.items() if k != "Value"},
+                    indent=2))
+            print(entry["Value"].decode(errors="replace"))
+    elif args.verb == "put":
+        value = (args.value or "").encode()
+        if args.value and args.value.startswith("@"):
+            with open(args.value[1:], "rb") as f:
+                value = f.read()
+        await c.kv.put(args.key, value)
+        print(f"Success! Data written to: {args.key}")
+    elif args.verb == "delete":
+        await c.kv.delete(args.key, recurse=args.recurse)
+        print(f"Success! Deleted key: {args.key}")
+    elif args.verb == "export":
+        entries, _ = await c.kv.list(args.key)
+        out = [{"key": e["Key"], "flags": e.get("Flags", 0),
+                "value": base64.b64encode(e["Value"]).decode()}
+               for e in entries]
+        print(json.dumps(out, indent=2))
+    elif args.verb == "import":
+        data = json.loads(sys.stdin.read())
+        for item in data:
+            await c.kv.put(item["key"], base64.b64decode(item["value"]),
+                           flags=item.get("flags", 0))
+        print(f"Imported: {len(data)} keys")
+    return 0
+
+
+async def cmd_catalog(args) -> int:
+    c = _client(args)
+    if args.what == "datacenters":
+        print("\n".join(await c.catalog.datacenters()))
+    elif args.what == "nodes":
+        nodes, _ = await c.catalog.nodes()
+        rows = [("Node", "Address")]
+        rows += [(n["Node"], n["Address"]) for n in nodes]
+        _print_table(rows)
+    elif args.what == "services":
+        services, _ = await c.catalog.services()
+        rows = [("Service", "Tags")]
+        rows += [(name, ",".join(tags)) for name, tags in sorted(
+            services.items())]
+        _print_table(rows)
+    return 0
+
+
+async def cmd_event(args) -> int:
+    out = await _client(args).event.fire(args.name, args.payload.encode())
+    print(f"Event ID: {out['ID']}")
+    return 0
+
+
+async def cmd_watch(args) -> int:
+    params = {"type": args.wtype}
+    for field in ("key", "prefix", "service", "tag", "state", "name"):
+        if getattr(args, field):
+            params[field] = getattr(args, field)
+    if args.passingonly:
+        params["passingonly"] = True
+    plan = parse_watch(params, _client(args))
+    done = asyncio.Event()
+
+    def handler(index, data):
+        print(json.dumps({"index": index, "data": data}, indent=2,
+                         default=_json_bytes))
+        sys.stdout.flush()
+        if args.once:
+            done.set()
+
+    plan.on_change(handler)
+    plan.start()
+    if args.once:
+        await done.wait()
+    else:
+        await asyncio.Event().wait()  # until Ctrl-C
+    plan.stop()
+    return 0
+
+
+async def cmd_session(args) -> int:
+    c = _client(args)
+    if args.verb == "list":
+        sessions, _ = await c.session.list()
+        rows = [("ID", "Node", "TTL", "Behavior")]
+        rows += [(s["ID"], s["Node"], str(s.get("TTL", "")),
+                  s.get("Behavior", "")) for s in sessions]
+        _print_table(rows)
+    elif args.verb == "destroy":
+        await c.session.destroy(args.sid)
+        print(f"Destroyed session {args.sid}")
+    elif args.verb == "info":
+        sess, _ = await c.session.info(args.sid)
+        print(json.dumps(sess, indent=2))
+    return 0
+
+
+async def cmd_lock(args) -> int:
+    """command/lock: acquire <prefix>/.lock with a session, run the
+    command, release (reference lock command semantics)."""
+    c = _client(args)
+    sid = await c.session.create({"TTL": "15s"})
+    key = f"{args.prefix.rstrip('/')}/.lock"
+    try:
+        while not await c.kv.put(key, b"", acquire=sid):
+            await asyncio.sleep(0.2)
+        proc = await asyncio.create_subprocess_shell(args.shell_command)
+        renew = asyncio.create_task(_renew_loop(c, sid))
+        code = await proc.wait()
+        renew.cancel()
+        return code
+    finally:
+        try:
+            await c.kv.put(key, b"", release=sid)
+            await c.session.destroy(sid)
+        except Exception:  # noqa: BLE001 — best effort cleanup
+            pass
+
+
+async def _renew_loop(c: ConsulClient, sid: str) -> None:
+    while True:
+        await asyncio.sleep(5)
+        await c.session.renew(sid)
+
+
+async def cmd_operator(args) -> int:
+    out = await _client(args).operator.raft_configuration()
+    rows = [("Node", "Address", "State", "Voter")]
+    for s in out.get("Servers", []):
+        rows.append((s["ID"], s["Address"],
+                     "leader" if s["Leader"] else "follower",
+                     str(s["Voter"]).lower()))
+    _print_table(rows)
+    return 0
+
+
+async def cmd_rtt(args) -> int:
+    """command/rtt: Vivaldi distance between two nodes' coordinates."""
+    c = _client(args)
+    node2 = args.node2
+    if not node2:
+        self_info = await c.agent.self()
+        node2 = self_info["Config"]["NodeName"]
+    c1, _ = await c.coordinate.node(args.node1)
+    c2, _ = await c.coordinate.node(node2)
+    if not c1 or not c2:
+        print("Error: coordinates not yet available", file=sys.stderr)
+        return 1
+    rtt = _coord_distance(c1[0]["Coord"], c2[0]["Coord"])
+    print(f"Estimated {args.node1} <-> {node2} rtt: {rtt * 1000:.3f} ms")
+    return 0
+
+
+def _coord_distance(a: dict, b: dict) -> float:
+    """coordinate.Coordinate.DistanceTo (Vivaldi 8-D + height)."""
+    vec_a, vec_b = a.get("Vec", []), b.get("Vec", [])
+    dist = math.sqrt(sum((x - y) ** 2 for x, y in zip(vec_a, vec_b)))
+    dist += a.get("Height", 0.0) + b.get("Height", 0.0)
+    adjusted = dist + a.get("Adjustment", 0.0) + b.get("Adjustment", 0.0)
+    return max(adjusted, 0.0)
+
+
+async def cmd_services(args) -> int:
+    c = _client(args)
+    if args.verb == "register":
+        raw = sys.stdin.read() if args.arg == "-" else open(args.arg).read()
+        await c.agent.service_register(json.loads(raw))
+        print("Registered service")
+    else:
+        await c.agent.service_deregister(args.arg)
+        print(f"Deregistered service: {args.arg}")
+    return 0
+
+
+async def cmd_monitor(args) -> int:
+    """Stream user events as they arrive (lightweight stand-in for the
+    reference's log-streaming monitor)."""
+    c = _client(args)
+    _, meta = await c.event.list()
+    index = meta.index
+    while True:
+        events, meta = await c.event.list(
+            opts=QueryOptions(index=index, wait="30s"))
+        if meta.index != index:
+            for e in events:
+                print(json.dumps(e, default=_json_bytes))
+            sys.stdout.flush()
+            index = meta.index
+
+
+async def cmd_version(args) -> int:
+    print(f"consul-tpu v{__version__}")
+    return 0
+
+
+def _print_table(rows: list[tuple]) -> None:
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(rows[0]))]
+    for row in rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(row, widths)).rstrip())
+
+
+def _json_bytes(obj):
+    if isinstance(obj, bytes):
+        return obj.decode(errors="replace")
+    return str(obj)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

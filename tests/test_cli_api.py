@@ -1,0 +1,226 @@
+"""API client library + watch plans + CLI black-box tests.
+
+Parity model: ``api/*_test.go`` (client over a live agent),
+``api/watch/watch_test.go`` (plans fire on change), and the
+``sdk/testutil.TestServer`` subprocess pattern (SURVEY.md §4.4): the
+CLI test execs the real ``agent -dev`` process and drives it with CLI
+subcommands over HTTP.
+"""
+
+import asyncio
+import contextlib
+import json
+import os
+import signal
+import sys
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.agent.agent import Agent, AgentConfig
+from consul_tpu.agent.http import HTTPApi
+from consul_tpu.api import ConsulClient, parse_watch
+from consul_tpu.api.client import QueryOptions
+from consul_tpu.net.transport import InMemoryNetwork
+
+
+@contextlib.asynccontextmanager
+async def dev_api():
+    net = InMemoryNetwork()
+    agent = Agent(
+        AgentConfig(node_name="dev", bootstrap_expect=1,
+                    gossip_interval_scale=0.05, sync_interval_s=0.3,
+                    sync_retry_interval_s=0.2, reconcile_interval_s=0.2),
+        gossip_transport=net.new_transport("dev:gossip"),
+        rpc_transport=net.new_transport("dev:rpc"),
+    )
+    await agent.start()
+    await wait_until(lambda: agent.delegate.is_leader(), msg="leader")
+    api = HTTPApi(agent)
+    addr = await api.start()
+    try:
+        yield agent, ConsulClient(addr)
+    finally:
+        await api.stop()
+        await agent.shutdown()
+
+
+class TestAPIClient:
+    async def test_kv_roundtrip(self):
+        async with dev_api() as (_, c):
+            assert await c.kv.put("app/db", b"postgres") is True
+            entry, meta = await c.kv.get("app/db")
+            assert entry["Value"] == b"postgres" and meta.index >= 1
+            entries, _ = await c.kv.list("app/")
+            assert [e["Key"] for e in entries] == ["app/db"]
+            keys, _ = await c.kv.keys("", separator="/")
+            assert keys == ["app/"]
+            assert await c.kv.delete("app/db") is True
+            entry, _ = await c.kv.get("app/db")
+            assert entry is None
+
+    async def test_catalog_health_session(self):
+        async with dev_api() as (agent, c):
+            await c.catalog.register({
+                "Node": "db-1", "Address": "10.5.5.5",
+                "Service": {"Service": "db", "Port": 5432},
+                "Checks": [{"CheckID": "db-alive", "ServiceID": "db",
+                            "Status": "passing"}],
+            })
+            nodes, _ = await c.catalog.nodes()
+            assert {n["Node"] for n in nodes} >= {"db-1", "dev"}
+            rows, _ = await c.health.service("db", passing=True)
+            assert rows[0]["Service"]["Port"] == 5432
+
+            sid = await c.session.create({"Node": "db-1",
+                                          "Checks": ["db-alive"]})
+            assert await c.kv.put("locks/db", b"db-1", acquire=sid) is True
+            sess, _ = await c.session.info(sid)
+            assert sess["Node"] == "db-1"
+            assert await c.kv.put("locks/db", b"", release=sid) is True
+            await c.session.destroy(sid)
+
+    async def test_query_and_txn(self):
+        async with dev_api() as (_, c):
+            await c.catalog.register({
+                "Node": "c1", "Address": "10.6.0.1",
+                "Service": {"Service": "cache", "Port": 6379},
+            })
+            qid = await c.query.create({"Name": "cache-q",
+                                        "Service": {"Service": "cache"}})
+            out, _ = await c.query.execute(qid)
+            assert out["Nodes"][0]["Service"]["Port"] == 6379
+            out, _ = await c.query.execute("cache-q")  # by name too
+            assert out["Nodes"]
+
+            res = await c.txn.apply([
+                {"KV": {"Verb": "set", "Key": "t/a", "Value": b"1"}},
+                {"KV": {"Verb": "get", "Key": "t/a"}},
+            ])
+            assert res["Errors"] == [] and len(res["Results"]) == 2
+
+    async def test_status_and_operator(self):
+        async with dev_api() as (_, c):
+            assert await c.status.leader()
+            peers = await c.status.peers()
+            assert len(peers) == 1
+            raft = await c.operator.raft_configuration()
+            assert raft["Servers"][0]["Leader"] is True
+
+
+class TestWatchPlans:
+    async def test_key_watch_fires_on_change(self):
+        async with dev_api() as (_, c):
+            await c.kv.put("watched", b"v1")
+            plan = parse_watch({"type": "key", "key": "watched"}, c)
+            fired = []
+            plan.on_change(lambda idx, data: fired.append((idx, data)))
+            plan.start()
+            await wait_until(lambda: len(fired) == 1, msg="initial fire")
+            assert fired[0][1]["Key"] == "watched"
+            await c.kv.put("watched", b"v2")
+            await wait_until(lambda: len(fired) == 2, msg="change fire")
+            assert fired[1][0] > fired[0][0]
+            plan.stop()
+
+    async def test_service_watch(self):
+        async with dev_api() as (_, c):
+            plan = parse_watch({"type": "service", "service": "web"}, c)
+            fired = []
+            plan.on_change(lambda idx, data: fired.append(data))
+            plan.start()
+            await wait_until(lambda: fired, msg="initial empty fire")
+            assert fired[0] == []
+            await c.catalog.register({
+                "Node": "w1", "Address": "10.7.0.1",
+                "Service": {"Service": "web", "Port": 80},
+            })
+            await wait_until(lambda: len(fired) >= 2, msg="service appears")
+            assert fired[-1][0]["Service"]["Service"] == "web"
+            plan.stop()
+
+    async def test_parse_watch_validation(self):
+        c = ConsulClient("127.0.0.1:1")
+        with pytest.raises(ValueError, match="unknown watch type"):
+            parse_watch({"type": "bogus"}, c)
+        with pytest.raises(ValueError, match="requires 'key'"):
+            parse_watch({"type": "key"}, c)
+
+
+class TestCLIBlackBox:
+    """Exec the real CLI binary: the sdk/testutil.TestServer pattern."""
+
+    async def test_dev_agent_and_cli_commands(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "consul_tpu.cli", "agent", "-dev",
+            "-http-port", "0", "-dns-port", "0",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+            env=env,
+        )
+        http_addr = None
+        try:
+            # Scrape the HTTP address from the boot banner.
+            while True:
+                line = await asyncio.wait_for(proc.stdout.readline(), 30)
+                assert line, "agent exited before banner"
+                text = line.decode()
+                if "HTTP addr:" in text:
+                    http_addr = text.split("HTTP addr:")[1].strip()
+                if "agent running" in text and http_addr:
+                    break
+                if http_addr and "Gossip via" in text:
+                    break
+
+            async def cli(*cli_args):
+                p = await asyncio.create_subprocess_exec(
+                    sys.executable, "-m", "consul_tpu.cli", *cli_args,
+                    "-http-addr", http_addr,
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.PIPE,
+                    env=env,
+                )
+                out, err = await asyncio.wait_for(p.communicate(), 30)
+                return p.returncode, out.decode(), err.decode()
+
+            # Wait until the embedded server has a leader.
+            async def has_leader():
+                code, out, _ = await cli("info")
+                return code == 0 and json.loads(out)["leader"]
+
+            await wait_until(has_leader, timeout=30, msg="leader via CLI")
+
+            code, out, err = await cli("kv", "put", "greeting", "hello")
+            assert code == 0, err
+            code, out, err = await cli("kv", "get", "greeting")
+            assert code == 0 and out.strip() == "hello"
+
+            code, out, _ = await cli("members")
+            assert code == 0 and "dev" in out and "server" in out
+
+            code, out, _ = await cli("catalog", "datacenters")
+            assert code == 0 and out.strip() == "dc1"
+
+            code, out, _ = await cli("operator", "raft", "list-peers")
+            assert code == 0 and "leader" in out
+
+            code, out, _ = await cli("version")
+            assert code == 0 and "consul-tpu" in out
+
+            code, out, err = await cli("event", "-name", "deploy", "v1")
+            assert code == 0 and "Event ID" in out
+
+            code, out, _ = await cli(
+                "watch", "-type", "key", "-key", "greeting", "-once"
+            )
+            assert code == 0
+            watched = json.loads(out)
+            assert watched["data"]["Key"] == "greeting"
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                await asyncio.wait_for(proc.wait(), 10)
+            except asyncio.TimeoutError:
+                proc.kill()
