@@ -197,10 +197,13 @@ class Agent:
         }
         record.setdefault("name", cid)
         self.local.add_check(record)
+        # Always retire any previous executor for this id — even when
+        # the new definition is a bare catalog check with no runner —
+        # so a replaced check can't keep pushing stale statuses.
+        old = self.checks.pop(cid, None)
+        if old:
+            old.stop()
         if runner is not None:
-            old = self.checks.pop(cid, None)
-            if old:
-                old.stop()
             self.checks[cid] = runner
             runner.start()
 

@@ -167,7 +167,24 @@ class KVS(_Endpoint):
     """kvs_endpoint.go."""
 
     async def apply(self, body: dict):
-        return await self._write("KVS.Apply", MessageType.KVS, body)
+        fwd = await self.server.forward("KVS.Apply", body)
+        if fwd is not None:
+            return fwd
+        if body.get("op") == "lock":
+            # Lock-delay is wall-time, so it is enforced pre-commit with
+            # the leader's clock only — doing it in the FSM would let
+            # peers diverge (kvs_endpoint.go:67-82 kvsPreApply).
+            key = (body.get("entry") or {}).get("key", "")
+            if self.server.store.kv_lock_delay(key) > 0:
+                return {
+                    "result": False,
+                    "index": self.server.store.max_index("kvs", "tombstones"),
+                }
+        result = await self.server.raft_apply(MessageType.KVS, body)
+        return {
+            "result": result,
+            "index": self.server.store.max_index("kvs", "tombstones"),
+        }
 
     async def get(self, body: dict):
         def run(ws):
@@ -277,7 +294,32 @@ class Txn(_Endpoint):
     """txn_endpoint.go — read-only op sets skip raft (Txn.Read)."""
 
     async def apply(self, body: dict):
-        return await self._write("Txn.Apply", MessageType.TXN, body)
+        fwd = await self.server.forward("Txn.Apply", body)
+        if fwd is not None:
+            return fwd
+        # Per-op pre-apply checks run with the leader's clock, exactly
+        # like the single-op path (txn_endpoint.go Apply → kvsPreApply):
+        # a "lock" verb inside a txn must honor lock-delay windows too.
+        errors = []
+        for i, op in enumerate(body.get("ops") or []):
+            kv = op.get("kv") if isinstance(op, dict) else None
+            if kv and kv.get("verb") == "lock":
+                key = (kv.get("entry") or {}).get("key", "")
+                if self.server.store.kv_lock_delay(key) > 0:
+                    errors.append(
+                        {"op_index": i,
+                         "what": f"key {key!r} is under a lock-delay"}
+                    )
+        if errors:
+            return {
+                "result": {"results": [], "errors": errors},
+                "index": self.server.store.max_index("kvs", "tombstones"),
+            }
+        result = await self.server.raft_apply(MessageType.TXN, body)
+        return {
+            "result": result,
+            "index": self.server.store.max_index("kvs", "tombstones"),
+        }
 
     async def read(self, body: dict):
         fwd = await self.server.forward("Txn.Read", body, read=True)

@@ -328,11 +328,14 @@ class HTTPApi:
     # -- helpers --------------------------------------------------------
 
     async def _rpc_read(self, req: HTTPRequest, method: str, body: dict,
-                        key: str, unwrap_single: bool = False) -> HTTPResponse:
+                        key: str, unwrap_single: bool = False,
+                        row: Optional[Callable] = None) -> HTTPResponse:
         body.update(req.query_options())
         out = await self.agent.rpc(method, body)
         meta = out.get("meta")
         data = out.get(key)
+        if row is not None and data is not None:
+            data = [row(r) for r in data]
         if unwrap_single:
             data = data[0] if data else None
             if data is None:
@@ -458,11 +461,59 @@ class HTTPApi:
         return HTTPResponse(200, KeyedMap(out.get("services") or {}),
                             headers=_meta_headers(out.get("meta")))
 
+    def _service_node_row(self, r: dict) -> dict:
+        """Internal service row → ``structs.ServiceNode`` JSON shape
+        (camelized downstream: ServiceID/ServiceName/ServicePort/...)."""
+        return {
+            "id": "",
+            "node": r.get("node", ""),
+            "address": r.get("node_address", ""),
+            "datacenter": self.agent.config.datacenter,
+            "node_meta": KeyedMap(r.get("node_meta") or {}),
+            "service_id": r.get("id", ""),
+            "service_name": r.get("service", ""),
+            "service_tags": r.get("tags") or [],
+            "service_address": r.get("address", ""),
+            "service_meta": KeyedMap(r.get("meta") or {}),
+            "service_port": int(r.get("port") or 0),
+            "create_index": r.get("create_index", 0),
+            "modify_index": r.get("modify_index", 0),
+        }
+
+    def _check_service_node_row(self, r: dict) -> dict:
+        """Internal health row → ``structs.CheckServiceNode`` JSON shape:
+        {Node: {...}, Service: {...}, Checks: [...]}."""
+        node = r.get("node") or {}
+        svc = r.get("service") or {}
+        return {
+            "node": {
+                "id": "",
+                "node": node.get("node", svc.get("node", "")),
+                "address": node.get("address", ""),
+                "datacenter": self.agent.config.datacenter,
+                "meta": KeyedMap(node.get("meta") or {}),
+                "create_index": node.get("create_index", 0),
+                "modify_index": node.get("modify_index", 0),
+            },
+            "service": {
+                "id": svc.get("id", ""),
+                "service": svc.get("service", ""),
+                "tags": svc.get("tags") or [],
+                "address": svc.get("address", ""),
+                "meta": KeyedMap(svc.get("meta") or {}),
+                "port": int(svc.get("port") or 0),
+                "create_index": svc.get("create_index", 0),
+                "modify_index": svc.get("modify_index", 0),
+            },
+            "checks": r.get("checks") or [],
+        }
+
     async def catalog_service(self, req, m) -> HTTPResponse:
         body = {"service": m.group("svc")}
         if "tag" in req.query:
             body["tag"] = req.query["tag"]
-        return await self._rpc_read(req, "Catalog.ServiceNodes", body, "nodes")
+        return await self._rpc_read(req, "Catalog.ServiceNodes", body, "nodes",
+                                    row=self._service_node_row)
 
     async def catalog_node(self, req, m) -> HTTPResponse:
         return await self._rpc_read(
@@ -495,7 +546,8 @@ class HTTPApi:
                 "passing_only": req.flag("passing")}
         if "tag" in req.query:
             body["tag"] = req.query["tag"]
-        return await self._rpc_read(req, "Health.ServiceNodes", body, "nodes")
+        return await self._rpc_read(req, "Health.ServiceNodes", body, "nodes",
+                                    row=self._check_service_node_row)
 
     async def health_state(self, req, m) -> HTTPResponse:
         return await self._rpc_read(
@@ -692,6 +744,11 @@ class HTTPApi:
                 op = {"kv": {"verb": kv["verb"], "entry": kv_entry}}
             elif kv and "entry" not in kv:
                 op = {"kv": {"verb": kv.pop("verb"), "entry": kv}}
+            # The API KVTxnOp carries the CAS index as ``Index``
+            # (api/kv.go KVTxnOp); internally it's the modify_index.
+            entry = op.get("kv", {}).get("entry")
+            if entry and "index" in entry and "modify_index" not in entry:
+                entry["modify_index"] = entry.pop("index")
             ops.append(op)
         out = await self.agent.rpc("Txn.Apply", {"ops": ops})
         result = out.get("result", out)

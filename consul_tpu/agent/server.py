@@ -128,6 +128,8 @@ class Server:
         )
 
         self.raft: Optional[RaftNode] = None
+        self._bootstrap_disabled = False
+        self._bootstrapping = False
         self._leader_tasks: list[asyncio.Task] = []
         self._tasks: list[asyncio.Task] = []
         self._reconcile_wake = asyncio.Event()
@@ -148,7 +150,7 @@ class Server:
         await self.rpc_server.start()
         await self.serf.start()
         self._tasks.append(asyncio.create_task(self._serf_event_pump()))
-        self._maybe_bootstrap()
+        await self._maybe_bootstrap()
 
     async def join(self, addrs: list[str]) -> int:
         return await self.serf.join(addrs)
@@ -199,23 +201,78 @@ class Server:
                 return m.tags.get("rpc_addr")
         return None
 
-    def _maybe_bootstrap(self) -> None:
-        if self.raft is not None:
+    async def _maybe_bootstrap(self) -> None:
+        """Live-bootstrap guard dance (server_serf.go:318-401).
+
+        Bootstrap only when (a) we have no raft state yet, (b) every
+        visible server agrees on bootstrap_expect, and (c) NO visible
+        server reports existing raft peers via Status.Peers.  A server
+        that finds evidence of an established cluster disables its
+        expect mode and starts raft as a non-voter follower instead —
+        the leader's reconcile folds it in (handleAliveMember →
+        add_voter), so a late joiner can never depose a live leader
+        with a self-computed voter set.
+        """
+        if self.raft is not None or self._bootstrap_disabled or self._bootstrapping:
             return
         expect = self.config.bootstrap_expect
         servers = [
             m for m in self._server_members() if m.status == MemberStatus.ALIVE
         ]
+        for m in servers:
+            peer_expect = m.tags.get("expect")
+            if peer_expect and int(peer_expect) != expect:
+                log.error(
+                    "%s: member %s has conflicting expect %s != %d; refusing bootstrap",
+                    self.node_id, m.name, peer_expect, expect,
+                )
+                return
         if len(servers) < expect:
             return
-        # Initial voter set = every server visible when the expect
-        # threshold is crossed (maybeBootstrap attempts a config with
-        # all discovered servers); sorted so simultaneous bootstrappers
-        # compute identical logs.  Servers joining later are added by
-        # the leader's reconcile (handleAliveMember → add_voter).
-        voters = sorted(m.tags["id"] for m in servers)
-        if self.node_id not in voters:
-            voters.append(self.node_id)
+
+        self._bootstrapping = True
+        try:
+            # Query each peer server; any reported raft peers is
+            # evidence of an existing cluster (server_serf.go:365-401).
+            for m in servers:
+                if m.tags.get("id") == self.node_id:
+                    continue
+                addr = m.tags.get("rpc_addr")
+                if not addr:
+                    continue
+                resp = None
+                for attempt in range(3):
+                    try:
+                        resp = await self.rpc_client.call(
+                            addr, "Status.Peers", {}, timeout=2.0
+                        )
+                        break
+                    except Exception:  # noqa: BLE001 — unreachable peer
+                        await asyncio.sleep(0.1 * (1 << attempt))
+                if resp is None:
+                    return  # retried on the next serf event
+                if resp.get("peers"):
+                    log.info(
+                        "%s: existing raft peers reported by %s; disabling bootstrap",
+                        self.node_id, m.name,
+                    )
+                    self._bootstrap_disabled = True
+                    self._start_raft([])  # non-voter follower; leader adds us
+                    return
+            if self.raft is not None:
+                return  # a concurrent path started raft while we probed
+            # Initial voter set = every server visible when the expect
+            # threshold is crossed; sorted so simultaneous bootstrappers
+            # compute identical configs.
+            voters = sorted(m.tags["id"] for m in servers)
+            if self.node_id not in voters:
+                voters.append(self.node_id)
+            self._start_raft(sorted(voters))
+            log.info("%s: raft bootstrapped with voters %s", self.node_id, voters)
+        finally:
+            self._bootstrapping = False
+
+    def _start_raft(self, voters: list[str]) -> None:
         self.raft = RaftNode(
             RaftConfig(
                 node_id=self.node_id,
@@ -225,12 +282,10 @@ class Server:
             ),
             self.fsm,
             self.raft_adapter,
-            sorted(voters),
+            voters,
         )
         self.raft.leadership_listeners.append(self._on_leadership)
-        task = asyncio.create_task(self.raft.start())
-        self._tasks.append(task)
-        log.info("%s: raft bootstrapped with voters %s", self.node_id, voters)
+        self._tasks.append(asyncio.create_task(self.raft.start()))
 
     # ------------------------------------------------------------------
     # RPC helpers used by endpoints
@@ -303,8 +358,8 @@ class Server:
         membership changes trigger bootstrap checks and reconcile."""
         while not self._shutdown:
             await self.serf.events.get()
-            self._maybe_bootstrap()
             self._reconcile_wake.set()
+            await self._maybe_bootstrap()
 
     # ------------------------------------------------------------------
     # leader loops (leader.go)

@@ -168,6 +168,15 @@ class RaftNode:
         self.snapshot_term = 0
         self.snapshot_data: Any = None
         self.voters: list[str] = list(voters)
+        # Bootstrap writes the initial configuration INTO THE LOG
+        # (hashicorp/raft BootstrapCluster appends a configuration entry
+        # at index 1) so it replicates to servers that lost the
+        # simultaneous-bootstrap race and idle with an empty config —
+        # constructor-only voter state would never reach them.
+        if voters:
+            self.log.append(
+                Entry(1, 0, ENTRY_CONFIG, {"voters": list(voters)})
+            )
 
         # Volatile state.
         self.role = Role.FOLLOWER
@@ -594,6 +603,12 @@ class RaftNode:
         raise ValueError(f"unknown raft rpc {method}")
 
     def _on_request_vote(self, req: dict) -> dict:
+        # A candidate outside our committed configuration never gets a
+        # vote (hashicorp/raft raft.go requestVote "not in configuration"
+        # check): keeps a divergently-bootstrapped or stale server from
+        # assembling a quorum that doesn't intersect ours.
+        if self.voters and req["candidate"] not in self.voters:
+            return {"term": self.current_term, "granted": False}
         if req["term"] > self.current_term:
             self._become_follower(req["term"], None)
         granted = False

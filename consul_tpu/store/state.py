@@ -24,6 +24,7 @@ mirroring ``fsm/fsm.go:102``.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 from consul_tpu.store.memdb import (
@@ -125,6 +126,10 @@ class StateStore:
     def __init__(self) -> None:
         self.db = MemDB(_schemas())
         self._abandon = None  # lazily-created asyncio.Event
+        # Lock-delay expirations per key — wall-clock, leader-local,
+        # deliberately NOT part of the replicated state
+        # (state/state_store.go:117-118, delay_oss.go).
+        self._lock_delays: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     # plumbing
@@ -359,6 +364,7 @@ class StateStore:
             node = tx.get("nodes", _b(rec["node"]), ws=ws)
             merged = dict(rec)
             merged["node_address"] = node["address"] if node else ""
+            merged["node_meta"] = (node.get("meta") or {}) if node else {}
             out.append(merged)
         return self.max_index("services", "nodes", tx=tx), out
 
@@ -404,7 +410,8 @@ class StateStore:
             ]
             if passing_only and any(c["status"] != HEALTH_PASSING for c in checks):
                 continue
-            out.append({"service": inst, "checks": checks})
+            node = tx.get("nodes", _b(inst["node"]), ws=ws)
+            out.append({"node": node, "service": inst, "checks": checks})
         return max(idx, self.max_index("checks", tx=tx)), out
 
     # ------------------------------------------------------------------
@@ -658,12 +665,33 @@ class StateStore:
         tx.commit()
         return True
 
+    def kv_lock_delay(self, key: str) -> float:
+        """Seconds until the lock-delay on ``key`` expires, 0 if clear
+        (``state/kvs.go:376`` KVSLockDelay).  Enforced pre-commit on the
+        leader only — see kvs_endpoint.go:67-82 for why it must not be
+        checked inside the FSM."""
+        exp = self._lock_delays.get(key)
+        if exp is None:
+            return 0.0
+        remaining = exp - time.monotonic()
+        if remaining <= 0:
+            del self._lock_delays[key]
+            return 0.0
+        return remaining
+
     def _destroy_session_txn(self, tx: MemTxn, idx: int, sess: dict) -> None:
         """Delete the session and apply its behavior to held locks
         (``state/session.go`` deleteSessionTxn)."""
         tx.delete("sessions", _b(sess["id"]))
         self._bump(tx, idx, "sessions")
         held = tx.records("kvs", _b(sess["id"]) + SEP, index="session")
+        delay = float(sess.get("lock_delay") or 0.0)
+        if delay > 0 and held:
+            # Guard the leader-election primitive against stale holders
+            # reacquiring immediately (session.go:348-368).
+            now = time.monotonic()
+            for rec in held:
+                self._lock_delays[rec["key"]] = now + delay
         for rec in held:
             if sess["behavior"] == SESSION_BEHAVIOR_DELETE:
                 tx.delete("kvs", _b(rec["key"]))

@@ -281,3 +281,76 @@ class TestCoordinateBatching:
             msg="coordinate flushed via raft batch",
         )
         await shutdown_all(client, *servers)
+
+
+class TestBootstrapGuards:
+    async def test_late_joiner_does_not_depose_leader(self):
+        """A server joining an established cluster at the expect
+        threshold must NOT live-bootstrap its own voter set: it probes
+        Status.Peers, disables bootstrap, and waits for the leader's
+        reconcile to add it (server_serf.go:318-401)."""
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+        term_before = leader.raft.current_term
+
+        late = make_server(net, "s9", expect=3)
+        await late.start()
+        await late.join(["s0:gossip"])
+
+        # The late joiner must end up a follower in the SAME cluster.
+        await wait_until(
+            lambda: late.raft is not None
+            and "s9" in late.raft.voters
+            and late.raft.leader_id == leader.node_id,
+            timeout=10,
+            msg="late joiner folded in as follower",
+        )
+        assert late._bootstrap_disabled is True
+        assert not late.is_leader()
+        # Leadership never churned: same leader, same term.
+        assert leader.is_leader()
+        assert leader.raft.current_term == term_before
+        await shutdown_all(late, *servers)
+
+
+class TestLockDelay:
+    async def test_invalidated_session_lock_delay_blocks_reacquire(self):
+        """KVSLock honors the lock-delay window set when a lock-holding
+        session dies (kvs_endpoint.go:67-82, state/session.go:348-368)."""
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+        addr = f"{leader.node_id}:rpc"
+        call = leader.rpc_client.call
+
+        await call(addr, "Catalog.Register", {
+            "node": "n-ld", "address": "10.9.9.9",
+        })
+        s1 = (await call(addr, "Session.Apply", {
+            "op": "create",
+            "session": {"node": "n-ld", "lock_delay": 0.4, "checks": []},
+        }))["result"]
+        got = await call(addr, "KVS.Apply", {
+            "op": "lock", "entry": {"key": "svc/lead", "session": s1},
+        })
+        assert got["result"] is True
+
+        # Session dies while holding the lock -> delay window opens.
+        await call(addr, "Session.Apply",
+                   {"op": "destroy", "session": {"id": s1}})
+        s2 = (await call(addr, "Session.Apply", {
+            "op": "create",
+            "session": {"node": "n-ld", "lock_delay": 0.4, "checks": []},
+        }))["result"]
+        denied = await call(addr, "KVS.Apply", {
+            "op": "lock", "entry": {"key": "svc/lead", "session": s2},
+        })
+        assert denied["result"] is False
+
+        await asyncio.sleep(0.5)  # let the delay lapse
+        allowed = await call(addr, "KVS.Apply", {
+            "op": "lock", "entry": {"key": "svc/lead", "session": s2},
+        })
+        assert allowed["result"] is True
+        await shutdown_all(*servers)
