@@ -11,6 +11,19 @@ from consul_tpu.models.broadcast import (
     broadcast_init,
     broadcast_round,
 )
+from consul_tpu.models.membership import (
+    RANK_ALIVE,
+    RANK_DEAD,
+    RANK_LEFT,
+    RANK_SUSPECT,
+    MembershipConfig,
+    MembershipState,
+    key_inc,
+    key_rank,
+    make_key,
+    membership_init,
+    membership_round,
+)
 from consul_tpu.models.swim import (
     SwimConfig,
     SwimState,
@@ -34,6 +47,17 @@ __all__ = [
     "BroadcastState",
     "broadcast_init",
     "broadcast_round",
+    "MembershipConfig",
+    "MembershipState",
+    "membership_init",
+    "membership_round",
+    "make_key",
+    "key_rank",
+    "key_inc",
+    "RANK_ALIVE",
+    "RANK_SUSPECT",
+    "RANK_DEAD",
+    "RANK_LEFT",
     "SwimConfig",
     "SwimState",
     "swim_init",

@@ -1,0 +1,498 @@
+"""Full-membership SWIM simulation: every node's view of every node.
+
+Where ``models/swim.py`` tracks ONE subject through N observers, this
+model carries the complete N x N membership state the reference's pump
+maintains (memberlist/state.go nodeState per member), so it can study
+what the single-subject model cannot: concurrent failures interacting
+through shared gossip bandwidth, join/leave intents, and the periodic
+push/pull anti-entropy backstop that dominates convergence tails under
+loss (memberlist/state.go:622-657 pushPull, :1283 mergeState).
+
+State layout (observer axis i = rows, subject axis j = columns):
+
+  key[i, j]       int32 — i's view of j, encoded (incarnation << 2) | rank
+                  with rank ALIVE=0 < SUSPECT=1 < DEAD=2 < LEFT=3, or -1
+                  when i has never heard of j.  Integer comparison of
+                  keys IS the protocol's merge precedence: an alive
+                  message only wins with a strictly higher incarnation,
+                  suspect beats alive at the same incarnation, dead
+                  beats suspect (aliveNode/suspectNode/deadNode
+                  acceptance rules, state.go:917,1134,1222).  Every
+                  delivery — gossip scatter or push/pull row merge — is
+                  therefore one max().
+  suspect_since[i,j] int32 — tick i started suspecting j (Lifeguard
+                  timer start, suspicion.go:50-80); NEVER otherwise.
+  confirms[i,j]   int32 — independent suspicion confirmations
+                  (suspicion.go:103-130 Confirm).
+  tx[i, j]        int32 — remaining retransmissions of i's queued
+                  broadcast about j.  One queue slot per subject whose
+                  payload is i's CURRENT view — exactly the name-keyed
+                  replacement of TransmitLimitedQueue (queue.go:14-120):
+                  newer news about j overwrites the older message, so
+                  eras never need separate per-class queues.
+  own_inc[i]      int32 — i's own incarnation (refutes bump it,
+                  state.go:880-915).
+  awareness[i]    int32 — Lifeguard node-health score 0..max-1
+                  (awareness.go:14-69): failed probes degrade it,
+                  successful probes recover it, and a degraded node
+                  waits longer before declaring suspicion
+                  (awareness.go:64 ScaleTimeout).
+  probe_pending_at[i], probe_subject[i] — the one in-flight failed
+                  probe (the reference probes one member per
+                  ProbeInterval, state.go:214-256).
+
+Ground truth (who is actually up) comes from the config's fail/leave/
+join schedules; detection of it is what the protocol machinery above
+has to accomplish.
+
+Network model: one compound packet per (sender, target) per tick
+(net.go makeCompoundMessage) carrying the sender's ``piggyback``
+highest-priority queued messages (queue.go GetBroadcasts drains
+fewest-transmits-first — here: highest remaining budget first, random
+tie-break); the packet survives with probability 1-loss.  Push/pull is
+a TCP stream — modeled lossless, requiring only both ends up — and is
+Poisson-staggered at rate 1/PushPullInterval per node per tick instead
+of per-node phase-shifted timers, keeping every tick's compiled
+program identical (the same reasoning the reference applies when it
+jitters pushPullTrigger, state.go:133-142).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import bernoulli_mask, sample_peers, sample_probe_targets
+from consul_tpu.protocol import retransmit_limit, suspicion_timeout_bounds
+from consul_tpu.protocol.profiles import GossipProfile, LAN
+
+RANK_ALIVE = 0
+RANK_SUSPECT = 1
+RANK_DEAD = 2
+RANK_LEFT = 3
+
+NEVER = jnp.iinfo(jnp.int32).max
+
+
+def make_key(inc, rank):
+    """Precedence key: (incarnation << 2) | rank; total order = protocol
+    merge precedence (see module docstring)."""
+    return (inc << 2) | rank
+
+
+def key_rank(k):
+    """Rank of a view key; -1 for unknown cells."""
+    return jnp.where(k >= 0, k & 3, -1)
+
+
+def key_inc(k):
+    """Incarnation of a view key; 0 for unknown cells."""
+    return jnp.where(k >= 0, k >> 2, 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipConfig:
+    """Static parameters of a full-membership study.
+
+    Schedules are tuples of ``(node, tick)`` pairs so the config stays
+    hashable for jit: ``fail_at`` crashes (no goodbye), ``leave_at``
+    graceful departures (left intent gossiped first, serf leave
+    semantics), ``join_at`` late joiners (known to nobody until their
+    first push/pull lands — memberlist Join → pushPullNode,
+    memberlist.go:249).
+    """
+
+    n: int
+    loss: float = 0.0
+    profile: GossipProfile = LAN
+    fanout: Optional[int] = None          # default: profile.gossip_nodes
+    piggyback: int = 8                    # messages per compound packet
+    fail_at: tuple = ()                   # ((node, tick), ...)
+    leave_at: tuple = ()
+    join_at: tuple = ()
+    probe_enabled: bool = True            # off = anti-entropy-only studies
+    push_pull_enabled: bool = True
+    leave_grace_ticks: int = 10           # leaver keeps gossiping this long
+
+    def __post_init__(self):
+        if self.fanout is None:
+            object.__setattr__(self, "fanout", self.profile.gossip_nodes)
+
+    @property
+    def tx_limit(self) -> int:
+        return retransmit_limit(self.profile.retransmit_mult, self.n)
+
+    @property
+    def probe_interval_ticks(self) -> int:
+        return self.profile.probe_interval_ticks
+
+    @property
+    def probe_timeout_ticks(self) -> int:
+        return self.profile.probe_timeout_ticks
+
+    @property
+    def push_pull_ticks(self) -> int:
+        return self.profile.push_pull_interval_ticks
+
+    @property
+    def confirmations_k(self) -> int:
+        # state.go:1186-1196: k = SuspicionMult - 2, or 0 if n-2 < k.
+        k = self.profile.suspicion_mult - 2
+        return 0 if self.n - 2 < k else k
+
+    @property
+    def suspicion_bounds_ticks(self) -> tuple[float, float]:
+        lo_ms, hi_ms = suspicion_timeout_bounds(
+            self.profile.suspicion_mult,
+            self.profile.suspicion_max_timeout_mult,
+            self.n,
+            self.profile.probe_interval_ms,
+        )
+        g = self.profile.gossip_interval_ms
+        return lo_ms / g, hi_ms / g
+
+    @property
+    def probe_fail_prob_alive(self) -> float:
+        """P(probe of a LIVE target fails): direct round-trip (2 legs)
+        and all IndirectChecks relays (4 legs) drop (state.go:326-454;
+        same derivation as SwimConfig.probe_fail_prob_alive)."""
+        ok = 1.0 - self.loss
+        p_direct = 1.0 - ok**2
+        p_indirect = 1.0 - ok**4
+        return p_direct * (p_indirect ** self.profile.indirect_checks)
+
+
+class MembershipState(NamedTuple):
+    key: jax.Array              # int32[n, n] — view keys (-1 unknown)
+    suspect_since: jax.Array    # int32[n, n]
+    confirms: jax.Array         # int32[n, n]
+    tx: jax.Array               # int32[n, n]
+    own_inc: jax.Array          # int32[n]
+    awareness: jax.Array        # int32[n]
+    probe_pending_at: jax.Array # int32[n]
+    probe_subject: jax.Array    # int32[n]
+    tick: jax.Array             # int32 scalar
+
+
+def _schedule_array(n: int, pairs: tuple, default: int) -> jnp.ndarray:
+    arr = [default] * n
+    for node, tick in pairs:
+        arr[node] = tick
+    return jnp.asarray(arr, jnp.int32)
+
+
+def membership_init(cfg: MembershipConfig) -> MembershipState:
+    n = cfg.n
+    join_tick = _schedule_array(n, cfg.join_at, 0)
+    # Established members know each other as (alive, inc 0); joiners'
+    # rows and columns start unknown except their self-view.
+    joiner = join_tick > 0
+    key = jnp.zeros((n, n), jnp.int32)
+    key = jnp.where(joiner[None, :], -1, key)   # nobody knows a joiner
+    key = jnp.where(joiner[:, None], -1, key)   # a joiner knows nobody
+    key = key.at[jnp.arange(n), jnp.arange(n)].set(0)  # ...but itself
+    return MembershipState(
+        key=key,
+        suspect_since=jnp.full((n, n), NEVER, jnp.int32),
+        confirms=jnp.zeros((n, n), jnp.int32),
+        tx=jnp.zeros((n, n), jnp.int32),
+        own_inc=jnp.zeros((n,), jnp.int32),
+        awareness=jnp.zeros((n,), jnp.int32),
+        probe_pending_at=jnp.full((n,), NEVER, jnp.int32),
+        probe_subject=jnp.zeros((n,), jnp.int32),
+        tick=jnp.int32(0),
+    )
+
+
+def _lifeguard_timeout_ticks(cfg: MembershipConfig, confirms: jax.Array) -> jax.Array:
+    """suspicion.go:86-97 remainingSuspicionTime, vectorized over cells
+    (same shape as models/swim.py._lifeguard_timeout_ticks)."""
+    lo, hi = cfg.suspicion_bounds_ticks
+    k = cfg.confirmations_k
+    if k < 1:
+        return jnp.full(confirms.shape, lo, jnp.float32)
+    frac = jnp.log(confirms.astype(jnp.float32) + 1.0) / math.log(k + 1.0)
+    raw = hi - frac * (hi - lo)
+    return jnp.maximum(jnp.ceil(raw), lo)
+
+
+def membership_round(
+    state: MembershipState, key_rng: jax.Array, cfg: MembershipConfig
+) -> MembershipState:
+    n, F = cfg.n, cfg.fanout
+    M = min(cfg.piggyback, n)
+    t = state.tick
+    (k_tie, k_tgt, k_loss, k_pp, k_ppsel, k_probe, k_pfail) = jax.random.split(
+        key_rng, 7
+    )
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    # ------------------------------------------------------------------
+    # Ground truth for this tick.
+    # ------------------------------------------------------------------
+    fail_tick = _schedule_array(n, cfg.fail_at, NEVER)
+    leave_tick = _schedule_array(n, cfg.leave_at, NEVER)
+    join_tick = _schedule_array(n, cfg.join_at, 0)
+    present = t >= join_tick
+    crashed = t >= fail_tick
+    leaving = present & (t >= leave_tick) & ~crashed
+    departed = present & ~crashed & (
+        t >= jnp.where(
+            leave_tick == NEVER, NEVER, leave_tick + cfg.leave_grace_ticks
+        )
+    )
+    participates = present & ~crashed & ~departed
+
+    key_m = state.key
+    tx = state.tx
+    suspect_since = state.suspect_since
+    confirms = state.confirms
+    own_inc = state.own_inc
+    awareness = state.awareness
+
+    # Leave intent: the leaver re-stamps its self-view LEFT at its own
+    # incarnation and gossips it (serf Leave broadcasts the intent
+    # before shutdown; memberlist encodes it as dead-with-Node==From).
+    diag = key_m[rows, rows]
+    diag_val = jnp.where(
+        leaving, make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE)
+    )
+    diag_val = jnp.maximum(diag, diag_val)  # never regress the self-view
+    key_m = key_m.at[rows, rows].set(jnp.where(present, diag_val, diag))
+    tx = tx.at[rows, rows].set(
+        jnp.where(diag_val > diag, cfg.tx_limit, tx[rows, rows])
+    )
+
+    # ------------------------------------------------------------------
+    # 1. Gossip: drain the top-M queued messages into a compound packet
+    #    for each of F random targets (state.go:566-616 gossip).
+    # ------------------------------------------------------------------
+    # Priority = remaining budget (fresh news has the most), random
+    # tie-break (queue.go orders by transmit count, ties random).
+    prio = tx.astype(jnp.float32) + jax.random.uniform(k_tie, (n, n))
+    _, subj = jax.lax.top_k(prio, M)                         # int32[n, M]
+    subj = subj.astype(jnp.int32)
+    msg_key = jnp.take_along_axis(key_m, subj, axis=1)       # [n, M]
+    msg_valid = (
+        (jnp.take_along_axis(tx, subj, axis=1) > 0)
+        & (msg_key >= 0)
+        & participates[:, None]
+    )
+
+    targets = sample_peers(k_tgt, n, F)                      # [n, F]
+    tgt_view = jnp.take_along_axis(key_m, targets, axis=1)   # sender's view
+    # Senders only gossip to members they consider non-dead
+    # (kRandomNodes filters dead/left, state.go:575-585).
+    tgt_sendable = (tgt_view >= 0) & (key_rank(tgt_view) <= RANK_SUSPECT)
+    packet_ok = (
+        participates[:, None]
+        & tgt_sendable
+        & bernoulli_mask(k_loss, (n, F), 1.0 - cfg.loss)
+        & (present & ~crashed & ~departed)[targets]          # receiver up
+    )
+
+    # Scatter every (sender, target, message) triple:
+    #   key_rx[r, s] = max key among arriving messages about s at r.
+    recv = jnp.broadcast_to(targets[:, :, None], (n, F, M))  # receiver idx
+    subj3 = jnp.broadcast_to(subj[:, None, :], (n, F, M))
+    val3 = jnp.broadcast_to(msg_key[:, None, :], (n, F, M))
+    ok3 = packet_ok[:, :, None] & msg_valid[:, None, :]
+    flat = jnp.where(ok3, recv * n + subj3, n * n)           # drop bucket
+    key_rx = (
+        jnp.full((n * n,), -1, jnp.int32)
+        .at[flat.ravel()]
+        .max(val3.ravel(), mode="drop")
+        .reshape(n, n)
+    )
+    # Suspect-class arrivals separately, for confirmation counting.
+    sus_val = jnp.where(key_rank(val3) == RANK_SUSPECT, key_inc(val3), -1)
+    sus_inc_rx = (
+        jnp.full((n * n,), -1, jnp.int32)
+        .at[flat.ravel()]
+        .max(sus_val.ravel(), mode="drop")
+        .reshape(n, n)
+    )
+
+    # Transmit budget: one transmission per target packet per drained
+    # message (queue.go:288-373), spent whether or not the UDP packet
+    # survived.
+    spend = jnp.where(msg_valid, F, 0)
+    tx = jnp.maximum(
+        tx.at[jnp.repeat(rows, M), subj.ravel()].add(-spend.ravel()), 0
+    )
+
+    # ------------------------------------------------------------------
+    # 2. Push/pull anti-entropy (state.go:622-657): initiators exchange
+    #    FULL state with one partner over TCP; both sides converge to
+    #    the cellwise precedence-max of the two rows (mergeState,
+    #    state.go:1283).
+    # ------------------------------------------------------------------
+    if cfg.push_pull_enabled:
+        known_cnt = jnp.sum(
+            (key_m >= 0) & (key_rank(key_m) <= RANK_SUSPECT), axis=1
+        )
+        # A node that knows only itself (a joiner) syncs immediately —
+        # that's Join → pushPullNode (memberlist.go:249); others fire at
+        # the Poissonized anti-entropy rate.
+        needs_join = participates & (known_cnt <= 1)
+        initiate = participates & (
+            needs_join
+            | bernoulli_mask(k_pp, (n,), 1.0 / cfg.push_pull_ticks)
+        )
+        partner = sample_probe_targets(k_ppsel, n)
+        pp_ok = initiate & participates[partner]
+        # Pull: initiator merges the partner's full row set.
+        key_rx = jnp.maximum(
+            key_rx, jnp.where(pp_ok[:, None], key_m[partner], -1)
+        )
+        # Push: partner merges the initiator's rows (scatter-max; the
+        # merge is idempotent so concurrent exchanges compose).
+        prow = jnp.where(pp_ok, partner, n)
+        key_rx = key_rx.at[prow].max(key_m, mode="drop")
+
+    # ------------------------------------------------------------------
+    # 3. Refutation: a node that hears itself suspected/declared dead at
+    #    >= its own incarnation re-asserts aliveness at accused+1
+    #    (state.go:880-915 refute; 1166-1170, 1246-1251) and takes a
+    #    health penalty (awareness.ApplyDelta(1) in refute).
+    # ------------------------------------------------------------------
+    self_rx = key_rx[rows, rows]
+    accused = jnp.where(
+        key_rank(self_rx) >= RANK_SUSPECT, key_inc(self_rx), -1
+    )
+    refuting = participates & ~leaving & (accused >= own_inc)
+    own_inc = jnp.where(refuting, accused + 1, own_inc)
+    awareness = jnp.clip(
+        awareness + refuting.astype(jnp.int32),
+        0, cfg.profile.awareness_max_multiplier - 1,
+    )
+    # Self-view never merges from the wire; re-stamp it post-refute.
+    key_rx = key_rx.at[rows, rows].set(-1)
+    self_key = jnp.where(
+        leaving, make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE)
+    )
+    key_after_refute = key_m.at[rows, rows].max(
+        jnp.where(present, self_key, -1)
+    )
+    tx = tx.at[rows, rows].set(
+        jnp.where(refuting, cfg.tx_limit, tx[rows, rows])
+    )
+
+    # ------------------------------------------------------------------
+    # 4. Merge deliveries (gossip + push/pull) into the view matrix.
+    # ------------------------------------------------------------------
+    old_key = key_after_refute
+    new_key = jnp.maximum(old_key, key_rx)
+    changed = new_key > old_key
+    fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
+    suspect_since = jnp.where(
+        fresh_suspect, t, jnp.where(changed, NEVER, suspect_since)
+    )
+    # Confirmations: an arriving suspect message at the incarnation we
+    # already suspect is an independent confirmation, re-gossiped when
+    # it advances the count (suspicion.go:103-130; distinctness
+    # approximated as in models/swim.py — at most one per tick).
+    confirming = (
+        ~changed
+        & (key_rank(old_key) == RANK_SUSPECT)
+        & (sus_inc_rx >= key_inc(old_key))
+    )
+    new_confirms = jnp.minimum(
+        confirms + confirming.astype(jnp.int32), cfg.confirmations_k
+    )
+    gained_conf = confirming & (new_confirms > confirms)
+    confirms = jnp.where(changed, 0, new_confirms)
+    tx = jnp.where(changed | gained_conf, cfg.tx_limit, tx)
+    key_m = new_key
+
+    # ------------------------------------------------------------------
+    # 5. Probe plane (state.go:214-497), every ProbeInterval.
+    # ------------------------------------------------------------------
+    if cfg.probe_enabled:
+        is_probe_tick = (t % cfg.probe_interval_ticks) == 0
+        ptarget = sample_probe_targets(k_probe, n)
+        pt_view = key_m[rows, ptarget]
+        probing = (
+            is_probe_tick
+            & participates
+            & (pt_view >= 0)
+            & (key_rank(pt_view) <= RANK_SUSPECT)
+        )
+        target_up = (present & ~crashed & ~departed)[ptarget]
+        p_fail = jnp.where(
+            target_up, jnp.float32(cfg.probe_fail_prob_alive), 1.0
+        )
+        failed = probing & bernoulli_mask(k_pfail, (n,), p_fail)
+        # Lifeguard health score: failed probes degrade, acked probes
+        # recover (awareness.go:14-49 ApplyDelta call sites in
+        # state.go probeNode / handleAckPayload).
+        awareness = jnp.clip(
+            awareness + failed.astype(jnp.int32)
+            - (probing & ~failed).astype(jnp.int32),
+            0, cfg.profile.awareness_max_multiplier - 1,
+        )
+        # A failed probe matures into suspicion after the probe cycle
+        # plus the awareness-scaled timeout (awareness.go:64
+        # ScaleTimeout: a degraded observer waits longer, trading
+        # detection latency for false-positive immunity).
+        can_pend = failed & (state.probe_pending_at == NEVER)
+        matures_at = (
+            t + cfg.probe_interval_ticks + awareness * cfg.probe_timeout_ticks
+        )
+        probe_pending_at = jnp.where(
+            can_pend, matures_at, state.probe_pending_at
+        )
+        probe_subject = jnp.where(can_pend, ptarget, state.probe_subject)
+
+        mature = probe_pending_at <= t
+        mcol = jnp.where(mature, probe_subject, n)
+        mview = key_m[rows, probe_subject]
+        # Suspect at the incarnation currently attached to the view
+        # (probeNode suspects with state.Incarnation, state.go:495-496),
+        # only if the view is still ALIVE.
+        apply_sus = mature & (key_rank(mview) == RANK_ALIVE)
+        sus_key = make_key(key_inc(mview), RANK_SUSPECT)
+        scol = jnp.where(apply_sus, mcol, n)
+        key_m = key_m.at[rows, scol].set(
+            jnp.where(apply_sus, sus_key, 0), mode="drop"
+        )
+        suspect_since = suspect_since.at[rows, scol].set(
+            jnp.where(apply_sus, t, 0), mode="drop"
+        )
+        confirms = confirms.at[rows, scol].set(0, mode="drop")
+        tx = tx.at[rows, scol].set(cfg.tx_limit, mode="drop")
+        probe_pending_at = jnp.where(mature, NEVER, probe_pending_at)
+    else:
+        probe_pending_at = state.probe_pending_at
+        probe_subject = state.probe_subject
+
+    # ------------------------------------------------------------------
+    # 6. Suspicion expiry -> DEAD at the suspicion's incarnation
+    #    (state.go:1200-1215), Lifeguard-accelerated by confirmations.
+    # ------------------------------------------------------------------
+    timeout = _lifeguard_timeout_ticks(cfg, confirms)
+    elapsed = (t - suspect_since).astype(jnp.float32)
+    expire = (
+        (key_rank(key_m) == RANK_SUSPECT)
+        & (suspect_since != NEVER)
+        & (elapsed >= timeout)
+    )
+    key_m = jnp.where(expire, make_key(key_inc(key_m), RANK_DEAD), key_m)
+    suspect_since = jnp.where(expire, NEVER, suspect_since)
+    tx = jnp.where(expire, cfg.tx_limit, tx)
+
+    return MembershipState(
+        key=key_m,
+        suspect_since=suspect_since,
+        confirms=confirms,
+        tx=tx,
+        own_inc=own_inc,
+        awareness=awareness,
+        probe_pending_at=probe_pending_at,
+        probe_subject=probe_subject,
+        tick=t + 1,
+    )

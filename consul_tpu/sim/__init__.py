@@ -1,19 +1,25 @@
 """Scan-based simulation engine, convergence metrics, scenario presets."""
 
 from consul_tpu.sim.engine import (
+    membership_scan,
     run_broadcast,
+    run_membership,
     run_swim,
     broadcast_scan,
     swim_scan,
 )
 from consul_tpu.sim.metrics import (
     time_to_fraction,
+    MembershipReport,
     BroadcastReport,
     SwimReport,
 )
 from consul_tpu.sim.scenarios import SCENARIOS, run_scenario
 
 __all__ = [
+    "membership_scan",
+    "run_membership",
+    "MembershipReport",
     "run_broadcast",
     "run_swim",
     "broadcast_scan",

@@ -21,6 +21,14 @@ from consul_tpu.models.broadcast import (
     broadcast_init,
     broadcast_round,
 )
+from consul_tpu.models.membership import (
+    RANK_DEAD,
+    RANK_SUSPECT,
+    MembershipConfig,
+    key_rank,
+    membership_init,
+    membership_round,
+)
 from consul_tpu.models.swim import (
     SwimConfig,
     swim_init,
@@ -54,6 +62,38 @@ def swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int):
             jnp.sum(nxt.view == VIEW_SUSPECT, dtype=jnp.int32),
             jnp.sum(nxt.view == VIEW_DEAD, dtype=jnp.int32),
         )
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"))
+def membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
+                    track: tuple = ()):
+    """Run ``steps`` ticks of the full-membership sim.
+
+    Per tick, for each tracked subject j: how many OTHER nodes view j
+    SUSPECT / DEAD; plus the global count of suspect cells (the
+    false-positive pressure gauge) and the mean membership-list size
+    (join/leave convergence).
+    """
+    track_idx = jnp.asarray(track, jnp.int32) if track else jnp.zeros(
+        (0,), jnp.int32
+    )
+
+    def tick(carry, k):
+        nxt = membership_round(carry, k, cfg)
+        ranks = key_rank(nxt.key)
+        cols = ranks[:, track_idx] if track else jnp.zeros(
+            (cfg.n, 0), jnp.int32
+        )
+        out = (
+            jnp.sum(cols == RANK_SUSPECT, axis=0, dtype=jnp.int32),
+            jnp.sum(cols == RANK_DEAD, axis=0, dtype=jnp.int32),
+            jnp.sum(ranks == RANK_SUSPECT, dtype=jnp.int32),
+            jnp.sum((nxt.key >= 0) & (ranks <= RANK_SUSPECT), dtype=jnp.int32),
+        )
+        return nxt, out
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
@@ -98,6 +138,50 @@ def run_broadcast(
         ticks=steps,
         tick_ms=cfg.profile.gossip_interval_ms,
         infected=np.asarray(infected),
+        wall_s=wall,
+    )
+
+
+def run_membership(
+    cfg: MembershipConfig,
+    steps: int,
+    seed: int = 0,
+    track: tuple = (),
+    sharded: bool = False,
+    mesh=None,
+    warmup: bool = True,
+):
+    """Full-membership study; ``track`` selects the subject columns whose
+    detection curves come back per tick."""
+    from consul_tpu.sim.metrics import MembershipReport
+
+    def make_state():
+        st = membership_init(cfg)
+        return shard_state(st, mesh or make_mesh()) if sharded else st
+
+    key = jax.random.PRNGKey(seed)
+    if warmup:
+        _, out = membership_scan(make_state(), key, cfg, steps, track)
+        jax.tree_util.tree_map(np.asarray, out)
+    t0 = time.perf_counter()
+    _, (sus, dead, sus_cells, known) = membership_scan(
+        make_state(), key, cfg, steps, track
+    )
+    sus, dead, sus_cells, known = (
+        np.asarray(sus), np.asarray(dead), np.asarray(sus_cells),
+        np.asarray(known),
+    )
+    wall = time.perf_counter() - t0
+    return MembershipReport(
+        n=cfg.n,
+        ticks=steps,
+        tick_ms=cfg.profile.gossip_interval_ms,
+        probe_interval_ms=cfg.profile.probe_interval_ms,
+        track=tuple(track),
+        suspecting=sus,
+        dead_known=dead,
+        suspect_cells=sus_cells,
+        known_members=known,
         wall_s=wall,
     )
 

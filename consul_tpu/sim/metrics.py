@@ -51,6 +51,56 @@ class BroadcastReport:
 
 
 @dataclasses.dataclass
+class MembershipReport:
+    """Detection curves from a full-membership study (one column per
+    tracked subject)."""
+
+    n: int
+    ticks: int
+    tick_ms: float
+    probe_interval_ms: float
+    track: tuple                  # tracked subject ids
+    suspecting: np.ndarray        # int32[ticks, S] — observers suspecting j
+    dead_known: np.ndarray        # int32[ticks, S]
+    suspect_cells: np.ndarray     # int32[ticks] — global suspicion pressure
+    known_members: np.ndarray     # int32[ticks] — sum of membership sizes
+    wall_s: float
+
+    @property
+    def rounds_per_sec(self) -> float:
+        return self.ticks / self.wall_s if self.wall_s > 0 else float("inf")
+
+    def first_tick(self, counts: np.ndarray) -> Optional[int]:
+        hit = np.nonzero(np.asarray(counts) > 0)[0]
+        return int(hit[0]) if hit.size else None
+
+    def first_detection_ms(self, subject_pos: int) -> Optional[float]:
+        """First tick any observer suspects tracked subject #pos."""
+        t = self.first_tick(self.suspecting[:, subject_pos])
+        return None if t is None else (t + 1) * self.tick_ms
+
+    def dead_converged(self, subject_pos: int, observers: int) -> Optional[int]:
+        """First tick when every live observer views the subject DEAD."""
+        hit = np.nonzero(self.dead_known[:, subject_pos] >= observers)[0]
+        return int(hit[0]) if hit.size else None
+
+    def summary(self) -> dict:
+        return {
+            "n": self.n,
+            "ticks": self.ticks,
+            "tick_ms": self.tick_ms,
+            "tracked": list(self.track),
+            "first_suspect_ms": [
+                self.first_detection_ms(i) for i in range(len(self.track))
+            ],
+            "dead_known_final": self.dead_known[-1].tolist(),
+            "suspect_cells_final": int(self.suspect_cells[-1]),
+            "mean_membership_final": float(self.known_members[-1]) / self.n,
+            "sim_rounds_per_sec": self.rounds_per_sec,
+        }
+
+
+@dataclasses.dataclass
 class SwimReport:
     """Failure-detection summary for one subject."""
 

@@ -21,9 +21,9 @@ from typing import Callable
 import jax
 import numpy as np
 
-from consul_tpu.models import BroadcastConfig, SwimConfig
+from consul_tpu.models import BroadcastConfig, MembershipConfig, SwimConfig
 from consul_tpu.protocol import LAN, WAN
-from consul_tpu.sim.engine import run_broadcast, run_swim
+from consul_tpu.sim.engine import run_broadcast, run_membership, run_swim
 
 
 def dev3(seed: int = 0) -> dict:
@@ -39,24 +39,30 @@ def dev3(seed: int = 0) -> dict:
 def probe1k(seed: int = 0) -> dict:
     """BASELINE config 2: 1k nodes, SWIM probe/ack, 1% induced failure.
 
-    1% of 1000 nodes = 10 independent crash subjects, vmapped."""
-    cfg = SwimConfig(n=1000, subject=0, loss=0.0, profile=LAN,
-                     delivery="edges")
-    # 1% of 1000 nodes = 10 subjects, run as independent studies (the
-    # subject index only relabels nodes, so varying the seed is the
-    # faithful ensemble).
-    summaries = [
-        run_swim(cfg, steps=200, seed=seed + s, warmup=False).summary()
-        for s in range(10)
-    ]
-    first_sus = [s["first_suspect_ms"] for s in summaries]
-    first_dead = [s["first_dead_ms"] for s in summaries]
+    1% of 1000 = 10 CONCURRENT crashes in one full-membership program
+    (models/membership.py): the failures interact through shared gossip
+    bandwidth, confirmation cross-talk, and the push/pull backstop —
+    the dynamics 10 independent single-subject universes can't show."""
+    failed = tuple(range(0, 1000, 100))  # 10 spread-out subjects
+    cfg = MembershipConfig(
+        n=1000, loss=0.0, profile=LAN, fanout=3,
+        fail_at=tuple((f, 10) for f in failed),
+    )
+    rep = run_membership(cfg, steps=300, seed=seed, track=failed,
+                         warmup=False)
+    first_sus = [rep.first_detection_ms(i) for i in range(len(failed))]
+    live = cfg.n - len(failed)
+    conv = [rep.dead_converged(i, live) for i in range(len(failed))]
     return {
         "scenario": "probe1k",
-        "n": 1000,
-        "subjects": len(summaries),
-        "mean_first_suspect_ms": float(np.mean(first_sus)),
-        "mean_first_dead_ms": float(np.mean(first_dead)),
+        "n": cfg.n,
+        "subjects": len(failed),
+        "mean_first_suspect_ms": float(np.mean([s for s in first_sus if s])),
+        "all_detected": all(c is not None for c in conv),
+        "mean_converged_ms": float(np.mean(
+            [(c + 1) * rep.tick_ms for c in conv if c is not None]
+        )) if any(c is not None for c in conv) else None,
+        "sim_rounds_per_sec": rep.rounds_per_sec,
     }
 
 

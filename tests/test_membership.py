@@ -1,0 +1,215 @@
+"""Full-membership SWIM sim: concurrent failures, push/pull backstop,
+joins/leaves, refutation, determinism.
+
+Parity model: memberlist's own state-machine tests
+(state_test.go TestMemberList_ProbeNode*, TestMemberlist_PushPull) plus
+the BASELINE probe1k config — 1% concurrent failures in ONE program.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models import (
+    RANK_ALIVE,
+    RANK_DEAD,
+    RANK_LEFT,
+    RANK_SUSPECT,
+    MembershipConfig,
+    key_inc,
+    key_rank,
+    make_key,
+    membership_init,
+    membership_round,
+)
+from consul_tpu.protocol.profiles import LAN
+from consul_tpu.sim import membership_scan, run_membership
+
+# A LAN-timing profile with the anti-entropy period shortened from 30s
+# to 2s so push/pull effects land within test-sized tick budgets.
+FAST_PP = dataclasses.replace(LAN, push_pull_interval_ms=2000)
+
+
+def suspicion_min_ticks(n: int) -> float:
+    # suspicionTimeout lower bound: mult * log10(n) * ProbeInterval
+    # (memberlist/util.go:64-69), in gossip ticks.
+    return 4 * math.log10(max(n, 10)) * (1000 / 200)
+
+
+class TestSingleFailure:
+    def test_detection_and_convergence(self):
+        n, fail_tick = 128, 10
+        cfg = MembershipConfig(n=n, fail_at=((5, fail_tick),))
+        r = run_membership(cfg, steps=250, track=(5,), warmup=False)
+
+        first_sus = r.first_tick(r.suspecting[:, 0])
+        first_dead = r.first_tick(r.dead_known[:, 0])
+        assert first_sus is not None and first_sus >= fail_tick
+        # Nobody declares dead before the suspicion machinery can run
+        # its minimum course after the first suspicion.
+        assert first_dead is not None
+        assert first_dead - first_sus >= suspicion_min_ticks(n) - 1
+        # Every live observer (everyone but the crashed node) converges.
+        assert r.dead_known[-1, 0] == n - 1
+        # Suspicion fully resolves — no lingering suspect cells.
+        assert r.suspect_cells[-1] == 0
+
+    def test_no_failure_no_churn(self):
+        cfg = MembershipConfig(n=64)
+        r = run_membership(cfg, steps=150, track=(3,), warmup=False)
+        assert r.suspecting[:, 0].max() == 0
+        assert r.dead_known[:, 0].max() == 0
+
+
+class TestConcurrentFailures:
+    def test_ten_failures_one_program(self):
+        """BASELINE config 2 shape: 1% of the pool fails at once; the
+        failures share gossip bandwidth and confirmation traffic in ONE
+        simulation (what the vmapped single-subject model couldn't do)."""
+        n = 256
+        failed = tuple(range(10))
+        cfg = MembershipConfig(
+            n=n, loss=0.01, fail_at=tuple((f, 10) for f in failed)
+        )
+        r = run_membership(cfg, steps=300, track=failed, warmup=False)
+        live = n - len(failed)
+        # Every live observer converges on every failed subject.
+        assert (r.dead_known[-1] == live).all(), r.dead_known[-1]
+        assert r.suspect_cells[-1] == 0
+
+
+class TestPushPullBackstop:
+    def test_dead_news_spreads_with_gossip_disabled(self):
+        """Anti-entropy alone converges the view (state.go:622-657): a
+        dead view planted at one node with NO transmit budget and NO
+        probing can only travel via push/pull row merges."""
+        n = 64
+        cfg = MembershipConfig(
+            n=n, profile=FAST_PP, probe_enabled=False,
+            fail_at=((7, 0),),
+        )
+        state = membership_init(cfg)
+        state = state._replace(
+            key=state.key.at[0, 7].set(make_key(jnp.int32(0), RANK_DEAD))
+        )
+        final, _ = membership_scan(state, jax.random.PRNGKey(1), cfg, 200, ())
+        ranks = np.asarray(key_rank(final.key))
+        observers = [i for i in range(n) if i != 7]
+        assert (ranks[observers, 7] == RANK_DEAD).all()
+
+    def test_thirty_pct_loss_converges_fully(self):
+        """Under 30% loss the gossip transmit budget alone leaves
+        stragglers; the push/pull backstop still reaches 100%
+        (the reference's convergence guarantee)."""
+        n = 128
+        cfg = MembershipConfig(
+            n=n, loss=0.30, profile=FAST_PP, fail_at=((9, 10),)
+        )
+        r = run_membership(cfg, steps=300, track=(9,), warmup=False)
+        assert r.dead_known[-1, 0] == n - 1
+
+
+class TestJoinLeave:
+    def test_join_via_push_pull(self):
+        """A joiner knows only itself; its join-time push/pull plus the
+        resulting alive broadcast make it known cluster-wide
+        (Join -> pushPullNode, memberlist.go:249)."""
+        n = 64
+        cfg = MembershipConfig(n=n, profile=FAST_PP, join_at=((63, 5),))
+        state = membership_init(cfg)
+        # Before joining: nobody knows 63, 63 knows nobody.
+        assert int((state.key[:, 63] >= 0).sum()) == 1
+        assert int((state.key[63, :] >= 0).sum()) == 1
+        final, _ = membership_scan(state, jax.random.PRNGKey(2), cfg, 120, ())
+        ranks = np.asarray(key_rank(final.key))
+        # Everyone sees the joiner alive; the joiner sees everyone.
+        assert (ranks[:, 63] == RANK_ALIVE).all()
+        assert (ranks[63, :] == RANK_ALIVE).all()
+
+    def test_graceful_leave_is_left_not_dead(self):
+        n = 64
+        cfg = MembershipConfig(
+            n=n, profile=FAST_PP, leave_at=((11, 10),),
+            leave_grace_ticks=10,
+        )
+        state = membership_init(cfg)
+        final, (sus, dead, _, _) = membership_scan(
+            state, jax.random.PRNGKey(3), cfg, 250, (11,)
+        )
+        ranks = np.asarray(key_rank(final.key))
+        observers = [i for i in range(n) if i != 11]
+        assert (ranks[observers, 11] == RANK_LEFT).all()
+        # A graceful departure never gets declared dead.
+        assert np.asarray(dead).max() == 0
+
+
+class TestRefutation:
+    def test_false_suspicion_is_refuted(self):
+        """A suspected-but-alive node bumps its incarnation and the
+        alive broadcast overrides every suspect view
+        (state.go:880-915, aliveNode override)."""
+        n = 64
+        cfg = MembershipConfig(n=n, probe_enabled=False)
+        state = membership_init(cfg)
+        # Plant a fresh suspicion of node 3 at node 0 with full budget.
+        state = state._replace(
+            key=state.key.at[0, 3].set(make_key(jnp.int32(0), RANK_SUSPECT)),
+            suspect_since=state.suspect_since.at[0, 3].set(0),
+            tx=state.tx.at[0, 3].set(cfg.tx_limit),
+        )
+        final, _ = membership_scan(state, jax.random.PRNGKey(4), cfg, 100, ())
+        ranks = np.asarray(key_rank(final.key))
+        incs = np.asarray(key_inc(final.key))
+        assert int(final.own_inc[3]) >= 1
+        assert (ranks[:, 3] == RANK_ALIVE).all()
+        # Views converged on the refuted incarnation.
+        assert (incs[:, 3] == int(final.own_inc[3])).all()
+
+
+class TestDeterminism:
+    def test_same_key_same_trajectory(self):
+        cfg = MembershipConfig(n=48, loss=0.2, fail_at=((1, 5),))
+        s1, o1 = membership_scan(
+            membership_init(cfg), jax.random.PRNGKey(7), cfg, 60, (1,)
+        )
+        s2, o2 = membership_scan(
+            membership_init(cfg), jax.random.PRNGKey(7), cfg, 60, (1,)
+        )
+        assert (np.asarray(s1.key) == np.asarray(s2.key)).all()
+        assert (np.asarray(o1[0]) == np.asarray(o2[0])).all()
+
+    def test_different_key_different_trajectory(self):
+        cfg = MembershipConfig(n=48, loss=0.2, fail_at=((1, 5),))
+        s1, _ = membership_scan(
+            membership_init(cfg), jax.random.PRNGKey(7), cfg, 60, ()
+        )
+        s2, _ = membership_scan(
+            membership_init(cfg), jax.random.PRNGKey(8), cfg, 60, ()
+        )
+        assert (np.asarray(s1.key) != np.asarray(s2.key)).any()
+
+
+class TestAwareness:
+    def test_failed_probes_degrade_health(self):
+        """Lifeguard: probing crashed members raises the prober's
+        awareness score (awareness.go ApplyDelta(+1) on probe
+        timeout); with half the cluster down, scores move."""
+        n = 64
+        cfg = MembershipConfig(
+            n=n, fail_at=tuple((i, 0) for i in range(n // 2))
+        )
+        state = membership_init(cfg)
+        # Run a handful of probe cycles.
+        final, _ = membership_scan(state, jax.random.PRNGKey(5), cfg, 30, ())
+        aw = np.asarray(final.awareness)
+        assert aw[n // 2:].max() >= 1
+
+    def test_healthy_cluster_stays_at_zero(self):
+        cfg = MembershipConfig(n=64)
+        final, _ = membership_scan(
+            membership_init(cfg), jax.random.PRNGKey(6), cfg, 30, ()
+        )
+        assert np.asarray(final.awareness).max() == 0

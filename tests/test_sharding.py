@@ -63,3 +63,17 @@ def test_graft_dryrun_smoke():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_membership_sharded_matches_unsharded():
+    from consul_tpu.models import MembershipConfig
+    from consul_tpu.sim import run_membership
+
+    cfg = MembershipConfig(n=256, loss=0.1, fail_at=((3, 5), (100, 5)))
+    r1 = run_membership(cfg, steps=40, seed=9, track=(3, 100),
+                        sharded=False, warmup=False)
+    r2 = run_membership(cfg, steps=40, seed=9, track=(3, 100),
+                        sharded=True, warmup=False)
+    assert np.array_equal(r1.dead_known, r2.dead_known)
+    assert np.array_equal(r1.suspecting, r2.suspecting)
+    assert np.array_equal(r1.suspect_cells, r2.suspect_cells)
