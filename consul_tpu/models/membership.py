@@ -292,7 +292,7 @@ def membership_round(
         participates[:, None]
         & tgt_sendable
         & bernoulli_mask(k_loss, (n, F), 1.0 - cfg.loss)
-        & (present & ~crashed & ~departed)[targets]          # receiver up
+        & participates[targets]                              # receiver up
     )
 
     # Scatter every (sender, target, message) triple:
@@ -422,7 +422,7 @@ def membership_round(
             & (pt_view >= 0)
             & (key_rank(pt_view) <= RANK_SUSPECT)
         )
-        target_up = (present & ~crashed & ~departed)[ptarget]
+        target_up = participates[ptarget]
         p_fail = jnp.where(
             target_up, jnp.float32(cfg.probe_fail_prob_alive), 1.0
         )
@@ -448,7 +448,9 @@ def membership_round(
         )
         probe_subject = jnp.where(can_pend, ptarget, state.probe_subject)
 
-        mature = probe_pending_at <= t
+        # A crashed observer mutates nothing: its pending probe never
+        # matures (a real dead process runs no timers).
+        mature = (probe_pending_at <= t) & participates
         mcol = jnp.where(mature, probe_subject, n)
         mview = key_m[rows, probe_subject]
         # Suspect at the incarnation currently attached to the view
@@ -480,6 +482,9 @@ def membership_round(
         (key_rank(key_m) == RANK_SUSPECT)
         & (suspect_since != NEVER)
         & (elapsed >= timeout)
+        # Crashed observers' frozen rows never advance SUSPECT->DEAD
+        # (their suspicion timers died with the process).
+        & participates[:, None]
     )
     key_m = jnp.where(expire, make_key(key_inc(key_m), RANK_DEAD), key_m)
     suspect_since = jnp.where(expire, NEVER, suspect_since)

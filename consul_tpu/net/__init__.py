@@ -11,6 +11,8 @@ executed by an asyncio event loop over pluggable transports.
   broadcast_queue.py TransmitLimitedQueue equivalent
   suspicion.py       Lifeguard suspicion timer
   memberlist.py      SWIM membership + failure detection
+  sim_transport.py   the sim↔host bridge: a Transport backed by the
+                     XLA membership simulator (the north-star seam)
 """
 
 from consul_tpu.net.wire import MessageType, encode, decode
@@ -22,8 +24,12 @@ from consul_tpu.net.transport import (
 )
 from consul_tpu.net.broadcast_queue import TransmitLimitedQueue
 from consul_tpu.net.memberlist import Memberlist, MemberlistConfig, Node
+from consul_tpu.net.sim_transport import SimBridge, SimPoolConfig, SimTransport
 
 __all__ = [
+    "SimBridge",
+    "SimPoolConfig",
+    "SimTransport",
     "MessageType",
     "encode",
     "decode",

@@ -160,18 +160,10 @@ def run_membership(
         return shard_state(st, mesh or make_mesh()) if sharded else st
 
     key = jax.random.PRNGKey(seed)
-    if warmup:
-        _, out = membership_scan(make_state(), key, cfg, steps, track)
-        jax.tree_util.tree_map(np.asarray, out)
-    t0 = time.perf_counter()
-    _, (sus, dead, sus_cells, known) = membership_scan(
-        make_state(), key, cfg, steps, track
+    scan = functools.partial(membership_scan, track=tuple(track))
+    _, (sus, dead, sus_cells, known), wall = _timed(
+        make_state, scan, key, cfg, steps, warmup
     )
-    sus, dead, sus_cells, known = (
-        np.asarray(sus), np.asarray(dead), np.asarray(sus_cells),
-        np.asarray(known),
-    )
-    wall = time.perf_counter() - t0
     return MembershipReport(
         n=cfg.n,
         ticks=steps,

@@ -57,7 +57,9 @@ def probe1k(seed: int = 0) -> dict:
         "scenario": "probe1k",
         "n": cfg.n,
         "subjects": len(failed),
-        "mean_first_suspect_ms": float(np.mean([s for s in first_sus if s])),
+        "mean_first_suspect_ms": float(
+            np.mean([s for s in first_sus if s])
+        ) if any(first_sus) else None,
         "all_detected": all(c is not None for c in conv),
         "mean_converged_ms": float(np.mean(
             [(c + 1) * rep.tick_ms for c in conv if c is not None]

@@ -1,0 +1,260 @@
+"""The north-star seam: a real host Memberlist/Cluster running over the
+XLA-simulated gossip pool via SimTransport (SURVEY.md §2.5; reference
+seam memberlist/transport.go:28-66, precedent mock_transport.go:14-66).
+
+What these tests pin:
+  * a host agent JOINs a simulated pool through the standard push/pull
+    path and sees every simulated member,
+  * simulated failures are detected by the simulated protocol machinery
+    and reach the host as member events through gossiped obituaries,
+  * a user event fired by the host agent infects the simulated
+    population epidemically,
+  * the population learns the host exists and probes it (the host's
+    refutation path answers),
+  * it works at 10k+ simulated members.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from helpers import wait_until
+
+from consul_tpu.net.memberlist import Memberlist, MemberlistConfig, NodeStatus
+from consul_tpu.net.sim_transport import SimBridge, SimPoolConfig, sim_addr
+from consul_tpu.eventing.cluster import Cluster, ClusterConfig, EventType
+from consul_tpu.protocol.profiles import GossipProfile, LAN
+
+SCALE = 0.01
+
+# A detection-accelerated profile for big-N tests: probes every gossip
+# tick, minimal suspicion multiplier — protocol structure identical,
+# constants shrunk so a 10k-member failure resolves in tens of ticks.
+FAST = GossipProfile(
+    name="fast",
+    probe_interval_ms=200,
+    probe_timeout_ms=200,
+    indirect_checks=3,
+    suspicion_mult=2,
+    suspicion_max_timeout_mult=2,
+    awareness_max_multiplier=8,
+    gossip_interval_ms=200,
+    gossip_nodes=3,
+    gossip_to_the_dead_ms=30_000,
+    retransmit_mult=4,
+    push_pull_interval_ms=30_000,
+)
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+async def attach_host(bridge, name="host0", profile=LAN):
+    transport = bridge.transport(f"sim-host://{name}")
+    m = Memberlist(
+        MemberlistConfig(name=name, profile=profile, interval_scale=SCALE),
+        transport,
+    )
+    await m.start()
+    return m, transport
+
+
+def test_host_joins_simulated_pool():
+    async def main():
+        n = 512
+        bridge = SimBridge(SimPoolConfig(n=n, interval_scale=SCALE,
+                                         realtime=False))
+        host, transport = await attach_host(bridge)
+        assert await host.join([sim_addr(0)]) == 1
+        # One push/pull returned the full simulated membership.
+        assert len(host.members()) == n + 1
+        assert {m.name for m in host.members()} >= {"sim-0", "sim-511"}
+        # The joined-through member knows the host; knowledge spreads.
+        assert bridge.host_awareness(transport) > 0
+        await bridge.run_ticks(20)
+        assert bridge.host_awareness(transport) > 0.9
+        await host.shutdown()
+        await bridge.shutdown()
+
+    run(main())
+
+
+def test_simulated_failure_reaches_host_as_member_event():
+    async def main():
+        n = 512
+        failed = 7
+        leaves: list[str] = []
+        bridge = SimBridge(
+            SimPoolConfig(
+                n=n,
+                profile=FAST,
+                interval_scale=SCALE,
+                fail_at=((failed, 5),),
+                realtime=False,
+            )
+        )
+        transport = bridge.transport("sim-host://host0")
+        host = Memberlist(
+            MemberlistConfig(
+                name="host0",
+                profile=FAST,
+                interval_scale=SCALE,
+                notify_leave=lambda node: leaves.append(node.name),
+            ),
+            transport,
+        )
+        await host.start()
+        assert await host.join([sim_addr(0)]) == 1
+
+        # Pump until the simulated protocol detects the crash and the
+        # obituary reaches the host through gossip.
+        for _ in range(30):
+            await bridge.run_ticks(5)
+            node = host.nodes.get("sim-7")
+            if node is not None and node.status == NodeStatus.DEAD:
+                break
+        assert host.nodes["sim-7"].status == NodeStatus.DEAD
+        assert "sim-7" in leaves
+        # Everyone else stays alive in the host's view.
+        alive = [m.name for m in host.members()]
+        assert "sim-7" not in alive
+        assert len(alive) >= n  # n-1 sim members + host itself
+        await host.shutdown()
+        await bridge.shutdown()
+
+    run(main())
+
+
+def test_host_user_event_infects_population():
+    async def main():
+        n = 512
+        bridge = SimBridge(
+            SimPoolConfig(n=n, interval_scale=SCALE, realtime=False)
+        )
+        transport = bridge.transport("sim-host://host0")
+        cluster = Cluster(
+            ClusterConfig(name="host0", interval_scale=SCALE), transport
+        )
+        await cluster.start()
+        assert await cluster.join([sim_addr(0)]) == 1
+        await bridge.run_ticks(3)
+
+        await cluster.user_event("deploy", b"v2-rollout")
+        # Let the host's gossip loop seed a few simulated members, then
+        # the infection spreads on device.
+        await asyncio.sleep(0.05)
+        await bridge.run_ticks(30)
+        coverage = bridge.event_coverage(b"v2-rollout")
+        assert coverage > 0.95, coverage
+        await cluster.shutdown()
+        await bridge.shutdown()
+
+    run(main())
+
+
+def test_population_probes_host_and_host_refutes():
+    async def main():
+        n = 256
+        bridge = SimBridge(
+            SimPoolConfig(n=n, interval_scale=SCALE, realtime=False)
+        )
+        host, transport = await attach_host(bridge)
+        assert await host.join([sim_addr(0)]) == 1
+        await bridge.run_ticks(40)
+        # The pool probed the host at least once and the host acked
+        # every probe (no missed pings -> no standing suspicion).
+        assert transport.ping_seq > 0
+        assert transport.missed_pings == 0
+        assert host.local_node().status == NodeStatus.ALIVE
+        await host.shutdown()
+        await bridge.shutdown()
+
+    run(main())
+
+
+def test_push_pull_backstop_syncs_host():
+    """If the transmit window is missed, the host's periodic push/pull
+    against a random simulated member recovers the full state
+    (state.go:622-657)."""
+
+    async def main():
+        n = 256
+        bridge = SimBridge(
+            SimPoolConfig(
+                n=n,
+                profile=FAST,
+                interval_scale=SCALE,
+                fail_at=((3, 2),),
+                realtime=False,
+            )
+        )
+        host, transport = await attach_host(bridge, profile=FAST)
+        # Let the sim converge on the death of node 3 BEFORE joining, so
+        # the gossip window is long past.
+        await bridge.run_ticks(40)
+        assert await host.join([sim_addr(0)]) == 1
+        # The join push/pull snapshot reflects the converged state: the
+        # dead member is NOT among the live membership.  (Like the
+        # reference, obituaries about never-seen nodes don't create
+        # entries — mergeState routes dead through suspect/dead handlers
+        # which ignore unknown names, state.go:1283+.)
+        alive = {m.name for m in host.members()}
+        assert "sim-3" not in alive
+        assert len(alive) == n  # n-1 live sim members + the host
+        await host.shutdown()
+        await bridge.shutdown()
+
+    run(main())
+
+
+def test_ten_thousand_member_pool():
+    """The VERDICT acceptance bar: a real Memberlist joins a 10k+-member
+    simulated pool, hears about a simulated failure, and a user event
+    fired by the host infects the population."""
+
+    async def main():
+        n = 10_000
+        failed = 4242
+        leaves: list[str] = []
+        bridge = SimBridge(
+            SimPoolConfig(
+                n=n,
+                profile=FAST,
+                interval_scale=SCALE,
+                fail_at=((failed, 3),),
+                realtime=False,
+            )
+        )
+        transport = bridge.transport("sim-host://host0")
+        cluster = Cluster(
+            ClusterConfig(name="host0", profile=FAST, interval_scale=SCALE),
+            transport,
+        )
+        cluster.config.on_event = lambda ev: (
+            leaves.extend(m.name for m in ev.members)
+            if ev.type == EventType.MEMBER_FAILED
+            else None
+        )
+        await cluster.start()
+        assert await cluster.join([sim_addr(17)]) == 1
+        assert len(cluster.memberlist.members()) == n + 1
+
+        await cluster.user_event("deploy", b"big-pool-event")
+        await asyncio.sleep(0.05)
+
+        detected = False
+        for _ in range(12):
+            await bridge.run_ticks(5)
+            node = cluster.memberlist.nodes.get(f"sim-{failed}")
+            if node is not None and node.status == NodeStatus.DEAD:
+                detected = True
+                break
+        assert detected, "simulated failure never reached the host"
+        coverage = bridge.event_coverage(b"big-pool-event")
+        assert coverage > 0.9, coverage
+        await cluster.shutdown()
+        await bridge.shutdown()
+
+    run(main())
