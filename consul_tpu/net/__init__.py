@@ -24,7 +24,18 @@ from consul_tpu.net.transport import (
 )
 from consul_tpu.net.broadcast_queue import TransmitLimitedQueue
 from consul_tpu.net.memberlist import Memberlist, MemberlistConfig, Node
-from consul_tpu.net.sim_transport import SimBridge, SimPoolConfig, SimTransport
+
+
+def __getattr__(name):
+    # sim_transport is the only net module that needs jax; load it
+    # lazily so the host plane stays importable without an accelerator
+    # runtime.
+    if name in ("SimBridge", "SimPoolConfig", "SimTransport"):
+        from consul_tpu.net import sim_transport
+
+        return getattr(sim_transport, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "SimBridge",

@@ -146,10 +146,10 @@ def _inject_and_step(
     same merge rule as any gossip delivery — membership.py docstring),
     refresh the transmit budget for cells that advanced so the
     population re-gossips the host's news, then run one protocol tick."""
-    old = state.key[inj_row, inj_col]
-    merged = jnp.maximum(old, inj_val)
-    key_m = state.key.at[inj_row, inj_col].set(merged, mode="drop")
-    advanced = merged > old
+    # Scatter-max handles duplicate (row, col) slots correctly (unlike
+    # .set(), whose result for repeated indices is unspecified).
+    key_m = state.key.at[inj_row, inj_col].max(inj_val, mode="drop")
+    advanced = inj_val > state.key[inj_row, inj_col]
     tx = state.tx.at[inj_row, inj_col].max(
         jnp.where(advanced, cfg.tx_limit, -1), mode="drop"
     )
@@ -233,11 +233,14 @@ class _BridgeStream(Stream):
             raise ConnectionError("stream closed")
         t, body = wire.decode(payload)
         if t == wire.MessageType.PUSH_PULL:
-            self._bridge._on_host_push_pull(self._j, body, self._host)
+            key_row = np.asarray(self._bridge.state.key[self._j])
+            self._bridge._on_host_push_pull(
+                self._j, body, self._host, key_row
+            )
             self._inbox.put_nowait(
                 wire.encode(
                     wire.MessageType.PUSH_PULL,
-                    self._bridge._pool_state_body(self._j),
+                    self._bridge._pool_state_body(self._j, key_row),
                 )
             )
         elif t == wire.MessageType.PING:
@@ -273,7 +276,7 @@ class SimTransport(Transport):
         # Simulated probing of this host (state.go:214-256 from the
         # pool's perspective).
         self.ping_seq = 0
-        self.pending_pings: dict[int, float] = {}  # seq -> deadline
+        self.pending_pings: dict[int, int] = {}  # seq -> deadline tick
         self.missed_pings = 0
         # Highest incarnation the host has asserted for itself (learned
         # from its ALIVE refutation broadcasts); suspicions the pool
@@ -449,24 +452,26 @@ class SimBridge:
                 infection.done = True
 
         self.tick += 1
+        up_np = self._participates_np()
         for host in list(self.hosts.values()):
-            self._deliver_to_host(host)
+            known_np = np.asarray(host.known.infected)
+            self._deliver_to_host(host, up_np, known_np)
             if self.cfg.probe_host:
-                self._probe_host(host)
+                self._probe_host(host, up_np, known_np)
 
     # ------------------------------------------------------------------
     # sim → host
     # ------------------------------------------------------------------
 
-    def _deliver_to_host(self, host: SimTransport) -> None:
+    def _deliver_to_host(
+        self, host: SimTransport, up: np.ndarray, known: np.ndarray
+    ) -> None:
         """Members that know the host include it in their gossip target
         selection like any other peer: P(host among fanout picks) ≈
         fanout/n, so expected packets/tick ≈ knowers·fanout/n
         (state.go:566-616 gossip + kRandomNodes)."""
         if host.closed:
             return
-        known = np.asarray(host.known.infected)
-        up = self._participates_np()
         knowers = np.flatnonzero(known & up)
         if knowers.size == 0:
             return
@@ -503,6 +508,8 @@ class SimBridge:
             for j in order[: self.cfg.piggyback]:
                 msgs.append(self._view_message(int(i), int(j), int(key_row[j])))
         for body, infection in self.events.items():
+            if infection.done:
+                continue  # tx exhausted everywhere: nothing to send
             if bool(infection.infected[i]) and int(infection.tx[i]) > 0:
                 # body is the already-encoded msgpack tail of the USER
                 # message as it arrived; re-prefix the type byte only.
@@ -540,16 +547,20 @@ class SimBridge:
             {"inc": inc, "node": name, "from": author},
         )
 
-    def _probe_host(self, host: SimTransport) -> None:
+    def _probe_host(
+        self, host: SimTransport, up: np.ndarray, known: np.ndarray
+    ) -> None:
         """Simulated members probe the host once per probe interval in
         expectation; a missed ack deadline gossips a suspect-host
         message back so the host's refutation path runs
-        (state.go:214-256, 880-915)."""
+        (state.go:214-256, 880-915).  Deadlines are tick-denominated so
+        the pump's time model (which may run slower than wall clock)
+        never produces spurious suspicion."""
         if host.closed:
             return
         now = time.monotonic()
         for seq, deadline in list(host.pending_pings.items()):
-            if now >= deadline:
+            if self.tick >= deadline:
                 del host.pending_pings[seq]
                 host.missed_pings += 1
                 # The prober suspects the host; the suspicion reaches
@@ -571,8 +582,6 @@ class SimBridge:
                 )
         if self.tick % self.mcfg.probe_interval_ticks != 0:
             return
-        known = np.asarray(host.known.infected)
-        up = self._participates_np()
         knowers = np.flatnonzero(known & up)
         if knowers.size == 0:
             return
@@ -583,12 +592,11 @@ class SimBridge:
         prober = int(self._host_rng.choice(knowers))
         host.ping_seq += 1
         seq = host.ping_seq
-        timeout = (
-            self.cfg.profile.probe_timeout_ms
-            / 1000.0
-            * self.cfg.interval_scale
+        # Ack must land within the probe cycle (probe_interval ticks),
+        # with slack for the host's event loop to run between ticks.
+        host.pending_pings[seq] = (
+            self.tick + 2 * self.mcfg.probe_interval_ticks + 2
         )
-        host.pending_pings[seq] = now + max(timeout, 4 * self.cfg.tick_seconds)
         host.packets.put_nowait(
             (
                 wire.encode(
@@ -715,12 +723,14 @@ class SimBridge:
         infection.seed(j, self.mcfg.tx_limit)
 
     def _on_host_push_pull(
-        self, j: int, body: dict, host: SimTransport
+        self, j: int, body: dict, host: SimTransport, key_row: np.ndarray
     ) -> None:
         """Host side of pushPullNode (state.go:622-657): the host pushed
         its state; the population learns the host exists (and would
         learn any other real members the host knows, but those route
-        host↔host)."""
+        host↔host).  Only entries that actually ADVANCE row j are
+        queued for injection — a periodic push/pull is otherwise almost
+        entirely no-ops and would flood the per-tick injection budget."""
         host.known.seed(j, self.mcfg.tx_limit)
         for snap in body.get("nodes", ()):
             name = snap.get("name", "")
@@ -733,14 +743,13 @@ class SimBridge:
                     continue
                 if 0 <= subject < self.cfg.n:
                     status = int(snap.get("status", 0))
-                    self._inject.append(
-                        (j, subject, make_key(int(snap.get("inc", 0)), status))
-                    )
+                    keyval = make_key(int(snap.get("inc", 0)), status)
+                    if keyval > int(key_row[subject]):
+                        self._inject.append((j, subject, keyval))
 
-    def _pool_state_body(self, j: int) -> dict:
+    def _pool_state_body(self, j: int, key_row: np.ndarray) -> dict:
         """Row j as push/pull node snapshots (the response half of the
         full-state exchange, state.go:1283 mergeState input)."""
-        key_row = np.asarray(self.state.key[j])
         known = np.flatnonzero(key_row >= 0)
         nodes = []
         for c in known:

@@ -19,8 +19,6 @@ import asyncio
 import numpy as np
 import pytest
 
-from helpers import wait_until
-
 from consul_tpu.net.memberlist import Memberlist, MemberlistConfig, NodeStatus
 from consul_tpu.net.sim_transport import SimBridge, SimPoolConfig, sim_addr
 from consul_tpu.eventing.cluster import Cluster, ClusterConfig, EventType

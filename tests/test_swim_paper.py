@@ -1,0 +1,122 @@
+"""SWIM-paper curve reproduction (the north-star acceptance test).
+
+BASELINE.json: "reproduce the SWIM paper's first-detection-time curve
+within 5%".  The SWIM paper (Das, Gupta, Motivala 2002, §5) predicts:
+
+  * First detection of a crashed member: each of the n-1 live members
+    probes one uniformly random member per protocol period
+    (memberlist/state.go:214-256), so the probability some member
+    probes the crashed one in a period is p = 1-(1-1/(n-1))^(n-1)
+    -> 1-1/e, and the first-detection time (in periods, counting the
+    failed probe's own period since suspicion lands at its end —
+    direct timeout + indirect probes fill the interval,
+    state.go:283-497) is Geometric(p) with mean 1/p -> e/(e-1) ~ 1.58,
+    INDEPENDENT of n.
+  * Epidemic dissemination: with per-round fanout F over uniform
+    targets, the infected fraction follows the mean-field recursion
+    x' = x + (1-x)(1-exp(-F x)) and reaches ~all members in O(log n)
+    rounds (the same math behind retransmit_limit, util.go:72-76).
+
+All runs are fixed-seed, so the 5% assertions are deterministic.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from consul_tpu.models.broadcast import BroadcastConfig, broadcast_init
+from consul_tpu.models.swim import SwimConfig, swim_init
+from consul_tpu.sim.engine import broadcast_scan, swim_scan
+
+
+def _first_detection_periods(n: int, seeds: int, seed0: int = 0) -> np.ndarray:
+    """Detection time in probe periods for ``seeds`` independent
+    universes (vmapped over the PRNG key), for one crashed subject."""
+    cfg = SwimConfig(n=n, subject=7, fail_at_tick=0)
+    P = cfg.probe_interval_ticks
+    steps = 30 * P
+
+    def one(k):
+        _, (sus, _dead) = swim_scan(swim_init(cfg), k, cfg, steps)
+        return sus
+
+    keys = jax.random.split(jax.random.PRNGKey(seed0), seeds)
+    sus = np.asarray(jax.vmap(one)(keys))          # [seeds, steps]
+    assert (sus.max(axis=1) > 0).all(), "subject never detected"
+    first_tick = np.argmax(sus > 0, axis=1)
+    # Suspicion matures exactly one period after the failed probe's
+    # tick, i.e. at the END of the period containing the failed probe —
+    # the paper's accounting.  first_tick/P is therefore the period
+    # count, starting at 1.
+    return first_tick / P
+
+
+def geometric_p(n: int) -> float:
+    return 1.0 - (1.0 - 1.0 / (n - 1)) ** (n - 1)
+
+
+def test_first_detection_mean_within_5pct():
+    n, seeds = 512, 400
+    periods = _first_detection_periods(n, seeds)
+    expected = 1.0 / geometric_p(n)               # ~1.582
+    rel_err = abs(periods.mean() - expected) / expected
+    assert rel_err < 0.05, (periods.mean(), expected, rel_err)
+
+
+def test_first_detection_cdf_within_5pct():
+    n, seeds = 512, 400
+    periods = _first_detection_periods(n, seeds)
+    p = geometric_p(n)
+    for k in range(1, 7):
+        emp = (periods <= k).mean()
+        geo = 1.0 - (1.0 - p) ** k
+        assert abs(emp - geo) < 0.05, (k, emp, geo)
+
+
+def test_first_detection_independent_of_n():
+    """The paper's headline property: expected detection time does not
+    grow with group size (SWIM §2: constant expected detection time)."""
+    small = _first_detection_periods(128, 300, seed0=1).mean()
+    large = _first_detection_periods(1024, 300, seed0=2).mean()
+    assert abs(small - large) / small < 0.10, (small, large)
+
+
+def test_infection_curve_matches_mean_field():
+    n = 20_000
+    cfg = BroadcastConfig(n=n, fanout=4, delivery="edges")
+    steps = 16
+    _, infected = broadcast_scan(
+        broadcast_init(cfg, origin=0), jax.random.PRNGKey(3), cfg, steps
+    )
+    x = np.asarray(infected) / n
+
+    mf = [1.0 / n]
+    for _ in range(steps):
+        xt = mf[-1]
+        mf.append(xt + (1 - xt) * (1 - np.exp(-cfg.fanout * xt)))
+    mf = np.array(mf[1:])
+
+    # Pointwise agreement through the whole epidemic (0 -> ~1), well
+    # inside the 5% target.
+    assert np.abs(x - mf).max() < 0.02, np.abs(x - mf).max()
+
+    # And convergence is O(log n): 99% infection within ~log_F-ish
+    # rounds of the mean-field prediction.
+    t99_sim = int(np.argmax(x >= 0.99))
+    t99_mf = int(np.argmax(mf >= 0.99))
+    assert abs(t99_sim - t99_mf) <= 1, (t99_sim, t99_mf)
+
+
+def test_infection_t99_grows_logarithmically():
+    """Dissemination latency grows ~log(n): quadrupling n adds at most
+    ~log_2(4)=2 rounds at fanout 4 (paper §5.2 / util.go:72-76)."""
+    t99 = {}
+    for n, seed in ((5_000, 4), (80_000, 5)):
+        cfg = BroadcastConfig(n=n, fanout=4, delivery="edges")
+        _, infected = broadcast_scan(
+            broadcast_init(cfg, origin=0), jax.random.PRNGKey(seed), cfg, 24
+        )
+        frac = np.asarray(infected) / n
+        assert frac[-1] >= 0.999
+        t99[n] = int(np.argmax(frac >= 0.99))
+    assert t99[80_000] - t99[5_000] <= 3, t99
