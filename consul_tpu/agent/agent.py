@@ -72,6 +72,7 @@ class Agent:
         config: AgentConfig,
         gossip_transport: Transport,
         rpc_transport: Optional[Transport] = None,
+        wan_transport: Optional[Transport] = None,
     ):
         self.config = config
         if config.server:
@@ -90,7 +91,10 @@ class Agent:
                 ),
                 gossip_transport,
                 rpc_transport,
+                wan_transport=wan_transport,
             )
+        elif wan_transport is not None:
+            raise ValueError("only server agents join the WAN pool")
         else:
             if rpc_transport is None:
                 raise ValueError("client agents need an rpc transport")

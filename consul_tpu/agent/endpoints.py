@@ -120,6 +120,11 @@ class Catalog(_Endpoint):
             ),
         )
 
+    async def list_datacenters(self, body: dict):
+        """catalog_endpoint.go ListDatacenters: known DCs sorted by
+        estimated round-trip from here (router.go:534)."""
+        return {"datacenters": self.server.router.get_datacenters_by_distance()}
+
 
 class Health(_Endpoint):
     """health_endpoint.go."""

@@ -85,10 +85,20 @@ class HTTPRequest:
             return {}
         return json.loads(self.body)
 
+    def dc_option(self) -> dict:
+        """http.go parseDC applies ?dc= to WRITES as well as reads —
+        splat this into every RPC write body so cross-DC forwarding
+        engages (rpc.go:577 checks dc before anything else)."""
+        return {"dc": self.query["dc"]} if "dc" in self.query else {}
+
     def query_options(self) -> dict:
         """Blocking/consistency params → RPC body fields
         (http.go parseWait/parseConsistency)."""
         opts: dict = {}
+        if "dc" in self.query:
+            # http.go parseDC: target datacenter; the RPC layer forwards
+            # over the WAN when it differs from the local DC.
+            opts["dc"] = self.query["dc"]
         if "index" in self.query:
             opts["min_query_index"] = int(self.query["index"])
         if "wait" in self.query:
@@ -449,7 +459,13 @@ class HTTPApi:
     # -- catalog ---------------------------------------------------------
 
     async def catalog_datacenters(self, req, m) -> HTTPResponse:
-        return HTTPResponse(200, [self.agent.config.datacenter])
+        try:
+            out = await self.agent.rpc("Catalog.ListDatacenters", {})
+        except RPCError:
+            # No reachable server: answer with what we know locally.
+            out = {}
+        return HTTPResponse(200, out.get("datacenters") or
+                            [self.agent.config.datacenter])
 
     async def catalog_nodes(self, req, m) -> HTTPResponse:
         return await self._rpc_read(req, "Catalog.ListNodes", {}, "nodes")
@@ -522,11 +538,15 @@ class HTTPApi:
         )
 
     async def catalog_register(self, req, m) -> HTTPResponse:
-        out = await self.agent.rpc("Catalog.Register", _decamelize(req.json()))
+        out = await self.agent.rpc(
+            "Catalog.Register", {**_decamelize(req.json()), **req.dc_option()}
+        )
         return HTTPResponse(200, out.get("result", True))
 
     async def catalog_deregister(self, req, m) -> HTTPResponse:
-        out = await self.agent.rpc("Catalog.Deregister", _decamelize(req.json()))
+        out = await self.agent.rpc(
+            "Catalog.Deregister", {**_decamelize(req.json()), **req.dc_option()}
+        )
         return HTTPResponse(200, out.get("result", True))
 
     # -- health ----------------------------------------------------------
@@ -591,7 +611,9 @@ class HTTPApi:
             entry["modify_index"] = int(req.query["cas"])
         else:
             op = "set"
-        out = await self.agent.rpc("KVS.Apply", {"op": op, "entry": entry})
+        out = await self.agent.rpc(
+            "KVS.Apply", {"op": op, "entry": entry, **req.dc_option()}
+        )
         result = out.get("result")
         return HTTPResponse(200, True if result is True or op == "set" else result)
 
@@ -605,7 +627,7 @@ class HTTPApi:
                               "modify_index": int(req.query["cas"])}}
         else:
             body = {"op": "delete", "entry": {"key": key}}
-        out = await self.agent.rpc("KVS.Apply", body)
+        out = await self.agent.rpc("KVS.Apply", {**body, **req.dc_option()})
         result = out.get("result")
         return HTTPResponse(200, result if isinstance(result, bool) else True)
 
@@ -614,13 +636,16 @@ class HTTPApi:
     async def session_create(self, req, m) -> HTTPResponse:
         sess = _decamelize(req.json())
         sess.setdefault("node", self.agent.config.node_name)
-        out = await self.agent.rpc("Session.Apply",
-                                   {"op": "create", "session": sess})
+        out = await self.agent.rpc(
+            "Session.Apply",
+            {"op": "create", "session": sess, **req.dc_option()},
+        )
         return HTTPResponse(200, {"id": out["result"]})
 
     async def session_destroy(self, req, m) -> HTTPResponse:
         await self.agent.rpc("Session.Apply", {
             "op": "destroy", "session": {"id": m.group("sid")},
+            **req.dc_option(),
         })
         return HTTPResponse(200, True)
 
@@ -750,7 +775,9 @@ class HTTPApi:
             if entry and "index" in entry and "modify_index" not in entry:
                 entry["modify_index"] = entry.pop("index")
             ops.append(op)
-        out = await self.agent.rpc("Txn.Apply", {"ops": ops})
+        out = await self.agent.rpc(
+            "Txn.Apply", {"ops": ops, **req.dc_option()}
+        )
         result = out.get("result", out)
         status = 200 if not result.get("errors") else 409
         return HTTPResponse(status, result)
@@ -760,6 +787,7 @@ class HTTPApi:
     async def config_apply(self, req, m) -> HTTPResponse:
         out = await self.agent.rpc("ConfigEntry.Apply", {
             "op": "set", "entry": _decamelize(req.json()),
+            **req.dc_option(),
         })
         return HTTPResponse(200, out.get("result", True))
 
@@ -783,6 +811,7 @@ class HTTPApi:
         out = await self.agent.rpc("ConfigEntry.Apply", {
             "op": "delete",
             "entry": {"kind": m.group("kind"), "name": m.group("name")},
+            **req.dc_option(),
         })
         return HTTPResponse(200, out.get("result", True))
 

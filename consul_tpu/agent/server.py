@@ -53,8 +53,9 @@ from consul_tpu.eventing.cluster import (
     Member,
     MemberStatus,
 )
+from consul_tpu.agent.router import Router
 from consul_tpu.net.transport import Transport
-from consul_tpu.protocol import LAN, GossipProfile
+from consul_tpu.protocol import LAN, WAN, GossipProfile
 from consul_tpu.store.state import (
     HEALTH_CRITICAL,
     HEALTH_PASSING,
@@ -84,6 +85,10 @@ class ServerConfig:
     raft_heartbeat_s: float = 0.05
     raft_election_min_s: float = 0.15
     raft_election_max_s: float = 0.30
+    # WAN pool timing profile (config.go:314-327 DefaultWANConfig) and
+    # LAN->WAN flooder cadence (agent/consul/flood.go loop).
+    wan_profile: GossipProfile = WAN
+    flood_interval_s: float = 1.0
 
 
 class Server:
@@ -94,6 +99,7 @@ class Server:
         config: ServerConfig,
         gossip_transport: Transport,
         rpc_transport: Transport,
+        wan_transport: Optional[Transport] = None,
     ):
         self.config = config
         self.fsm = ConsulFSM()
@@ -110,22 +116,48 @@ class Server:
         self.rpc_server.bind_raft(self.raft_adapter.handle)
 
         # Gossip plane: LAN serf pool with server tags.
+        lan_tags = {
+            "role": "consul",
+            "dc": config.datacenter,
+            "id": config.node_name,
+            "rpc_addr": rpc_transport.local_addr(),
+            "expect": str(config.bootstrap_expect),
+        }
+        if wan_transport is not None:
+            # Advertised so peers' flooders can join us into the WAN
+            # pool (serf_flooder.go reads the wan port from tags).
+            lan_tags["wan_addr"] = wan_transport.local_addr()
         self.serf = Cluster(
             ClusterConfig(
                 name=config.node_name,
-                tags={
-                    "role": "consul",
-                    "dc": config.datacenter,
-                    "id": config.node_name,
-                    "rpc_addr": rpc_transport.local_addr(),
-                    "expect": str(config.bootstrap_expect),
-                },
+                tags=lan_tags,
                 profile=config.profile,
                 interval_scale=config.gossip_interval_scale,
                 on_event=self._on_serf_event,
             ),
             gossip_transport,
         )
+
+        # WAN pool (server.go:506 setupSerf(WAN)): servers of every DC,
+        # named "<node>.<dc>" (server_serf.go), slower timing profile.
+        self.serf_wan: Optional[Cluster] = None
+        if wan_transport is not None:
+            self.serf_wan = Cluster(
+                ClusterConfig(
+                    name=f"{config.node_name}.{config.datacenter}",
+                    tags={
+                        "role": "consul",
+                        "dc": config.datacenter,
+                        "id": config.node_name,
+                        "rpc_addr": rpc_transport.local_addr(),
+                    },
+                    profile=config.wan_profile,
+                    interval_scale=config.gossip_interval_scale,
+                    queue_events=False,  # router reads members directly
+                ),
+                wan_transport,
+            )
+        self.router = Router(config.datacenter, self.serf_wan)
 
         self.raft: Optional[RaftNode] = None
         self._bootstrap_disabled = False
@@ -149,11 +181,45 @@ class Server:
     async def start(self) -> None:
         await self.rpc_server.start()
         await self.serf.start()
+        if self.serf_wan is not None:
+            await self.serf_wan.start()
+            self._tasks.append(asyncio.create_task(self._flood_loop()))
         self._tasks.append(asyncio.create_task(self._serf_event_pump()))
         await self._maybe_bootstrap()
 
     async def join(self, addrs: list[str]) -> int:
         return await self.serf.join(addrs)
+
+    async def join_wan(self, addrs: list[str]) -> int:
+        """Join the WAN pool (server.go JoinWAN / `consul join -wan`)."""
+        if self.serf_wan is None:
+            raise RPCError("WAN gossip not configured")
+        return await self.serf_wan.join(addrs)
+
+    async def _flood_loop(self) -> None:
+        """LAN→WAN flooder (agent/consul/flood.go:27-60 + router/
+        serf_flooder.go): any server seen on the LAN but missing from
+        the WAN pool gets joined in via its advertised wan_addr, so one
+        explicit WAN join per DC suffices to federate every server."""
+        while not self._shutdown:
+            await asyncio.sleep(self.config.flood_interval_s)
+            try:
+                wan_names = {
+                    m.tags.get("id")
+                    for m in self.serf_wan.members.values()
+                    if m.status == MemberStatus.ALIVE
+                    and m.tags.get("dc") == self.config.datacenter
+                }
+                for m in list(self.serf.members.values()):
+                    if (
+                        m.status == MemberStatus.ALIVE
+                        and m.tags.get("role") == "consul"
+                        and m.tags.get("wan_addr")
+                        and m.tags.get("id") not in wan_names
+                    ):
+                        await self.serf_wan.join([m.tags["wan_addr"]])
+            except Exception:
+                log.exception("flood loop failed")
 
     async def leave(self) -> None:
         # Graceful departure (server.go Leave): demote ourselves from
@@ -163,6 +229,8 @@ class Server:
                 await self.raft.remove_server(self.node_id)
             except Exception:  # noqa: BLE001 - best effort on the way out
                 pass
+        if self.serf_wan is not None:
+            await self.serf_wan.leave()
         await self.serf.leave()
 
     async def shutdown(self) -> None:
@@ -171,6 +239,8 @@ class Server:
             t.cancel()
         if self.raft:
             await self.raft.shutdown()
+        if self.serf_wan is not None:
+            await self.serf_wan.shutdown()
         await self.serf.shutdown()
         await self.rpc_client.shutdown()
         await self._raft_rpc_client.shutdown()
@@ -299,13 +369,19 @@ class Server:
     async def forward(
         self, method: str, body: dict, *, read: bool = False
     ) -> Optional[dict]:
-        """Forward to the leader unless we are it (rpc.go:577-614).
+        """Forward to the right datacenter, then to the leader unless we
+        are it (rpc.go:577-614 forward: the dc check comes FIRST —
+        a request for another dc goes over the WAN regardless of our
+        leadership or the read's staleness).
 
         Returns None when the caller should execute locally, else the
-        leader's response.  Only *reads* honor allow_stale — a write
+        remote response.  Only *reads* honor allow_stale — a write
         carrying a recycled query-options dict must still reach the
         leader (the reference's forward() checks info.IsRead()).
         """
+        dc = body.get("dc")
+        if dc and dc != self.config.datacenter:
+            return await self._forward_dc(method, body, dc)
         if read and body.get("allow_stale"):
             return None
         if self.raft is not None and self.raft.is_leader():
@@ -316,6 +392,23 @@ class Server:
         return await self.rpc_client.call(
             addr, method, body, timeout=rpc_timeout_for(body)
         )
+
+    async def _forward_dc(self, method: str, body: dict, dc: str) -> dict:
+        """rpc.go:617-655 forwardDC: pick a server of the target DC from
+        the router (WAN-discovered) and relay the call; try a couple of
+        candidates before giving up."""
+        servers = self.router.servers_in_dc(dc)
+        if not servers:
+            raise RPCError(f"no path to datacenter {dc}")
+        last: Optional[Exception] = None
+        for meta in servers[:2]:
+            try:
+                return await self.rpc_client.call(
+                    meta.rpc_addr, method, body, timeout=rpc_timeout_for(body)
+                )
+            except Exception as e:  # noqa: BLE001 - try the next server
+                last = e
+        raise RPCError(f"rpc to datacenter {dc} failed: {last}")
 
     async def raft_apply(self, msg_type: MessageType, body: dict):
         """Apply a command through raft (rpc.go:679 raftApply)."""

@@ -38,6 +38,7 @@ from consul_tpu.net.memberlist import (
     NodeStatus,
 )
 from consul_tpu.net.transport import Transport
+from consul_tpu.net.vivaldi import Coordinate, VivaldiClient
 from consul_tpu.eventing.lamport import LamportClock
 from consul_tpu.protocol import GossipProfile, LAN
 
@@ -144,6 +145,13 @@ class ClusterConfig:
     # Event sink: called for every Event (the EventCh analogue); events
     # are also readable from Cluster.events (an asyncio.Queue).
     on_event: Optional[Callable[[Event], None]] = None
+    # Vivaldi network coordinates piggybacked on probe acks
+    # (serf/ping_delegate.go:46-90; DisableCoordinates in serf config).
+    coordinates: bool = True
+    # False: don't enqueue events on Cluster.events (for pools whose
+    # owner consumes nothing from the queue, e.g. the WAN pool — the
+    # queue would otherwise grow unboundedly under member churn).
+    queue_events: bool = True
 
 
 def encode_tags(tags: dict[str, str]) -> bytes:
@@ -189,6 +197,12 @@ class Cluster:
             retransmit_mult=config.profile.retransmit_mult,
         )
 
+        # Vivaldi coordinate client + peer coordinate cache, fed by the
+        # probe ping/ack exchange (serf/ping_delegate.go:46-90; the
+        # cache is serf's coordClient/coordCache pair, serf.go:82-90).
+        self.vivaldi = VivaldiClient() if config.coordinates else None
+        self.coord_cache: dict[str, "Coordinate"] = {}
+
         self.memberlist = Memberlist(
             MemberlistConfig(
                 name=config.name,
@@ -202,9 +216,38 @@ class Cluster:
                 notify_join=self._on_node_join,
                 notify_leave=self._on_node_leave,
                 notify_update=self._on_node_update,
+                ack_payload=self._ack_payload if self.vivaldi else None,
+                notify_ping_complete=(
+                    self._on_ping_complete if self.vivaldi else None
+                ),
             ),
             transport,
         )
+
+    # ------------------------------------------------------------------
+    # coordinates (ping_delegate.go:46-90)
+    # ------------------------------------------------------------------
+
+    def _ack_payload(self) -> dict:
+        return {"coord": self.vivaldi.get_coordinate().to_wire()}
+
+    def _on_ping_complete(self, node: Node, rtt_s: float, ack: dict) -> None:
+        raw = ack.get("coord")
+        if raw is None:
+            return
+        other = Coordinate.from_wire(raw)
+        if not other.is_valid():
+            return
+        self.vivaldi.update(node.name, other, rtt_s)
+        self.coord_cache[node.name] = other
+
+    def get_coordinate(self):
+        """Our own Vivaldi coordinate (serf.GetCoordinate)."""
+        return self.vivaldi.get_coordinate() if self.vivaldi else None
+
+    def get_cached_coordinate(self, name: str):
+        """A peer's last seen coordinate (serf.GetCachedCoordinate)."""
+        return self.coord_cache.get(name)
 
     # ------------------------------------------------------------------
     # lifecycle (serf.go:244 Create, 459 UserEvent, 630 Join, ...)
@@ -592,7 +635,8 @@ class Cluster:
         self._emit(Event(type=EventType.MEMBER_UPDATE, members=[m]))
 
     def _emit(self, event: Event) -> None:
-        self.events.put_nowait(event)
+        if self.config.queue_events:
+            self.events.put_nowait(event)
         if self.config.on_event is not None:
             try:
                 self.config.on_event(event)

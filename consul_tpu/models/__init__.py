@@ -24,6 +24,12 @@ from consul_tpu.models.membership import (
     membership_init,
     membership_round,
 )
+from consul_tpu.models.multidc import (
+    MultiDCConfig,
+    MultiDCState,
+    multidc_init,
+    multidc_round,
+)
 from consul_tpu.models.swim import (
     SwimConfig,
     SwimState,
@@ -58,6 +64,10 @@ __all__ = [
     "RANK_SUSPECT",
     "RANK_DEAD",
     "RANK_LEFT",
+    "MultiDCConfig",
+    "MultiDCState",
+    "multidc_init",
+    "multidc_round",
     "SwimConfig",
     "SwimState",
     "swim_init",

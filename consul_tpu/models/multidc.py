@@ -1,0 +1,243 @@
+"""Multi-segment (multi-DC) epidemic broadcast with two edge classes.
+
+The reference partitions gossip into LAN pools — one per datacenter (or
+network segment: a LAN partition carrying its own serf,
+agent/consul/server_serf.go:50) — bridged by a WAN pool that only
+*servers* join (agent/consul/server.go:506,534; leaders flood-join it,
+agent/consul/flood.go:27-60).  The WAN pool runs a slower, loss-tolerant
+timing profile (memberlist/config.go:315-326: 500 ms gossip, fanout 4,
+suspicion 6x) while each LAN runs the fast profile (200 ms gossip,
+fanout 3).
+
+This model is BASELINE config 5 made real: ``n`` nodes in ``segments``
+contiguous shards; every node gossips within its own segment with LAN
+parameters; the first ``bridges_per_segment`` nodes of each segment are
+that segment's servers, members of the global WAN pool, gossiping
+cross-segment with WAN parameters.  Cross-segment edges are therefore a
+*different edge class*: slower cadence (Poisson-staggered at
+lan_interval/wan_interval per tick, the same discretization trick the
+membership model uses for push/pull), separate loss rate, separate
+retransmit budget scaled by the WAN pool size.
+
+Sharding: segments are contiguous, so with ``segments == n_devices``
+each device holds exactly its segment and ALL LAN traffic is local to
+the device; only WAN (bridge) traffic crosses the mesh — the ICI/DCN ↔
+LAN/WAN analogy of SURVEY.md §5 stated as a layout.
+
+One tick = one LAN GossipInterval.  Delivery modes as in broadcast.py:
+``edges`` scatters every message; ``aggregate`` Poissonizes arrivals
+per segment (LAN) and over the bridge set (WAN) — per-receiver arrival
+counts depend only on the sender tally of its own segment (LAN) and of
+the whole bridge pool (WAN), so the only cross-device traffic in
+aggregate mode is the S-vector of per-segment sender counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import bernoulli_mask, deliver_or
+from consul_tpu.protocol import retransmit_limit
+from consul_tpu.protocol.profiles import GossipProfile, LAN, WAN
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiDCConfig:
+    n: int
+    segments: int = 8
+    bridges_per_segment: int = 3      # servers per DC (3-5 typical)
+    lan_profile: GossipProfile = LAN
+    wan_profile: GossipProfile = WAN
+    loss_lan: float = 0.0
+    loss_wan: float = 0.0
+    delivery: str = "edges"
+    wan_enabled: bool = True          # False: isolated segments (control)
+
+    def __post_init__(self):
+        if self.n % self.segments != 0:
+            raise ValueError("n must divide evenly into segments")
+        if self.delivery not in ("edges", "aggregate"):
+            raise ValueError(f"bad delivery {self.delivery!r}")
+        if self.bridges_per_segment >= self.seg_size:
+            raise ValueError("segment smaller than its bridge set")
+
+    @property
+    def seg_size(self) -> int:
+        return self.n // self.segments
+
+    @property
+    def fanout_lan(self) -> int:
+        return self.lan_profile.gossip_nodes
+
+    @property
+    def fanout_wan(self) -> int:
+        return self.wan_profile.gossip_nodes
+
+    @property
+    def n_bridges(self) -> int:
+        return self.segments * self.bridges_per_segment
+
+    @property
+    def tx_limit_lan(self) -> int:
+        # Retransmit budget scales with the LAN pool size — the segment
+        # (memberlist/util.go:72-76 with the segment's member count).
+        return retransmit_limit(self.lan_profile.retransmit_mult, self.seg_size)
+
+    @property
+    def tx_limit_wan(self) -> int:
+        return retransmit_limit(self.wan_profile.retransmit_mult, self.n_bridges)
+
+    @property
+    def wan_rate(self) -> float:
+        """P(a bridge runs a WAN gossip round in a given LAN tick): the
+        WAN pool gossips every wan_interval while the clock advances in
+        lan_interval ticks (config.go:322 vs :293), Poisson-staggered."""
+        return min(
+            self.lan_profile.gossip_interval_ms
+            / self.wan_profile.gossip_interval_ms,
+            1.0,
+        )
+
+
+class MultiDCState(NamedTuple):
+    knows: jax.Array    # bool[n]
+    tx_lan: jax.Array   # int32[n] — LAN transmit budget
+    tx_wan: jax.Array   # int32[n] — WAN budget (nonzero only on bridges)
+    tick: jax.Array
+
+
+def _segment_of(cfg: MultiDCConfig) -> jax.Array:
+    return jnp.arange(cfg.n, dtype=jnp.int32) // cfg.seg_size
+
+
+def _is_bridge(cfg: MultiDCConfig) -> jax.Array:
+    return (jnp.arange(cfg.n, dtype=jnp.int32) % cfg.seg_size) < (
+        cfg.bridges_per_segment
+    )
+
+
+def multidc_init(cfg: MultiDCConfig, origin: int = 0) -> MultiDCState:
+    knows = jnp.zeros((cfg.n,), jnp.bool_).at[origin].set(True)
+    tx_lan = jnp.zeros((cfg.n,), jnp.int32).at[origin].set(cfg.tx_limit_lan)
+    origin_bridge = (origin % cfg.seg_size) < cfg.bridges_per_segment
+    tx_wan = (
+        jnp.zeros((cfg.n,), jnp.int32)
+        .at[origin]
+        .set(cfg.tx_limit_wan if origin_bridge else 0)
+    )
+    return MultiDCState(
+        knows=knows, tx_lan=tx_lan, tx_wan=tx_wan, tick=jnp.int32(0)
+    )
+
+
+def multidc_round(
+    state: MultiDCState, key: jax.Array, cfg: MultiDCConfig
+) -> MultiDCState:
+    n, S, ss, B = cfg.n, cfg.segments, cfg.seg_size, cfg.bridges_per_segment
+    k_lan_sel, k_lan_loss, k_wan_on, k_wan_seg, k_wan_slot, k_wan_loss = (
+        jax.random.split(key, 6)
+    )
+    seg = _segment_of(cfg)
+    bridge = _is_bridge(cfg)
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    # ------------------------------------------------------------------
+    # LAN edge class: gossip within the segment only.
+    # ------------------------------------------------------------------
+    senders_l = state.knows & (state.tx_lan > 0)
+    if cfg.delivery == "edges":
+        # Uniform target within own segment, excluding self (shift trick
+        # over the in-segment offset).
+        draws = jax.random.randint(
+            k_lan_sel, (n, cfg.fanout_lan), 0, max(ss - 1, 1), jnp.int32
+        )
+        off = idx % ss
+        local = jnp.where(draws >= off[:, None], draws + 1, draws) % ss
+        targets = seg[:, None] * ss + local
+        delivered = senders_l[:, None] & bernoulli_mask(
+            k_lan_loss, (n, cfg.fanout_lan), 1.0 - cfg.loss_lan
+        )
+        got_lan = deliver_or(state.knows, targets, delivered) & ~state.knows
+    else:
+        # Per-segment Poissonized arrivals: lambda depends only on the
+        # receiver's own segment's sender count (all LAN copies of the
+        # event are identical — see BroadcastConfig.delivery).
+        per_seg = jnp.sum(
+            senders_l.reshape(S, ss), axis=1, dtype=jnp.float32
+        )
+        lam = (
+            per_seg[seg]
+            - senders_l.astype(jnp.float32)  # own copies never self-target
+        ) * cfg.fanout_lan * (1.0 - cfg.loss_lan) / max(ss - 1, 1)
+        got_lan = (
+            (jax.random.uniform(k_lan_loss, (n,)) < 1.0 - jnp.exp(-lam))
+            & ~state.knows
+        )
+
+    # ------------------------------------------------------------------
+    # WAN edge class: bridges gossip across segments at the WAN cadence.
+    # ------------------------------------------------------------------
+    if cfg.wan_enabled:
+        wan_on = bernoulli_mask(k_wan_on, (n,), cfg.wan_rate)
+        senders_w = state.knows & (state.tx_wan > 0) & bridge & wan_on
+        if cfg.delivery == "edges":
+            # Target: uniform bridge of a DIFFERENT segment (the
+            # intra-segment server pairs are already covered by LAN).
+            dseg = jax.random.randint(
+                k_wan_seg, (n, cfg.fanout_wan), 0, max(S - 1, 1), jnp.int32
+            )
+            tseg = jnp.where(dseg >= seg[:, None], dseg + 1, dseg) % S
+            slot = jax.random.randint(
+                k_wan_slot, (n, cfg.fanout_wan), 0, B, jnp.int32
+            )
+            wtargets = tseg * ss + slot
+            wdelivered = senders_w[:, None] & bernoulli_mask(
+                k_wan_loss, (n, cfg.fanout_wan), 1.0 - cfg.loss_wan
+            )
+            got_wan = (
+                deliver_or(state.knows, wtargets, wdelivered) & ~state.knows
+            )
+        else:
+            w_total = jnp.sum(senders_w, dtype=jnp.float32)
+            # A bridge receives from senders outside its own segment.
+            per_seg_w = jnp.sum(
+                senders_w.reshape(S, ss), axis=1, dtype=jnp.float32
+            )
+            lam_w = (
+                (w_total - per_seg_w[seg])
+                * cfg.fanout_wan
+                * (1.0 - cfg.loss_wan)
+                / max(cfg.n_bridges - B, 1)
+            )
+            got_wan = (
+                bridge
+                & (jax.random.uniform(k_wan_loss, (n,)) < 1.0 - jnp.exp(-lam_w))
+                & ~state.knows
+            )
+        spent_w = jnp.where(senders_w, cfg.fanout_wan, 0).astype(jnp.int32)
+    else:
+        got_wan = jnp.zeros((n,), jnp.bool_)
+        spent_w = jnp.zeros((n,), jnp.int32)
+
+    # ------------------------------------------------------------------
+    # Budgets: LAN spends per tick, WAN only on its staggered rounds;
+    # fresh recipients queue the event on both their edge classes
+    # (a serf event crossing the WAN re-enters the remote LAN pool via
+    # that segment's servers — the flood path in reverse).
+    # ------------------------------------------------------------------
+    newly = got_lan | got_wan
+    new_knows = state.knows | newly
+    tx_lan = jnp.maximum(
+        state.tx_lan - jnp.where(senders_l, cfg.fanout_lan, 0), 0
+    )
+    tx_lan = jnp.where(newly, cfg.tx_limit_lan, tx_lan)
+    tx_wan = jnp.maximum(state.tx_wan - spent_w, 0)
+    tx_wan = jnp.where(newly & bridge, cfg.tx_limit_wan, tx_wan)
+
+    return MultiDCState(
+        knows=new_knows, tx_lan=tx_lan, tx_wan=tx_wan, tick=state.tick + 1
+    )

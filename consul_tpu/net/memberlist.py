@@ -89,8 +89,12 @@ class MemberlistConfig:
     notify_join: Optional[Callable[[Node], None]] = None
     notify_leave: Optional[Callable[[Node], None]] = None
     notify_update: Optional[Callable[[Node], None]] = None
-    # Ping hook (PingDelegate -> Vivaldi): (node, rtt_seconds)
-    notify_ping_complete: Optional[Callable[[Node, float], None]] = None
+    # Ping delegate (serf/ping_delegate.go:46-90): ``ack_payload`` is
+    # appended to our ACK responses (the serf coordinate piggyback);
+    # ``notify_ping_complete(node, rtt_seconds, ack_body)`` receives the
+    # peer's ack including any such payload.
+    ack_payload: Optional[Callable[[], dict]] = None
+    notify_ping_complete: Optional[Callable[[Node, float, dict], None]] = None
 
     def s(self, ms: float) -> float:
         """Protocol ms -> scaled seconds."""
@@ -279,12 +283,21 @@ class Memberlist:
         else:
             log.warning("unhandled message type %s from %s", msg_type, src)
 
+    def _ack_body(self, seq) -> dict:
+        body = {"seq": seq}
+        if self.config.ack_payload is not None:
+            try:
+                body.update(self.config.ack_payload())
+            except Exception:
+                log.exception("ack payload hook failed")
+        return body
+
     def _on_ping(self, body, src: str) -> None:
         # Answer only pings addressed to us (net.go handlePing).
         if body.get("node") not in (None, self.config.name):
             return
         asyncio.ensure_future(
-            self._send_msg(src, wire.MessageType.ACK_RESP, {"seq": body["seq"]})
+            self._send_msg(src, wire.MessageType.ACK_RESP, self._ack_body(body["seq"]))
         )
 
     async def _on_indirect_ping(self, body, src: str) -> None:
@@ -314,7 +327,7 @@ class Memberlist:
     def _on_ack(self, body) -> None:
         fut = self._ack_waiters.get(body["seq"])
         if fut and not fut.done():
-            fut.set_result(time.monotonic())
+            fut.set_result((time.monotonic(), body))
 
     # ------------------------------------------------------------------
     # probe plane (state.go:214-497)
@@ -381,11 +394,11 @@ class Memberlist:
                 {"seq": seq, "node": node.name, "from": self.config.name},
             )
             try:
-                await asyncio.wait_for(fut, timeout)
+                _ts, ack = await asyncio.wait_for(fut, timeout)
                 rtt = time.monotonic() - sent_at
                 self.awareness.apply_delta(-1)
                 if self.config.notify_ping_complete:
-                    self.config.notify_ping_complete(node, rtt)
+                    self.config.notify_ping_complete(node, rtt, ack)
                 return
             except asyncio.TimeoutError:
                 pass
@@ -591,7 +604,8 @@ class Memberlist:
             elif t == wire.MessageType.PING:
                 await stream.send(
                     wire.encode(
-                        wire.MessageType.ACK_RESP, {"seq": body.get("seq", 0)}
+                        wire.MessageType.ACK_RESP,
+                        self._ack_body(body.get("seq", 0)),
                     )
                 )
         except Exception:

@@ -2,8 +2,10 @@
 
 from consul_tpu.sim.engine import (
     membership_scan,
+    multidc_scan,
     run_broadcast,
     run_membership,
+    run_multidc,
     run_swim,
     broadcast_scan,
     swim_scan,
@@ -11,6 +13,7 @@ from consul_tpu.sim.engine import (
 from consul_tpu.sim.metrics import (
     time_to_fraction,
     MembershipReport,
+    MultiDCReport,
     BroadcastReport,
     SwimReport,
 )
@@ -21,8 +24,10 @@ __all__ = [
     "run_membership",
     "MembershipReport",
     "run_broadcast",
+    "run_multidc",
     "run_swim",
     "broadcast_scan",
+    "multidc_scan",
     "swim_scan",
     "time_to_fraction",
     "BroadcastReport",

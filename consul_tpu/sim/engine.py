@@ -29,6 +29,11 @@ from consul_tpu.models.membership import (
     membership_init,
     membership_round,
 )
+from consul_tpu.models.multidc import (
+    MultiDCConfig,
+    multidc_init,
+    multidc_round,
+)
 from consul_tpu.models.swim import (
     SwimConfig,
     swim_init,
@@ -47,6 +52,24 @@ def broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int):
     def tick(carry, k):
         nxt = broadcast_round(carry, k, cfg)
         return nxt, jnp.sum(nxt.knows, dtype=jnp.int32)
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+def multidc_scan(state, key: jax.Array, cfg: MultiDCConfig, steps: int):
+    """Run ``steps`` LAN ticks of the two-edge-class broadcast; returns
+    (final_state, (infected_total[steps], infected_per_segment[steps, S]))."""
+
+    def tick(carry, k):
+        nxt = multidc_round(carry, k, cfg)
+        per_seg = jnp.sum(
+            nxt.knows.reshape(cfg.segments, cfg.seg_size),
+            axis=1,
+            dtype=jnp.int32,
+        )
+        return nxt, (jnp.sum(nxt.knows, dtype=jnp.int32), per_seg)
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
@@ -138,6 +161,39 @@ def run_broadcast(
         ticks=steps,
         tick_ms=cfg.profile.gossip_interval_ms,
         infected=np.asarray(infected),
+        wall_s=wall,
+    )
+
+
+def run_multidc(
+    cfg: MultiDCConfig,
+    steps: int,
+    seed: int = 0,
+    origin: int = 0,
+    sharded: bool = False,
+    mesh=None,
+    warmup: bool = True,
+):
+    """Two-edge-class (LAN intra-segment / WAN cross-segment) broadcast
+    study; with ``sharded`` each device holds whole segments so only the
+    WAN class crosses the mesh."""
+    from consul_tpu.sim.metrics import MultiDCReport
+
+    def make_state():
+        st = multidc_init(cfg, origin=origin)
+        return shard_state(st, mesh or make_mesh()) if sharded else st
+
+    key = jax.random.PRNGKey(seed)
+    _, (total, per_seg), wall = _timed(
+        make_state, multidc_scan, key, cfg, steps, warmup
+    )
+    return MultiDCReport(
+        n=cfg.n,
+        segments=cfg.segments,
+        ticks=steps,
+        tick_ms=cfg.lan_profile.gossip_interval_ms,
+        infected=np.asarray(total),
+        per_segment=np.asarray(per_seg),
         wall_s=wall,
     )
 

@@ -88,19 +88,28 @@ def suspect1m(seed: int = 0) -> dict:
 
 
 def multidc1m(seed: int = 0) -> dict:
-    """BASELINE config 5: 1M nodes in 8 segments (1 segment per device),
-    epidemic broadcast sharded across the mesh."""
+    """BASELINE config 5: 1M nodes in 8 segments, TWO edge classes —
+    LAN gossip inside each segment, WAN-profile gossip (slower cadence,
+    server bridges only, memberlist/config.go:315-326) across segments —
+    sharded one segment per device so all LAN traffic is device-local
+    and only WAN crosses the mesh."""
+    from consul_tpu.models.multidc import MultiDCConfig
     from consul_tpu.parallel import make_mesh
+    from consul_tpu.sim.engine import run_multidc
 
-    cfg = BroadcastConfig(n=1_000_000, fanout=4, profile=LAN,
-                          delivery="aggregate")
     mesh = make_mesh()
-    rep = run_broadcast(cfg, steps=100, seed=seed, sharded=True, mesh=mesh)
-    return {
-        "scenario": "multidc1m",
-        "segments": int(mesh.devices.size),
-        **rep.summary(),
-    }
+    cfg = MultiDCConfig(
+        n=1_000_000,
+        segments=8,
+        bridges_per_segment=5,
+        delivery="aggregate",
+    )
+    # Origin is a non-bridge node of segment 0: the event must climb
+    # onto the WAN through segment 0's servers and re-enter every other
+    # segment through theirs (flood.go path in reverse).
+    rep = run_multidc(cfg, steps=120, seed=seed, origin=cfg.seg_size // 2,
+                      sharded=True, mesh=mesh)
+    return {"scenario": "multidc1m", **rep.summary()}
 
 
 SCENARIOS: dict[str, Callable[..., dict]] = {

@@ -1,0 +1,107 @@
+"""Multi-segment (multi-DC) broadcast: two edge classes, as the
+reference structures them — per-segment LAN serf pools bridged by a
+server-only WAN pool (server.go:506,534; flood.go:27-60;
+memberlist/config.go:315-326 WAN timing).
+"""
+
+import jax
+import numpy as np
+
+from consul_tpu.models.multidc import (
+    MultiDCConfig,
+    multidc_init,
+    multidc_round,
+)
+from consul_tpu.parallel import make_mesh, shard_state
+from consul_tpu.sim.engine import multidc_scan, run_multidc
+
+
+def test_wan_disabled_confines_event_to_origin_segment():
+    """The defining property of the two-edge-class structure: without
+    the WAN pool, segments are isolated gossip universes."""
+    cfg = MultiDCConfig(n=4096, segments=8, wan_enabled=False)
+    rep = run_multidc(cfg, steps=40, seed=0, origin=100, warmup=False)
+    assert rep.segments_reached() == 1
+    # ...but the origin segment itself fully converges.
+    assert rep.per_segment[-1][0] == cfg.seg_size
+
+
+def test_event_crosses_all_segments_via_wan():
+    cfg = MultiDCConfig(n=4096, segments=8, bridges_per_segment=3)
+    # Origin is a NON-bridge member: the event must reach segment 0's
+    # servers by LAN, cross on the WAN class, and re-enter the other
+    # segments through their servers.
+    rep = run_multidc(cfg, steps=80, seed=1, origin=50, warmup=False)
+    assert rep.segments_reached() == 8
+    assert rep.infected[-1] == cfg.n
+
+
+def test_wan_hop_adds_latency():
+    """Remote segments converge later than the origin segment — the WAN
+    cadence (500 ms vs 200 ms) and the extra hops are visible in the
+    per-segment curves."""
+    cfg = MultiDCConfig(n=8192, segments=8, bridges_per_segment=3)
+    rep = run_multidc(cfg, steps=100, seed=2, origin=10, warmup=False)
+    t_origin = rep.segment_t99_ms(0)
+    remote = [rep.segment_t99_ms(s) for s in range(1, 8)]
+    assert t_origin is not None and all(t is not None for t in remote)
+    assert min(remote) > t_origin
+
+
+def test_wan_loss_slows_cross_segment_convergence():
+    base = MultiDCConfig(n=4096, segments=8, bridges_per_segment=3)
+    lossy = MultiDCConfig(
+        n=4096, segments=8, bridges_per_segment=3, loss_wan=0.5
+    )
+    r0 = run_multidc(base, steps=100, seed=3, origin=20, warmup=False)
+    r1 = run_multidc(lossy, steps=100, seed=3, origin=20, warmup=False)
+    assert r1.time_to_ms(0.99) >= r0.time_to_ms(0.99)
+
+
+def test_aggregate_matches_edges_distributionally():
+    """Same convergence curve from the exact scatter path and the
+    Poissonized path, averaged over seeds (the multidc analogue of
+    tests/test_aggregate.py)."""
+    t99 = {}
+    for delivery in ("edges", "aggregate"):
+        cfg = MultiDCConfig(
+            n=4096, segments=8, bridges_per_segment=3, delivery=delivery
+        )
+        ts = []
+        for seed in range(4):
+            rep = run_multidc(cfg, steps=80, seed=seed, origin=9,
+                              warmup=False)
+            # A lone straggler after budget exhaustion is legitimate
+            # (real gossip leaves it to push/pull; this model has none).
+            assert rep.infected[-1] >= 0.999 * cfg.n
+            ts.append(np.argmax(rep.infected >= 0.99 * cfg.n))
+        t99[delivery] = np.mean(ts)
+    assert abs(t99["edges"] - t99["aggregate"]) <= 3.0, t99
+
+
+def test_sharded_equals_unsharded():
+    """One segment per device: the sharded program computes the exact
+    same trajectory as the single-device one (determinism across
+    shardings, SURVEY.md §5 race-discipline)."""
+    cfg = MultiDCConfig(n=2048, segments=8, bridges_per_segment=3)
+    key = jax.random.PRNGKey(7)
+    st = multidc_init(cfg, origin=33)
+    _, (plain_total, plain_seg) = multidc_scan(st, key, cfg, 40)
+    mesh = make_mesh()
+    st_sh = shard_state(multidc_init(cfg, origin=33), mesh)
+    _, (sh_total, sh_seg) = multidc_scan(st_sh, key, cfg, 40)
+    np.testing.assert_array_equal(np.asarray(plain_total), np.asarray(sh_total))
+    np.testing.assert_array_equal(np.asarray(plain_seg), np.asarray(sh_seg))
+
+
+def test_bridge_budget_scales_with_wan_pool():
+    cfg = MultiDCConfig(n=4096, segments=8, bridges_per_segment=3)
+    # LAN budget scales with segment size, WAN with the bridge count —
+    # two different pools, two different retransmit scales
+    # (memberlist/util.go:72-76 applied per pool).
+    assert cfg.tx_limit_lan != cfg.tx_limit_wan or cfg.seg_size == cfg.n_bridges
+    st = multidc_init(cfg, origin=0)  # origin 0 IS a bridge
+    assert int(st.tx_wan[0]) == cfg.tx_limit_wan
+    assert int(st.tx_lan[0]) == cfg.tx_limit_lan
+    st2 = multidc_init(cfg, origin=10)  # non-bridge: no WAN budget
+    assert int(st2.tx_wan[10]) == 0

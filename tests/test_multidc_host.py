@@ -1,0 +1,187 @@
+"""Host-plane WAN federation: per-DC LAN pools + one WAN pool, the
+LAN→WAN flooder, router areas, and cross-DC RPC forwarding.
+
+Parity model: agent/consul/server.go:506,534 (two serf pools),
+flood.go:27-60 (flooder), router/router.go (DC→servers map +
+GetDatacentersByDistance), rpc.go:577-655 (forward → forwardDC).  Each
+DC gets its OWN in-memory LAN network — segments are physically
+separate gossip domains — while the WAN and RPC planes are shared.
+"""
+
+import asyncio
+import base64
+
+import pytest
+
+from helpers import wait_for as wait_until
+from helpers import wait_for_leader
+
+from consul_tpu.agent.server import Server, ServerConfig
+from consul_tpu.net.transport import InMemoryNetwork
+from consul_tpu.protocol import LAN
+
+
+def make_dc_server(lan_net, wan_net, rpc_net, name, dc, expect):
+    cfg = ServerConfig(
+        node_name=name,
+        datacenter=dc,
+        bootstrap_expect=expect,
+        gossip_interval_scale=0.05,
+        reconcile_interval_s=0.2,
+        coordinate_update_period_s=0.1,
+        session_ttl_sweep_s=0.1,
+        flood_interval_s=0.1,
+    )
+    return Server(
+        cfg,
+        gossip_transport=lan_net.new_transport(f"{name}.{dc}:gossip"),
+        rpc_transport=rpc_net.new_transport(f"{name}.{dc}:rpc"),
+        wan_transport=wan_net.new_transport(f"{name}.{dc}:wan"),
+    )
+
+
+async def start_two_dcs(n1=2, n2=1):
+    """dc1 with n1 servers, dc2 with n2; one explicit WAN join bridges
+    them, the flooder federates the rest."""
+    lan1, lan2 = InMemoryNetwork(), InMemoryNetwork()
+    wan, rpc = InMemoryNetwork(), InMemoryNetwork()
+    dc1 = [
+        make_dc_server(lan1, wan, rpc, f"a{i}", "dc1", n1) for i in range(n1)
+    ]
+    dc2 = [
+        make_dc_server(lan2, wan, rpc, f"b{i}", "dc2", n2) for i in range(n2)
+    ]
+    for s in dc1 + dc2:
+        await s.start()
+    for s in dc1[1:]:
+        await s.join([f"a0.dc1:gossip"])
+    for s in dc2[1:]:
+        await s.join([f"b0.dc2:gossip"])
+    await wait_for_leader(dc1)
+    await wait_for_leader(dc2)
+    # One WAN join from dc2's first server to dc1's (consul join -wan).
+    assert await dc2[0].join_wan(["a0.dc1:wan"]) == 1
+    return dc1, dc2
+
+
+async def shutdown_all(*servers):
+    for s in servers:
+        await s.shutdown()
+    await asyncio.sleep(0)
+
+
+class TestWANFederation:
+    async def test_flooder_federates_every_server(self):
+        dc1, dc2 = await start_two_dcs(n1=2, n2=1)
+        # Only a0<->b0 joined explicitly; the flooder must pull a1 into
+        # the WAN pool via its advertised wan_addr (flood.go:27-60).
+        await wait_until(
+            lambda: all(
+                {"dc1", "dc2"} <= set(s.router.servers_by_dc())
+                and len(s.router.servers_by_dc().get("dc1", [])) == 2
+                for s in dc1 + dc2
+            ),
+            timeout=10,
+            msg="every server sees 2 dc1 + 1 dc2 servers on the WAN",
+        )
+        await shutdown_all(*dc1, *dc2)
+
+    async def test_lan_pools_stay_isolated(self):
+        dc1, dc2 = await start_two_dcs()
+        # LAN membership never leaks across DCs (separate pools —
+        # server.go:506,534 keeps them distinct by construction).
+        assert all(
+            not any(m.startswith("b") for m in s.serf.members) for s in dc1
+        )
+        assert all(
+            not any(m.startswith("a") for m in s.serf.members) for s in dc2
+        )
+        await shutdown_all(*dc1, *dc2)
+
+    async def test_cross_dc_kv_write_and_read(self):
+        dc1, dc2 = await start_two_dcs()
+        entry = dc1[0]
+        # A write addressed to dc2 submitted to a dc1 server must land
+        # in dc2's replicated store (rpc.go forwardDC).
+        out = await entry.rpc_client.call(
+            "a0.dc1:rpc",
+            "KVS.Apply",
+            {"op": "set", "entry": {"key": "wan", "value": b"x"}, "dc": "dc2"},
+        )
+        assert out["result"] is True
+        assert dc2[0].store.kv_get("wan")[1]["value"] == b"x"
+        # And it is NOT in dc1's store.
+        assert dc1[0].store.kv_get("wan")[1] is None
+
+        got = await entry.rpc_client.call(
+            "a0.dc1:rpc", "KVS.Get", {"key": "wan", "dc": "dc2"}
+        )
+        assert got["entries"][0]["value"] == b"x"
+        await shutdown_all(*dc1, *dc2)
+
+    async def test_datacenters_listed_local_first(self):
+        dc1, dc2 = await start_two_dcs()
+        out = await dc1[0].rpc_client.call(
+            "a0.dc1:rpc", "Catalog.ListDatacenters", {}
+        )
+        assert out["datacenters"][0] == "dc1"
+        assert set(out["datacenters"]) == {"dc1", "dc2"}
+        out2 = await dc2[0].rpc_client.call(
+            "b0.dc2:rpc", "Catalog.ListDatacenters", {}
+        )
+        assert out2["datacenters"][0] == "dc2"
+        await shutdown_all(*dc1, *dc2)
+
+    async def test_http_dc_param_routes_write_and_read(self):
+        """PUT/GET /v1/kv/...?dc=dc2 against a dc1 agent crosses the WAN
+        (http.go parseDC → rpc.go forwardDC)."""
+        from test_http_dns import http_call
+
+        from consul_tpu.agent.agent import Agent, AgentConfig
+        from consul_tpu.agent.http import HTTPApi
+
+        lan1, lan2 = InMemoryNetwork(), InMemoryNetwork()
+        wan, rpc = InMemoryNetwork(), InMemoryNetwork()
+        mk = lambda name, dc, lan: Agent(
+            AgentConfig(node_name=name, datacenter=dc, bootstrap_expect=1,
+                        gossip_interval_scale=0.05, sync_interval_s=0.3,
+                        sync_retry_interval_s=0.2, reconcile_interval_s=0.2),
+            gossip_transport=lan.new_transport(f"{name}:gossip"),
+            rpc_transport=rpc.new_transport(f"{name}:rpc"),
+            wan_transport=wan.new_transport(f"{name}:wan"),
+        )
+        a1, a2 = mk("h1", "dc1", lan1), mk("h2", "dc2", lan2)
+        await a1.start()
+        await a2.start()
+        await wait_until(lambda: a1.delegate.is_leader(), msg="dc1 leader")
+        await wait_until(lambda: a2.delegate.is_leader(), msg="dc2 leader")
+        await a2.delegate.join_wan(["h1:wan"])
+
+        api = HTTPApi(a1)
+        addr = await api.start()
+        status, _, ok = await http_call(
+            addr, "PUT", "/v1/kv/xdc?dc=dc2", b"remote"
+        )
+        assert status == 200 and ok is True
+        assert a2.delegate.store.kv_get("xdc")[1]["value"] == b"remote"
+        assert a1.delegate.store.kv_get("xdc")[1] is None
+
+        status, _, rows = await http_call(addr, "GET", "/v1/kv/xdc?dc=dc2")
+        assert status == 200
+        assert base64.b64decode(rows[0]["Value"]) == b"remote"
+
+        await api.stop()
+        await a1.shutdown()
+        await a2.shutdown()
+
+    async def test_wan_coordinates_populate(self):
+        """The WAN pool's ping/ack piggyback fills the Vivaldi cache,
+        the input to GetDatacentersByDistance (ping_delegate.go:46-90,
+        router.go:534)."""
+        dc1, dc2 = await start_two_dcs()
+        await wait_until(
+            lambda: len(dc1[0].serf_wan.coord_cache) > 0,
+            timeout=15,
+            msg="WAN probe acks carried coordinates",
+        )
+        await shutdown_all(*dc1, *dc2)
