@@ -117,6 +117,11 @@ class Agent:
             sync_interval_s=config.sync_interval_s,
             retry_interval_s=config.sync_retry_interval_s,
         )
+        # Agent cache: typed, background-blocking-refresh reads
+        # (agent/cache, cache.go:285/488/717), primarily feeding DNS.
+        from consul_tpu.agent.cache import AgentCache
+
+        self.cache = AgentCache(rpc=self.rpc)
         self.checks: dict[str, CheckRunner] = {}
         self.events: list[UserEvent] = []  # dedup ring, newest last
         self.event_index = 0  # monotonic, the X-Consul-Index for /event/list
@@ -152,6 +157,12 @@ class Agent:
             return await self.delegate.rpc_server.dispatch_local(method, body)
         return await self.delegate.rpc(method, body)
 
+    async def cached_rpc(self, cache_type: str, body: dict):
+        """Read through the agent cache (agent.go cache-backed RPCs with
+        QueryOptions.UseCache): warm entries answer instantly while a
+        background blocking query keeps them fresh."""
+        return await self.cache.get(cache_type, body)
+
     async def start(self) -> None:
         await self.delegate.start()
         self.syncer.start()
@@ -164,6 +175,7 @@ class Agent:
 
     async def shutdown(self) -> None:
         self.syncer.stop()
+        self.cache.stop()
         for runner in self.checks.values():
             runner.stop()
         await self.delegate.shutdown()

@@ -19,6 +19,7 @@ import random
 import struct
 from typing import Optional
 
+from consul_tpu.agent import cache
 from consul_tpu.agent.agent import Agent
 
 log = logging.getLogger("consul_tpu.dns")
@@ -323,8 +324,8 @@ class DNSServer:
         """<node>.node[.<dc>].consul (dns.go nodeLookup)."""
         idx = core.index("node") if "node" in core else len(core) - 1
         node = ".".join(core[:idx])
-        out = await self.agent.rpc(
-            "Internal.NodeInfo", {"node": node, "allow_stale": True}
+        out = await self.agent.cached_rpc(
+            cache.NODE_INFO, {"node": node, "allow_stale": True}
         )
         dump = out.get("dump") or []
         if not dump:
@@ -366,8 +367,9 @@ class DNSServer:
                 "passing_only": self.only_passing}
         if tag:
             body["tag"] = tag
-        out = await self.agent.rpc("Health.ServiceNodes", body)
-        rows = out.get("nodes") or []
+        out = await self.agent.cached_rpc(cache.HEALTH_SERVICES, body)
+        # Cached values are shared: copy before shuffling.
+        rows = list(out.get("nodes") or [])
         if not rows:
             raise LookupError(service)
         self._rng.shuffle(rows)
@@ -393,12 +395,12 @@ class DNSServer:
     async def _query_lookup(self, core: list[str], q: DNSQuestion) -> list[DNSRecord]:
         """<name-or-id>.query.consul (dns.go preparedQueryLookup)."""
         name = ".".join(core[:-1])
-        out = await self.agent.rpc(
-            "PreparedQuery.Execute", {"query_id": name, "allow_stale": True}
+        out = await self.agent.cached_rpc(
+            cache.PREPARED_QUERY, {"query_id": name, "allow_stale": True}
         )
         if out.get("error"):
             raise LookupError(name)
-        rows = out.get("nodes") or []
+        rows = list(out.get("nodes") or [])
         if not rows:
             raise LookupError(name)
         self._rng.shuffle(rows)

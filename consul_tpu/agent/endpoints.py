@@ -500,6 +500,38 @@ def _wrap(idx_and_data: tuple[int, Any], key: str) -> tuple[int, dict]:
     return idx, {key: data}
 
 
+class Subscribe(_Endpoint):
+    """agent/rpc/subscribe/subscribe.go:45 — server-streaming change
+    subscriptions: a snapshot of current state (closed by an
+    end_of_snapshot marker), then live events as commits land.  Rides
+    the muxed RPC port as a streaming method instead of gRPC."""
+
+    async def subscribe(self, body: dict):
+        from consul_tpu.stream import SubscriptionClosed
+
+        topic = body["topic"]
+        key = body.get("key", "")
+        sub = self.server.publisher.subscribe(topic, key)
+        try:
+            while True:
+                ev = await sub.next()
+                yield {
+                    "topic": ev.topic,
+                    "key": ev.key,
+                    "index": ev.index,
+                    "payload": ev.payload,
+                    "end_of_snapshot": ev.end_of_snapshot,
+                }
+        except SubscriptionClosed:
+            # Store was rebuilt (snapshot restore): tell the consumer to
+            # resubscribe for a fresh snapshot (pbsubscribe
+            # NewSnapshotToFollow semantics, inverted: we end the
+            # stream with a reset marker).
+            yield {"reset": True}
+        finally:
+            sub.close()
+
+
 def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
     """The registry (server_oss.go:8-23)."""
     return {
@@ -514,4 +546,5 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "PreparedQuery": PreparedQuery(server),
         "Internal": Internal(server),
         "Operator": Operator(server),
+        "Subscribe": Subscribe(server),
     }

@@ -23,6 +23,12 @@ from typing import Any, Callable, Optional
 
 from consul_tpu.consensus.raft import FSM, Entry
 from consul_tpu.store.state import StateStore
+from consul_tpu.stream import (
+    TOPIC_KV,
+    TOPIC_SERVICE_HEALTH,
+    Event,
+    EventPublisher,
+)
 
 log = logging.getLogger("consul_tpu.fsm")
 
@@ -62,8 +68,21 @@ class ConsulFSM(FSM):
     is a consistent snapshot at some raft index (``fsm/fsm.go:102``).
     """
 
-    def __init__(self, store: Optional[StateStore] = None):
+    def __init__(
+        self,
+        store: Optional[StateStore] = None,
+        publisher: Optional[EventPublisher] = None,
+    ):
         self.store = store or StateStore()
+        # Change-stream publisher (state/memdb.go:37-41 wires the
+        # reference's changeTrackerDB to the EventPublisher; here the
+        # FSM is the single writer, so it is the publish point).
+        self.publisher = publisher
+        if publisher is not None:
+            publisher.register_snapshot_handler(
+                TOPIC_SERVICE_HEALTH, self._snapshot_service_health
+            )
+            publisher.register_snapshot_handler(TOPIC_KV, self._snapshot_kv)
         self._handlers: dict[int, Callable[[int, dict], Any]] = {
             MessageType.REGISTER: self._apply_register,
             MessageType.DEREGISTER: self._apply_deregister,
@@ -92,8 +111,13 @@ class ConsulFSM(FSM):
                 log.warning("ignoring unknown message type %d", msg_type)
                 return None
             raise ValueError(f"unknown raft command type {msg_type}")
+        pre = (
+            self._pre_change_info(msg_type & ~IGNORE_UNKNOWN_FLAG, body)
+            if self.publisher is not None
+            else None
+        )
         try:
-            return handler(entry.index, body)
+            result = handler(entry.index, body)
         except (ValueError, KeyError, TypeError) as e:
             # Domain errors (bad registration, missing session, malformed
             # body...) are a *result*, not an FSM failure: every replica
@@ -101,6 +125,16 @@ class ConsulFSM(FSM):
             # returns it to the caller (the reference returns the error
             # as the Apply value).
             return {"error": f"{type(e).__name__}: {e}"}
+        if self.publisher is not None:
+            try:
+                events = self._events_for(
+                    msg_type & ~IGNORE_UNKNOWN_FLAG, entry.index, body, pre
+                )
+                if events:
+                    self.publisher.publish(events)
+            except Exception:  # noqa: BLE001 - stream must never fail raft
+                log.exception("event publish failed")
+        return result
 
     def snapshot(self) -> Any:
         return self.store.snapshot()
@@ -108,8 +142,109 @@ class ConsulFSM(FSM):
     def restore(self, snap: Any) -> None:
         # The reference builds a NEW state store and abandons the old
         # one so blocked queries wake and re-run (fsm.go Restore);
-        # StateStore.restore does both.
+        # StateStore.restore does both.  Stream subscribers likewise get
+        # force-closed and must resubscribe for a fresh snapshot
+        # (event_publisher.go on index regression).
         self.store.restore(snap)
+        if self.publisher is not None:
+            self.publisher.close_all()
+
+    # -- change-stream plumbing (state/memdb.go:37-41 equivalents) ----------
+
+    def _snapshot_service_health(self, key: str) -> tuple[int, list]:
+        idx, rows = self.store.check_service_nodes(key)
+        return idx, [
+            Event(topic=TOPIC_SERVICE_HEALTH, key=key, index=idx, payload=rows)
+        ]
+
+    def _snapshot_kv(self, prefix: str) -> tuple[int, list]:
+        idx, entries = self.store.kv_list(prefix)
+        return idx, [
+            Event(topic=TOPIC_KV, key=e["key"], index=idx, payload=e)
+            for e in entries
+        ]
+
+    def _node_service_names(self, node: str) -> set[str]:
+        try:
+            _, services = self.store.node_services(node)
+        except Exception:  # noqa: BLE001 - node may be gone
+            return set()
+        return {s.get("service", s.get("id", "")) for s in services}
+
+    def _pre_change_info(self, msg_type: int, body: dict) -> Optional[dict]:
+        """Subjects only determinable BEFORE the store mutates (a
+        deregistration or recursive delete removes the rows we need to
+        look at): affected service names and kv keys."""
+        if msg_type == MessageType.DEREGISTER:
+            node = body.get("node", "")
+            if body.get("service_id"):
+                names = set()
+                _, services = self.store.node_services(node)
+                for s in services:
+                    if s.get("id") == body["service_id"]:
+                        names.add(s.get("service", ""))
+                return {"services": names}
+            return {"services": self._node_service_names(node)}
+        if msg_type == MessageType.KVS and body.get("op") == "delete-tree":
+            prefix = (body.get("entry") or {}).get("key", "")
+            _, entries = self.store.kv_list(prefix)
+            return {"kv_keys": {e["key"] for e in entries}}
+        return None
+
+    def _events_for(
+        self, msg_type: int, idx: int, body: dict, pre: Optional[dict]
+    ) -> list:
+        services: set[str] = set(
+            (pre or {}).get("services", ())
+        )
+        kv_keys: set[str] = set((pre or {}).get("kv_keys", ()))
+        if msg_type == MessageType.REGISTER:
+            svc = body.get("service")
+            if svc:
+                services.add(svc.get("service", svc.get("id", "")))
+            checks = list(body.get("checks") or [])
+            if body.get("check"):
+                checks.append(body["check"])
+            for c in checks:
+                if c.get("service_id"):
+                    # Map the check's service id to its name.
+                    node = body.get("node", "")
+                    _, node_svcs = self.store.node_services(node)
+                    for s in node_svcs:
+                        if s.get("id") == c["service_id"]:
+                            services.add(s.get("service", ""))
+                else:
+                    # Node-level check affects every service on the node
+                    # (a failing serf check fails them all).
+                    services |= self._node_service_names(body.get("node", ""))
+            if not svc and not checks:
+                # Node-only update (e.g. address change): every service
+                # on the node embeds the node record in its rows.
+                services |= self._node_service_names(body.get("node", ""))
+        elif msg_type == MessageType.KVS:
+            entry = body.get("entry") or {}
+            if entry.get("key"):
+                kv_keys.add(entry["key"])
+        elif msg_type == MessageType.TXN:
+            for op in body.get("ops", []):
+                entry = (op.get("kv") or {}).get("entry") or {}
+                if entry.get("key"):
+                    kv_keys.add(entry["key"])
+        events: list = []
+        for name in sorted(s for s in services if s):
+            _, rows = self.store.check_service_nodes(name)
+            events.append(
+                Event(
+                    topic=TOPIC_SERVICE_HEALTH, key=name, index=idx,
+                    payload=rows,
+                )
+            )
+        for key in sorted(kv_keys):
+            _, entry = self.store.kv_get(key)
+            events.append(
+                Event(topic=TOPIC_KV, key=key, index=idx, payload=entry)
+            )
+        return events
 
     # -- command handlers (fsm/commands_oss.go) -----------------------------
 

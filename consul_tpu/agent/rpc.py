@@ -28,11 +28,12 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import inspect
 import logging
 import random
 import re
 import time
-from typing import Any, Callable, Optional
+from typing import Any, AsyncIterator, Callable, Optional
 
 import msgpack
 
@@ -258,6 +259,9 @@ class RPCServer:
         interleave partial writes — the yamux-per-stream analogue)."""
         write_q: asyncio.Queue = asyncio.Queue()
         pending: set[asyncio.Task] = set()
+        streams_by_seq: dict[int, asyncio.Task] = {}
+        # Cancels that raced ahead of their handler task starting.
+        cancelled_seqs: set[int] = set()
 
         async def writer():
             try:
@@ -278,12 +282,49 @@ class RPCServer:
             while True:
                 raw = await stream.recv()
                 req = _unpack(raw)
+                if req.get("cancel"):
+                    # Client abandoned a server-streaming call
+                    # (grpc-style cancellation for Subscribe).  The
+                    # handler task may not have started yet — remember
+                    # the seq so it aborts on arrival.
+                    seq = req.get("seq", 0)
+                    t = streams_by_seq.pop(seq, None)
+                    if t is not None:
+                        t.cancel()
+                    else:
+                        cancelled_seqs.add(seq)
+                    continue
 
                 async def handle(req=req):
                     seq = req.get("seq", 0)
                     try:
                         result = await dispatch(req["method"], req.get("body") or {})
-                        resp = {"seq": seq, "error": None, "body": result}
+                        if inspect.isasyncgen(result):
+                            if seq in cancelled_seqs:
+                                # Cancel frame beat us here.
+                                cancelled_seqs.discard(seq)
+                                await result.aclose()
+                                return
+                            # Server-streaming response (the gRPC
+                            # subscribe analogue, subscribe.go:45): one
+                            # frame per yielded item with more=True,
+                            # then a closing frame.
+                            streams_by_seq[seq] = asyncio.current_task()
+                            try:
+                                async for item in result:
+                                    await write_q.put(_pack(
+                                        {"seq": seq, "error": None,
+                                         "body": item, "more": True}
+                                    ))
+                                resp = {"seq": seq, "error": None,
+                                        "body": None, "more": False}
+                            except asyncio.CancelledError:
+                                await result.aclose()
+                                return
+                            finally:
+                                streams_by_seq.pop(seq, None)
+                        else:
+                            resp = {"seq": seq, "error": None, "body": result}
                     except Exception as e:  # noqa: BLE001 — error -> wire
                         resp = {"seq": seq, "error": str(e) or repr(e), "body": None}
                     try:
@@ -314,6 +355,10 @@ class RPCServer:
         fn = getattr(endpoint, snake(verb), None) if endpoint else None
         if fn is None or verb.startswith("_"):
             raise RPCError(f"rpc: can't find method {method}")
+        if inspect.isasyncgenfunction(fn):
+            # Server-streaming endpoint: hand the generator back to the
+            # frame pump (or a local caller) to iterate.
+            return fn(body)
         return await fn(body)
 
     async def _dispatch_raft(self, method: str, body: dict) -> Any:
@@ -334,6 +379,8 @@ class _Conn:
         self.stream = stream
         self.seq = 0
         self.waiters: dict[int, asyncio.Future] = {}
+        # seq -> queue for server-streaming calls (multiple frames).
+        self.stream_waiters: dict[int, asyncio.Queue] = {}
         self.reader: Optional[asyncio.Task] = None
         self.dead = False
 
@@ -343,6 +390,9 @@ class _Conn:
             if not fut.done():
                 fut.set_exception(exc)
         self.waiters.clear()
+        for q in self.stream_waiters.values():
+            q.put_nowait(exc)
+        self.stream_waiters.clear()
 
 
 class RPCClient:
@@ -401,11 +451,53 @@ class RPCClient:
             self._conns[addr] = conn
             return conn
 
+    async def stream(
+        self, addr: str, method: str, body: dict
+    ) -> AsyncIterator[Any]:
+        """Server-streaming call: yields each frame's body until the
+        server closes the stream (the client half of Subscribe).
+        Abandoning the iterator sends a cancel frame."""
+        conn = await self._get_conn(addr)
+        conn.seq += 1
+        seq = conn.seq
+        q: asyncio.Queue = asyncio.Queue()
+        conn.stream_waiters[seq] = q
+        finished = False
+        try:
+            await conn.stream.send(
+                _pack({"seq": seq, "method": method, "body": body})
+            )
+            while True:
+                item = await q.get()
+                if isinstance(item, Exception):
+                    finished = True
+                    raise item
+                if item.get("error"):
+                    finished = True
+                    raise RPCError(item["error"])
+                if not item.get("more", False):
+                    finished = True
+                    return
+                yield item.get("body")
+        finally:
+            conn.stream_waiters.pop(seq, None)
+            if not finished and not conn.dead:
+                # Iterator abandoned mid-stream: tell the server.
+                try:
+                    await conn.stream.send(_pack({"seq": seq, "cancel": True}))
+                except Exception:  # noqa: BLE001 - conn already torn down
+                    pass
+
     async def _read_loop(self, addr: str, conn: _Conn) -> None:
         try:
             while True:
                 resp = _unpack(await conn.stream.recv())
-                fut = conn.waiters.get(resp.get("seq"))
+                seq = resp.get("seq")
+                sq = conn.stream_waiters.get(seq)
+                if sq is not None:
+                    sq.put_nowait(resp)
+                    continue
+                fut = conn.waiters.get(seq)
                 if fut and not fut.done():
                     fut.set_result(resp)
         except (ConnectionError, asyncio.CancelledError, Exception) as e:

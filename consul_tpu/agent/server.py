@@ -102,7 +102,12 @@ class Server:
         wan_transport: Optional[Transport] = None,
     ):
         self.config = config
-        self.fsm = ConsulFSM()
+        # Change-stream pub/sub fed by the FSM (stream/event_publisher.go
+        # wired at state/memdb.go:37-41), served by Subscribe RPCs.
+        from consul_tpu.stream import EventPublisher
+
+        self.publisher = EventPublisher()
+        self.fsm = ConsulFSM(publisher=self.publisher)
         self.store = self.fsm.store
 
         # RPC plane (port 8300 analogue; serf rides gossip_transport).

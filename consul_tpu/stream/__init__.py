@@ -1,0 +1,20 @@
+"""State-change pub/sub: snapshot + live-follow subscriptions
+(agent/consul/stream + agent/rpc/subscribe equivalents)."""
+
+from consul_tpu.stream.publisher import (
+    TOPIC_KV,
+    TOPIC_SERVICE_HEALTH,
+    Event,
+    EventPublisher,
+    Subscription,
+    SubscriptionClosed,
+)
+
+__all__ = [
+    "TOPIC_KV",
+    "TOPIC_SERVICE_HEALTH",
+    "Event",
+    "EventPublisher",
+    "Subscription",
+    "SubscriptionClosed",
+]

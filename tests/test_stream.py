@@ -1,0 +1,210 @@
+"""Event stream pub/sub: snapshot+follow subscriptions, FSM publishing,
+and the streaming Subscribe RPC across a leader change.
+
+Parity model: agent/consul/stream/event_publisher_test.go +
+agent/rpc/subscribe/subscribe_test.go (snapshot, end-of-snapshot
+marker, live follow, reset on store abandon).
+"""
+
+import asyncio
+
+import pytest
+
+from helpers import wait_for as wait_until
+from helpers import wait_for_leader
+
+from consul_tpu.stream import (
+    TOPIC_KV,
+    TOPIC_SERVICE_HEALTH,
+    Event,
+    EventPublisher,
+    SubscriptionClosed,
+)
+
+from test_cluster_agents import make_server, shutdown_all, start_cluster
+from consul_tpu.net.transport import InMemoryNetwork
+
+
+# ---------------------------------------------------------------------------
+# publisher unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_then_live():
+    async def main():
+        pub = EventPublisher()
+        pub.register_snapshot_handler(
+            "t", lambda key: (7, [Event("t", key, 7, {"snap": key})])
+        )
+        sub = pub.subscribe("t", "a")
+        ev = await sub.next()
+        assert ev.payload == {"snap": "a"}
+        eos = await sub.next()
+        assert eos.end_of_snapshot and eos.index == 7
+        pub.publish([Event("t", "a", 8, {"live": 1})])
+        live = await sub.next()
+        assert live.payload == {"live": 1} and live.index == 8
+
+    asyncio.run(main())
+
+
+def test_key_filtering_and_multiple_subscribers():
+    async def main():
+        pub = EventPublisher()
+        sub_a = pub.subscribe("t", "a")
+        sub_all = pub.subscribe("t", "")
+        pub.publish([Event("t", "b", 1, "B"), Event("t", "a", 1, "A")])
+        assert (await sub_a.next()).payload == "A"
+        assert (await sub_all.next()).payload == "B"
+        assert (await sub_all.next()).payload == "A"
+        # sub_a never sees b's event; a timeout proves the filter.
+        with pytest.raises(asyncio.TimeoutError):
+            await sub_a.next(timeout=0.05)
+
+    asyncio.run(main())
+
+
+def test_slow_subscriber_misses_nothing():
+    async def main():
+        pub = EventPublisher()
+        sub = pub.subscribe("t", "")
+        for i in range(50):
+            pub.publish([Event("t", "k", i + 1, i)])
+        got = [(await sub.next()).payload for _ in range(50)]
+        assert got == list(range(50))
+
+    asyncio.run(main())
+
+
+def test_close_all_wakes_and_raises():
+    async def main():
+        pub = EventPublisher()
+        sub = pub.subscribe("t", "")
+        waiter = asyncio.create_task(sub.next())
+        await asyncio.sleep(0.01)
+        pub.close_all()
+        with pytest.raises(SubscriptionClosed):
+            await waiter
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# cluster end-to-end: Subscribe RPC through the muxed stream
+# ---------------------------------------------------------------------------
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=120))
+
+
+class TestSubscribeRPC:
+    async def _collect(self, events, it):
+        async for ev in it:
+            events.append(ev)
+
+    async def test_snapshot_then_live_across_leader_change(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+        follower = next(s for s in servers if not s.is_leader())
+
+        # Seed one instance of 'web' BEFORE subscribing: it must arrive
+        # in the snapshot.
+        await leader.rpc_client.call(
+            f"{leader.node_id}:rpc", "Catalog.Register",
+            {"node": "n1", "address": "10.0.0.1",
+             "service": {"id": "web1", "service": "web", "port": 80}},
+        )
+
+        # Wait for the registration to replicate to the follower so the
+        # snapshot (served from ITS store) contains it.
+        await wait_until(
+            lambda: follower.store.check_service_nodes("web")[1],
+            msg="registration replicated to follower",
+        )
+
+        events: list = []
+        it = follower.rpc_client.stream(
+            f"{follower.node_id}:rpc", "Subscribe.Subscribe",
+            {"topic": TOPIC_SERVICE_HEALTH, "key": "web"},
+        )
+        task = asyncio.create_task(self._collect(events, it))
+
+        await wait_until(
+            lambda: any(e.get("end_of_snapshot") for e in events),
+            msg="snapshot delivered",
+        )
+        snap = [e for e in events if not e.get("end_of_snapshot")]
+        assert snap and any(
+            r["service"]["id"] == "web1" for r in snap[0]["payload"]
+        )
+
+        # Live follow: another instance registers.
+        await leader.rpc_client.call(
+            f"{leader.node_id}:rpc", "Catalog.Register",
+            {"node": "n2", "address": "10.0.0.2",
+             "service": {"id": "web2", "service": "web", "port": 80}},
+        )
+        await wait_until(
+            lambda: any(
+                not e.get("end_of_snapshot")
+                and e.get("payload") is not None
+                and len(e["payload"]) == 2
+                for e in events
+            ),
+            msg="live event with both instances",
+        )
+
+        # Leader change: the subscription is served from the follower's
+        # local store, which keeps applying the new leader's commits.
+        await leader.shutdown()
+        remaining = [s for s in servers if s is not leader]
+        new_leader = await wait_for_leader(remaining)
+        count_before = len(events)
+        await new_leader.rpc_client.call(
+            f"{new_leader.node_id}:rpc",
+            "Catalog.Register",
+            {"node": "n3", "address": "10.0.0.3",
+             "service": {"id": "web3", "service": "web", "port": 80}},
+        )
+        await wait_until(
+            lambda: len(events) > count_before,
+            msg="live event after leader change",
+        )
+        task.cancel()
+        await shutdown_all(*remaining)
+
+    async def test_kv_topic(self):
+        net = InMemoryNetwork()
+        servers = await start_cluster(net)
+        leader = next(s for s in servers if s.is_leader())
+        events: list = []
+        it = leader.rpc_client.stream(
+            f"{leader.node_id}:rpc", "Subscribe.Subscribe",
+            {"topic": TOPIC_KV, "key": "app/config"},
+        )
+        task = asyncio.create_task(self._collect(events, it))
+        await wait_until(
+            lambda: any(e.get("end_of_snapshot") for e in events),
+            msg="kv snapshot",
+        )
+        await leader.rpc_client.call(
+            f"{leader.node_id}:rpc", "KVS.Apply",
+            {"op": "set", "entry": {"key": "app/config", "value": b"v1"}},
+        )
+        await wait_until(
+            lambda: any(
+                (e.get("payload") or {}).get("value") == b"v1" for e in events
+            ),
+            msg="kv live event",
+        )
+        # A different key's write must NOT arrive.
+        await leader.rpc_client.call(
+            f"{leader.node_id}:rpc", "KVS.Apply",
+            {"op": "set", "entry": {"key": "other", "value": b"z"}},
+        )
+        await asyncio.sleep(0.1)
+        assert not any(e.get("key") == "other" for e in events)
+        task.cancel()
+        await shutdown_all(*servers)
