@@ -54,6 +54,13 @@ class AgentConfig:
     reconcile_interval_s: float = 60.0
     coordinate_update_period_s: float = 5.0
     session_ttl_sweep_s: float = 1.0
+    # ACLs (forwarded to ServerConfig).
+    acl_enabled: bool = False
+    acl_default_policy: str = "allow"
+    acl_master_token: str = ""
+    # Token the agent itself uses for anti-entropy catalog writes
+    # (agent/config acl.tokens.agent).
+    acl_agent_token: str = ""
 
 
 @dataclasses.dataclass
@@ -88,6 +95,9 @@ class Agent:
                     reconcile_interval_s=config.reconcile_interval_s,
                     coordinate_update_period_s=config.coordinate_update_period_s,
                     session_ttl_sweep_s=config.session_ttl_sweep_s,
+                    acl_enabled=config.acl_enabled,
+                    acl_default_policy=config.acl_default_policy,
+                    acl_master_token=config.acl_master_token,
                 ),
                 gossip_transport,
                 rpc_transport,
@@ -110,7 +120,7 @@ class Agent:
             )
 
         addr = config.advertise_addr or gossip_transport.local_addr()
-        self.local = LocalState(config.node_name, self.rpc, address=addr)
+        self.local = LocalState(config.node_name, self._agent_rpc, address=addr)
         self.syncer = StateSyncer(
             self.local,
             cluster_size=lambda: len(self.serf.members) or 1,
@@ -121,7 +131,10 @@ class Agent:
         # (agent/cache, cache.go:285/488/717), primarily feeding DNS.
         from consul_tpu.agent.cache import AgentCache
 
-        self.cache = AgentCache(rpc=self.rpc)
+        # Reads through the cache run as the AGENT identity so DNS
+        # works under ACL enforcement (the reference's DNS RPCs carry
+        # the agent token too).
+        self.cache = AgentCache(rpc=self._agent_rpc)
         self.checks: dict[str, CheckRunner] = {}
         self.events: list[UserEvent] = []  # dedup ring, newest last
         self.event_index = 0  # monotonic, the X-Consul-Index for /event/list
@@ -156,6 +169,14 @@ class Agent:
         if isinstance(self.delegate, Server):
             return await self.delegate.rpc_server.dispatch_local(method, body)
         return await self.delegate.rpc(method, body)
+
+    async def _agent_rpc(self, method: str, body: dict):
+        """RPC as the AGENT identity: anti-entropy writes carry the
+        agent token (agent/config acl.tokens.agent) so registration
+        sync works under ACL enforcement."""
+        if self.config.acl_agent_token and "token" not in body:
+            body = {**body, "token": self.config.acl_agent_token}
+        return await self.rpc(method, body)
 
     async def cached_rpc(self, cache_type: str, body: dict):
         """Read through the agent cache (agent.go cache-backed RPCs with

@@ -46,6 +46,7 @@ PREPARED_QUERY = "prepared-query"
 
 REFRESH_BACKOFF_MIN = 0.5   # cache.go RefreshBackoffMin (scaled-friendly)
 REFRESH_TIMEOUT = 600.0     # cache-types' 10-minute blocking wait
+MAX_REFRESH_TASKS = 512     # cap on concurrent background refreshers
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,9 +223,17 @@ class AgentCache:
                     fut.set_result(None)
             entry.waiters.clear()
         if t.refresh and entry.refresh_task is None and not self._shutdown:
-            entry.refresh_task = asyncio.create_task(
-                self._refresh_loop(t, key, entry, body)
+            # Cap background refreshers: a flood of distinct (possibly
+            # bogus) names must not pin an unbounded task per key —
+            # entries over the cap behave as TTL-only.
+            active = sum(
+                1 for e in self._entries.values()
+                if e.refresh_task is not None and not e.refresh_task.done()
             )
+            if active < MAX_REFRESH_TASKS:
+                entry.refresh_task = asyncio.create_task(
+                    self._refresh_loop(t, key, entry, body)
+                )
 
     async def _refresh_loop(self, t: CacheType, key: tuple, entry: _Entry,
                             body: dict) -> None:

@@ -13,9 +13,11 @@ the reference finds the same RPC surface.
 
 from __future__ import annotations
 
+import asyncio
 import uuid
 from typing import TYPE_CHECKING, Any, Callable, Optional
 
+from consul_tpu.acl.engine import READ, WRITE
 from consul_tpu.agent.fsm import MessageType
 from consul_tpu.agent.rpc import QueryOptions, blocking_query
 from consul_tpu.store.state import HEALTH_CRITICAL, HEALTH_PASSING
@@ -27,6 +29,18 @@ if TYPE_CHECKING:
 class _Endpoint:
     def __init__(self, server: "Server"):
         self.server = server
+
+    def _authz(self, body: dict):
+        """Authorizer for read-side filterACL, or None when enforcement
+        is off (ACLs disabled, or the request targets another DC whose
+        own servers enforce)."""
+        srv = self.server
+        if not srv.acl.enabled:
+            return None
+        dc = body.get("dc")
+        if dc and dc != srv.config.datacenter:
+            return None
+        return srv.acl_resolve(body)
 
     async def _read(self, method: str, body: dict, run: Callable):
         """Common read path: forward unless stale, optional consistency
@@ -85,24 +99,48 @@ class Catalog(_Endpoint):
     """catalog_endpoint.go."""
 
     async def register(self, body: dict):
+        # catalog_endpoint.go Register: node write + service write when
+        # a service is included (vetRegisterWithACL).
+        self.server.acl_check(body, "node", body.get("node", ""), WRITE)
+        svc = body.get("service")
+        if svc:
+            self.server.acl_check(
+                body, "service", svc.get("service", ""), WRITE
+            )
         return await self._write("Catalog.Register", MessageType.REGISTER, body)
 
     async def deregister(self, body: dict):
+        self.server.acl_check(body, "node", body.get("node", ""), WRITE)
         return await self._write("Catalog.Deregister", MessageType.DEREGISTER, body)
 
     async def list_nodes(self, body: dict):
-        return await self._read(
+        out = await self._read(
             "Catalog.ListNodes", body,
             lambda ws: _wrap(self.server.store.nodes(ws), "nodes"),
         )
+        authz = self._authz(body)
+        if authz is not None and "nodes" in out:
+            out["nodes"] = [
+                n for n in out["nodes"] if authz.node_read(n.get("name", ""))
+            ]
+        return out
 
     async def list_services(self, body: dict):
-        return await self._read(
+        out = await self._read(
             "Catalog.ListServices", body,
             lambda ws: _wrap(self.server.store.services(ws), "services"),
         )
+        authz = self._authz(body)
+        if authz is not None and "services" in out:
+            out["services"] = {
+                name: tags
+                for name, tags in out["services"].items()
+                if authz.service_read(name)
+            }
+        return out
 
     async def service_nodes(self, body: dict):
+        self.server.acl_check(body, "service", body.get("service", ""), READ)
         tag = body.get("tag")
         return await self._read(
             "Catalog.ServiceNodes", body,
@@ -113,12 +151,20 @@ class Catalog(_Endpoint):
         )
 
     async def node_services(self, body: dict):
-        return await self._read(
+        self.server.acl_check(body, "node", body.get("node", ""), READ)
+        out = await self._read(
             "Catalog.NodeServices", body,
             lambda ws: _wrap(
                 self.server.store.node_services(body["node"], ws=ws), "services"
             ),
         )
+        authz = self._authz(body)
+        if authz is not None and "services" in out:
+            out["services"] = [
+                s for s in out["services"]
+                if authz.service_read(s.get("service", ""))
+            ]
+        return out
 
     async def list_datacenters(self, body: dict):
         """catalog_endpoint.go ListDatacenters: known DCs sorted by
@@ -130,6 +176,7 @@ class Health(_Endpoint):
     """health_endpoint.go."""
 
     async def node_checks(self, body: dict):
+        self.server.acl_check(body, "node", body.get("node", ""), READ)
         return await self._read(
             "Health.NodeChecks", body,
             lambda ws: _wrap(self.server.store.node_checks(body["node"], ws=ws),
@@ -137,6 +184,7 @@ class Health(_Endpoint):
         )
 
     async def service_checks(self, body: dict):
+        self.server.acl_check(body, "service", body.get("service", ""), READ)
         return await self._read(
             "Health.ServiceChecks", body,
             lambda ws: _wrap(
@@ -155,6 +203,7 @@ class Health(_Endpoint):
     async def service_nodes(self, body: dict):
         """Nodes + service + checks, optionally only passing instances
         (health_endpoint.go ServiceNodes w/ PassingOnly)."""
+        self.server.acl_check(body, "service", body.get("service", ""), READ)
         passing = bool(body.get("passing_only", body.get("passing", False)))
         return await self._read(
             "Health.ServiceNodes", body,
@@ -172,6 +221,11 @@ class KVS(_Endpoint):
     """kvs_endpoint.go."""
 
     async def apply(self, body: dict):
+        # kvs_endpoint.go:35-60 kvsPreApply: key write (+ the reference
+        # also checks session perms for lock ops via the session's node).
+        self.server.acl_check(
+            body, "key", (body.get("entry") or {}).get("key", ""), WRITE
+        )
         fwd = await self.server.forward("KVS.Apply", body)
         if fwd is not None:
             return fwd
@@ -192,6 +246,8 @@ class KVS(_Endpoint):
         }
 
     async def get(self, body: dict):
+        self.server.acl_check(body, "key", body["key"], READ)
+
         def run(ws):
             idx, rec = self.server.store.kv_get(body["key"], ws=ws)
             return idx, {"entries": [rec] if rec else []}
@@ -199,14 +255,15 @@ class KVS(_Endpoint):
         return await self._read("KVS.Get", body, run)
 
     async def list(self, body: dict):
-        return await self._read(
+        out = await self._read(
             "KVS.List", body,
             lambda ws: _wrap(self.server.store.kv_list(body["key"], ws=ws),
                              "entries"),
         )
+        return self._filter_keys(body, out, "entries", lambda e: e["key"])
 
     async def list_keys(self, body: dict):
-        return await self._read(
+        out = await self._read(
             "KVS.ListKeys", body,
             lambda ws: _wrap(
                 self.server.store.kv_keys(
@@ -215,6 +272,21 @@ class KVS(_Endpoint):
                 "keys",
             ),
         )
+        return self._filter_keys(body, out, "keys", lambda k: k)
+
+    def _filter_keys(self, body: dict, out: dict, field: str, key_of):
+        """filterACL on list results: entries the token cannot read are
+        dropped, not denied (consul/filter.go FilterKeys)."""
+        if not self.server.acl.enabled or field not in out:
+            return out
+        dc = body.get("dc")
+        if dc and dc != self.server.config.datacenter:
+            return out
+        authz = self.server.acl_resolve(body)
+        out[field] = [
+            item for item in out[field] if authz.key_read(key_of(item))
+        ]
+        return out
 
 
 class Session(_Endpoint):
@@ -222,6 +294,14 @@ class Session(_Endpoint):
 
     async def apply(self, body: dict):
         op = body.get("op")
+        # session_endpoint.go Apply: session write on the session's node.
+        node = (body.get("session") or {}).get("node", "")
+        if op == "destroy" and not node:
+            _, existing = self.server.store.session_get(
+                (body.get("session") or {}).get("id", "")
+            )
+            node = (existing or {}).get("node", "")
+        self.server.acl_check(body, "session", node, WRITE)
         if op == "create":
             sess = dict(body.get("session") or {})
             sess.setdefault("id", str(uuid.uuid4()))
@@ -257,6 +337,9 @@ class Session(_Endpoint):
         idx, sess = self.server.store.session_get(body["id"])
         if sess is None:
             return {"sessions": [], "meta": {"index": idx}}
+        # session_endpoint.go Renew: session write on the session's node
+        # (an unauthorized party must not keep locks alive).
+        self.server.acl_check(body, "session", sess.get("node", ""), WRITE)
         from consul_tpu.agent.server import _parse_ttl
 
         ttl = _parse_ttl(sess.get("ttl"))
@@ -338,6 +421,9 @@ class ConfigEntry(_Endpoint):
     """config_endpoint.go."""
 
     async def apply(self, body: dict):
+        # config_endpoint.go Apply checks per-kind service/operator
+        # perms; collapsed here to operator write.
+        self.server.acl_check(body, "operator", "", WRITE)
         return await self._write("ConfigEntry.Apply", MessageType.CONFIG_ENTRY, body)
 
     async def get(self, body: dict):
@@ -366,6 +452,10 @@ class PreparedQuery(_Endpoint):
 
     async def apply(self, body: dict):
         op = body.get("op")
+        # prepared_query_endpoint.go Apply: query write on the name.
+        self.server.acl_check(
+            body, "query", (body.get("query") or {}).get("name", ""), WRITE
+        )
         if op in ("create", "update"):
             q = dict(body.get("query") or {})
             q.setdefault("id", str(uuid.uuid4()))
@@ -418,6 +508,8 @@ class Internal(_Endpoint):
     """internal_endpoint.go — composite reads used by the UI/agent."""
 
     async def node_info(self, body: dict):
+        self.server.acl_check(body, "node", body.get("node", ""), READ)
+
         def run(ws):
             idx1, node = self.server.store.node(body["node"], ws=ws)
             idx2, svcs = self.server.store.node_services(body["node"], ws=ws)
@@ -431,6 +523,10 @@ class Internal(_Endpoint):
         return await self._read("Internal.NodeInfo", body, run)
 
     async def node_dump(self, body: dict):
+        # internal_endpoint.go NodeDump is filtered per node
+        # (filterACL); collapsed to a node read check on the whole dump.
+        self.server.acl_check(body, "node", "", READ)
+
         def run(ws):
             idx, nodes = self.server.store.nodes(ws=ws)
             # Watch + index across ALL three tables, or a blocking dump
@@ -453,6 +549,7 @@ class Operator(_Endpoint):
     """operator_raft_endpoint.go / operator_autopilot_endpoint.go."""
 
     async def raft_get_configuration(self, body: dict):
+        self.server.acl_check(body, "operator", "", READ)
         raft = self.server.raft
         servers = []
         if raft is not None:
@@ -466,6 +563,7 @@ class Operator(_Endpoint):
         return {"servers": servers, "index": raft.commit_index if raft else 0}
 
     async def raft_remove_peer_by_id(self, body: dict):
+        self.server.acl_check(body, "operator", "", WRITE)
         fwd = await self.server.forward("Operator.RaftRemovePeerByID", body)
         if fwd is not None:
             return fwd
@@ -498,6 +596,120 @@ class Operator(_Endpoint):
 def _wrap(idx_and_data: tuple[int, Any], key: str) -> tuple[int, dict]:
     idx, data = idx_and_data
     return idx, {key: data}
+
+
+class ACL(_Endpoint):
+    """acl_endpoint.go — token/policy CRUD + one-shot bootstrap.
+
+    Bootstrap (acl_endpoint.go:56-118 BootstrapTokens): allowed only
+    while no management token exists; returns a generated management
+    secret.  All other methods require acl read/write via a resolved
+    token (consul/acl_endpoint.go authorizers)."""
+
+    def __init__(self, server):
+        super().__init__(server)
+        self._bootstrap_lock = asyncio.Lock()
+
+    async def bootstrap(self, body: dict):
+        fwd = await self.server.forward("ACL.Bootstrap", body)
+        if fwd is not None:
+            return fwd
+        # Serialize check-then-apply so concurrent bootstraps can't both
+        # mint a management token (acl_endpoint.go guards with the
+        # bootstrap reset index through raft).
+        async with self._bootstrap_lock:
+            _, tokens = self.server.store.acl_token_list()
+            if any(t.get("type") == "management" for t in tokens):
+                raise ValueError("ACL bootstrap no longer allowed")
+            secret = str(uuid.uuid4())
+            token = {
+                "secret_id": secret,
+                "description": "Bootstrap Token (Global Management)",
+                "type": "management",
+                "policies": [],
+            }
+            await self.server.raft_apply(
+                MessageType.ACL_TOKEN_SET, {"token": token}
+            )
+        self.server.acl.invalidate()
+        return {"token": token}
+
+    async def token_set(self, body: dict):
+        # Forward the ORIGINAL body (auth token intact) and transform on
+        # the executing leader only — a follower must never forward a
+        # half-built raft payload back into this endpoint.
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.TokenSet", body)
+        if fwd is not None:
+            return fwd
+        token = dict(body.get("acl_token") or body.get("new_token") or {})
+        token.setdefault("secret_id", str(uuid.uuid4()))
+        result = await self.server.raft_apply(
+            MessageType.ACL_TOKEN_SET, {"token": token}
+        )
+        self.server.acl.invalidate(token["secret_id"])
+        return {"result": result, "token": token}
+
+    async def token_delete(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.TokenDelete", body)
+        if fwd is not None:
+            return fwd
+        result = await self.server.raft_apply(
+            MessageType.ACL_TOKEN_DELETE, {"secret_id": body["secret_id"]}
+        )
+        self.server.acl.invalidate(body["secret_id"])
+        return {"result": result}
+
+    async def token_list(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        idx, tokens = self.server.store.acl_token_list()
+        # Secrets are redacted for mere acl:read (the reference exposes
+        # them only to acl:write).
+        if not self.server.acl_resolve(body).acl_write():
+            tokens = [
+                {**t, "secret_id": "<hidden>"} for t in tokens
+            ]
+        return {"tokens": tokens, "meta": {"index": idx}}
+
+    async def token_read(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        rec = self.server.store.acl_token_get(body["secret_id"])
+        return {"token": rec}
+
+    async def policy_set(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.PolicySet", body)
+        if fwd is not None:
+            return fwd
+        policy = dict(body.get("policy") or {})
+        policy.setdefault("id", str(uuid.uuid4()))
+        result = await self.server.raft_apply(
+            MessageType.ACL_POLICY_SET, {"policy": policy}
+        )
+        self.server.acl.invalidate()
+        return {"result": result, "policy": policy}
+
+    async def policy_delete(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.PolicyDelete", body)
+        if fwd is not None:
+            return fwd
+        result = await self.server.raft_apply(
+            MessageType.ACL_POLICY_DELETE, {"id": body["id"]}
+        )
+        self.server.acl.invalidate()
+        return {"result": result}
+
+    async def policy_list(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        idx, policies = self.server.store.acl_policy_list()
+        return {"policies": policies, "meta": {"index": idx}}
+
+    async def policy_read(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        rec = self.server.store.acl_policy_get(body["id"])
+        return {"policy": rec}
 
 
 class Subscribe(_Endpoint):
@@ -546,5 +758,6 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "PreparedQuery": PreparedQuery(server),
         "Internal": Internal(server),
         "Operator": Operator(server),
+        "ACL": ACL(server),
         "Subscribe": Subscribe(server),
     }

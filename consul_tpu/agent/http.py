@@ -31,7 +31,11 @@ import urllib.parse
 from typing import Any, Callable, Optional
 
 from consul_tpu.agent.agent import Agent
-from consul_tpu.agent.rpc import RPCError
+from consul_tpu.agent.rpc import (
+    ERR_ACL_NOT_FOUND,
+    ERR_PERMISSION_DENIED,
+    RPCError,
+)
 from consul_tpu.agent.server import _parse_ttl
 from consul_tpu.version import __version__
 
@@ -85,11 +89,23 @@ class HTTPRequest:
             return {}
         return json.loads(self.body)
 
+    def token(self) -> str:
+        """http.go parseToken: ?token= beats the X-Consul-Token header."""
+        return self.query.get("token") or self.headers.get(
+            "x-consul-token", ""
+        )
+
     def dc_option(self) -> dict:
-        """http.go parseDC applies ?dc= to WRITES as well as reads —
-        splat this into every RPC write body so cross-DC forwarding
-        engages (rpc.go:577 checks dc before anything else)."""
-        return {"dc": self.query["dc"]} if "dc" in self.query else {}
+        """http.go parseDC + parseToken apply to WRITES as well as
+        reads — splat this into every RPC write body so cross-DC
+        forwarding and ACL enforcement engage (rpc.go:577)."""
+        out: dict = {}
+        if "dc" in self.query:
+            out["dc"] = self.query["dc"]
+        tok = self.token()
+        if tok:
+            out["token"] = tok
+        return out
 
     def query_options(self) -> dict:
         """Blocking/consistency params → RPC body fields
@@ -99,6 +115,9 @@ class HTTPRequest:
             # http.go parseDC: target datacenter; the RPC layer forwards
             # over the WAN when it differs from the local DC.
             opts["dc"] = self.query["dc"]
+        tok = self.token()
+        if tok:
+            opts["token"] = tok
         if "index" in self.query:
             opts["min_query_index"] = int(self.query["index"])
         if "wait" in self.query:
@@ -246,7 +265,12 @@ class HTTPApi:
             try:
                 return await handler(req, m)
             except RPCError as e:
-                return HTTPResponse(500, {"error": str(e)})
+                # http.go:1067-1080: ACL failures are 403s, the rest of
+                # the RPC error space is a 500.
+                msg = str(e)
+                if msg in (ERR_PERMISSION_DENIED, ERR_ACL_NOT_FOUND):
+                    return HTTPResponse(403, {"error": msg})
+                return HTTPResponse(500, {"error": msg})
             except (ValueError, KeyError, json.JSONDecodeError) as e:
                 return HTTPResponse(400, {"error": f"{type(e).__name__}: {e}"})
             except asyncio.CancelledError:
@@ -334,6 +358,16 @@ class HTTPApi:
         # operator
         r("GET", r"/v1/operator/raft/configuration", self.operator_raft)
         r("GET", r"/v1/operator/autopilot/health", self.operator_health)
+        # acl (http_register.go /v1/acl/*)
+        r("PUT", r"/v1/acl/bootstrap", self.acl_bootstrap)
+        r("PUT", r"/v1/acl/token", self.acl_token_set)
+        r("GET", r"/v1/acl/tokens", self.acl_token_list)
+        r("GET", r"/v1/acl/token/(?P<sid>.+)", self.acl_token_read)
+        r("DELETE", r"/v1/acl/token/(?P<sid>.+)", self.acl_token_delete)
+        r("PUT", r"/v1/acl/policy", self.acl_policy_set)
+        r("GET", r"/v1/acl/policies", self.acl_policy_list)
+        r("GET", r"/v1/acl/policy/(?P<pid>.+)", self.acl_policy_read)
+        r("DELETE", r"/v1/acl/policy/(?P<pid>.+)", self.acl_policy_delete)
 
     # -- helpers --------------------------------------------------------
 
@@ -814,6 +848,63 @@ class HTTPApi:
             **req.dc_option(),
         })
         return HTTPResponse(200, out.get("result", True))
+
+    # -- acl -----------------------------------------------------------------
+
+    async def acl_bootstrap(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.Bootstrap", req.dc_option())
+        return HTTPResponse(200, out.get("token"))
+
+    async def acl_token_set(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.TokenSet", {
+            "acl_token": _decamelize(req.json()), **req.dc_option(),
+        })
+        return HTTPResponse(200, out.get("token"))
+
+    async def acl_token_list(self, req, m) -> HTTPResponse:
+        body = dict(req.query_options())
+        out = await self.agent.rpc("ACL.TokenList", body)
+        return HTTPResponse(200, out.get("tokens", []),
+                            headers=_meta_headers(out.get("meta")))
+
+    async def acl_token_read(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.TokenRead", {
+            "secret_id": m.group("sid"), **req.query_options(),
+        })
+        if out.get("token") is None:
+            return HTTPResponse(404, {"error": "token not found"})
+        return HTTPResponse(200, out["token"])
+
+    async def acl_token_delete(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.TokenDelete", {
+            "secret_id": m.group("sid"), **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result", True)))
+
+    async def acl_policy_set(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.PolicySet", {
+            "policy": _decamelize(req.json()), **req.dc_option(),
+        })
+        return HTTPResponse(200, out.get("policy"))
+
+    async def acl_policy_list(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.PolicyList", dict(req.query_options()))
+        return HTTPResponse(200, out.get("policies", []),
+                            headers=_meta_headers(out.get("meta")))
+
+    async def acl_policy_read(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.PolicyRead", {
+            "id": m.group("pid"), **req.query_options(),
+        })
+        if out.get("policy") is None:
+            return HTTPResponse(404, {"error": "policy not found"})
+        return HTTPResponse(200, out["policy"])
+
+    async def acl_policy_delete(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.PolicyDelete", {
+            "id": m.group("pid"), **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result", True)))
 
     # -- operator ------------------------------------------------------------
 

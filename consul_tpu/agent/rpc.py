@@ -60,6 +60,8 @@ class RPCError(Exception):
 
 
 ERR_NO_LEADER = "No cluster leader"  # structs.ErrNoLeader
+ERR_PERMISSION_DENIED = "Permission denied"  # acl.ErrPermissionDenied
+ERR_ACL_NOT_FOUND = "ACL not found"  # acl.ErrNotFound
 
 
 def _pack(obj: Any) -> bytes:
@@ -293,6 +295,14 @@ class RPCServer:
                         t.cancel()
                     else:
                         cancelled_seqs.add(seq)
+                        # Seqs are monotonic per connection: entries far
+                        # behind the current seq belong to streams that
+                        # already finished — drop them so a cancel that
+                        # raced a normal completion can't accumulate.
+                        if len(cancelled_seqs) > 64:
+                            cancelled_seqs.intersection_update(
+                                s for s in cancelled_seqs if s > seq - 512
+                            )
                     continue
 
                 async def handle(req=req):

@@ -37,6 +37,7 @@ from consul_tpu.agent import endpoints as eps
 from consul_tpu.agent.fsm import ConsulFSM, MessageType
 from consul_tpu.agent.rpc import (
     ERR_NO_LEADER,
+    ERR_PERMISSION_DENIED,
     RPC_RAFT,
     RPCClient,
     RPCError,
@@ -89,6 +90,11 @@ class ServerConfig:
     # LAN->WAN flooder cadence (agent/consul/flood.go loop).
     wan_profile: GossipProfile = WAN
     flood_interval_s: float = 1.0
+    # ACL system (agent/config: acl.enabled / default_policy / tokens.master).
+    acl_enabled: bool = False
+    acl_default_policy: str = "allow"   # "allow" | "deny"
+    acl_master_token: str = ""
+    acl_token_ttl_s: float = 30.0
 
 
 class Server:
@@ -109,6 +115,19 @@ class Server:
         self.publisher = EventPublisher()
         self.fsm = ConsulFSM(publisher=self.publisher)
         self.store = self.fsm.store
+
+        # ACL resolution against the replicated token/policy tables
+        # (agent/consul/acl.go ACLResolver; cache TTL = ACLTokenTTL).
+        from consul_tpu.acl import ACLResolver
+
+        self.acl = ACLResolver(
+            token_lookup=self.store.acl_token_get,
+            policy_lookup=self.store.acl_policy_get,
+            enabled=config.acl_enabled,
+            default_policy=config.acl_default_policy,
+            master_token=config.acl_master_token,
+            ttl_s=config.acl_token_ttl_s,
+        )
 
         # RPC plane (port 8300 analogue; serf rides gossip_transport).
         self.rpc_transport = rpc_transport
@@ -365,6 +384,29 @@ class Server:
     # ------------------------------------------------------------------
     # RPC helpers used by endpoints
     # ------------------------------------------------------------------
+
+    def acl_resolve(self, body: dict):
+        """Token from QueryOptions → Authorizer; unknown tokens surface
+        as an RPC error (consul/acl.go ResolveToken)."""
+        from consul_tpu.acl.engine import ACLError
+
+        try:
+            return self.acl.resolve(body.get("token", "") or "")
+        except ACLError as e:
+            raise RPCError(str(e)) from e
+
+    def acl_check(self, body: dict, kind: str, name: str, want: str) -> None:
+        """Enforce one resource permission; raises the reference's
+        'Permission denied' (acl.ErrPermissionDenied) on failure.
+        Requests bound for another DC are enforced THERE — token tables
+        are per-datacenter (the reference replicates them; we don't)."""
+        if not self.acl.enabled:
+            return
+        dc = body.get("dc")
+        if dc and dc != self.config.datacenter:
+            return
+        if not self.acl_resolve(body).allowed(kind, name, want):
+            raise RPCError(ERR_PERMISSION_DENIED)
 
     def leader_rpc_addr(self) -> Optional[str]:
         if self.raft is None or self.raft.leader_id is None:

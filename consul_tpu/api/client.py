@@ -55,8 +55,9 @@ class QueryOptions:
 class ConsulClient:
     """api.Client: one agent HTTP address, namespaced accessors."""
 
-    def __init__(self, addr: str = "127.0.0.1:8500"):
+    def __init__(self, addr: str = "127.0.0.1:8500", token: str = ""):
         self.addr = addr.removeprefix("http://")
+        self.token = token  # api.Config.Token -> X-Consul-Token header
         self.kv = KV(self)
         self.catalog = Catalog(self)
         self.health = Health(self)
@@ -69,6 +70,7 @@ class ConsulClient:
         self.coordinate = Coordinate(self)
         self.txn = Txn(self)
         self.config = ConfigAPI(self)
+        self.acl = ACLAPI(self)
 
     # -- raw request -----------------------------------------------------
 
@@ -89,9 +91,13 @@ class ConsulClient:
         host, port = self.addr.rsplit(":", 1)
         reader, writer = await asyncio.open_connection(host, int(port))
         try:
+            token_hdr = (
+                f"X-Consul-Token: {self.token}\r\n" if self.token else ""
+            )
             head = (
                 f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
-                f"Content-Length: {len(payload)}\r\nConnection: close\r\n\r\n"
+                f"Content-Length: {len(payload)}\r\n{token_hdr}"
+                f"Connection: close\r\n\r\n"
             )
             writer.write(head.encode() + payload)
             await writer.drain()
@@ -433,3 +439,30 @@ def _b64(obj):
     if isinstance(obj, bytes):
         return base64.b64encode(obj).decode()
     raise TypeError(type(obj))
+
+
+class ACLAPI(_NS):
+    """api/acl.go: token/policy CRUD + bootstrap."""
+
+    async def bootstrap(self) -> dict:
+        return await self.c.write("PUT", "/v1/acl/bootstrap")
+
+    async def token_create(self, token: dict) -> dict:
+        return await self.c.write("PUT", "/v1/acl/token", body=token)
+
+    async def token_list(self) -> list:
+        data, _ = await self.c.read("/v1/acl/tokens")
+        return data or []
+
+    async def token_delete(self, secret_id: str):
+        return await self.c.write("DELETE", f"/v1/acl/token/{secret_id}")
+
+    async def policy_create(self, policy: dict) -> dict:
+        return await self.c.write("PUT", "/v1/acl/policy", body=policy)
+
+    async def policy_list(self) -> list:
+        data, _ = await self.c.read("/v1/acl/policies")
+        return data or []
+
+    async def policy_delete(self, pid: str):
+        return await self.c.write("DELETE", f"/v1/acl/policy/{pid}")

@@ -117,6 +117,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("shell_command")
 
     # ops ------------------------------------------------------------------
+    sp = cmd("acl", cmd_acl, "ACL token and policy management")
+    sp.add_argument("subsystem", choices=["bootstrap", "token", "policy"])
+    sp.add_argument("verb", nargs="?", default="list",
+                    choices=["list", "create", "delete"])
+    sp.add_argument("arg", nargs="?", default="",
+                    help="JSON definition, id, or secret")
+    sp.add_argument("-token", default="")
+
     sp = cmd("operator", cmd_operator, "cluster operator tools")
     sp.add_argument("subsystem", choices=["raft"])
     sp.add_argument("action", choices=["list-peers"])
@@ -202,7 +210,7 @@ async def cmd_agent(args) -> int:
 
 
 def _client(args) -> ConsulClient:
-    return ConsulClient(args.http_addr)
+    return ConsulClient(args.http_addr, token=getattr(args, "token", ""))
 
 
 async def cmd_members(args) -> int:
@@ -386,6 +394,41 @@ async def _renew_loop(c: ConsulClient, sid: str) -> None:
     while True:
         await asyncio.sleep(5)
         await c.session.renew(sid)
+
+
+async def cmd_acl(args) -> int:
+    """command/acl: bootstrap, token list/create/delete, policy ..."""
+    c = _client(args)
+    if args.subsystem == "bootstrap":
+        tok = await c.acl.bootstrap()
+        print(f"SecretID: {tok['SecretID']}")
+        return 0
+    import json as _json
+
+    if args.subsystem == "token":
+        if args.verb == "list":
+            for t in await c.acl.token_list():
+                print(f"{t.get('SecretID', '')}\t{t.get('Type', '')}\t"
+                      f"{t.get('Description', '')}")
+        elif args.verb == "create":
+            tok = await c.acl.token_create(
+                _json.loads(args.arg) if args.arg else {}
+            )
+            print(f"SecretID: {tok['SecretID']}")
+        else:
+            await c.acl.token_delete(args.arg)
+            print("deleted")
+        return 0
+    if args.verb == "list":
+        for pl in await c.acl.policy_list():
+            print(f"{pl.get('ID', '')}\t{pl.get('Name', '')}")
+    elif args.verb == "create":
+        pl = await c.acl.policy_create(_json.loads(args.arg))
+        print(f"ID: {pl['ID']}")
+    else:
+        await c.acl.policy_delete(args.arg)
+        print("deleted")
+    return 0
 
 
 async def cmd_operator(args) -> int:

@@ -1,0 +1,247 @@
+"""ACL system end-to-end: policy precedence, token resolution, RPC/HTTP
+enforcement (403s), bootstrap, and list filtering.
+
+Parity model: acl/policy_test.go + acl/acl_test.go (precedence),
+agent/consul/acl_endpoint_test.go (bootstrap one-shot),
+agent/http_test.go (parseToken, 403 mapping).
+"""
+
+import asyncio
+import contextlib
+import json
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.acl.engine import (
+    ACLError,
+    ACLResolver,
+    Authorizer,
+    DENY,
+    READ,
+    WRITE,
+    parse_policy,
+)
+from consul_tpu.agent.agent import Agent, AgentConfig
+from consul_tpu.agent.http import HTTPApi
+from consul_tpu.net.transport import InMemoryNetwork
+
+from test_http_dns import http_call
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+# ---------------------------------------------------------------------------
+# engine precedence (acl/policy.go + acl.go)
+# ---------------------------------------------------------------------------
+
+
+def test_longest_prefix_wins_and_exact_beats_prefix():
+    p = parse_policy({
+        "key_prefix": {"": {"policy": "deny"},
+                       "app/": {"policy": "read"}},
+        "key": {"app/rw": {"policy": "write"}},
+    })
+    a = Authorizer([p])
+    assert not a.key_read("other")          # "" prefix deny
+    assert a.key_read("app/x")              # app/ read
+    assert not a.key_write("app/x")
+    assert a.key_write("app/rw")            # exact write beats app/ read
+
+
+def test_merged_policies_deny_wins_on_tie():
+    p1 = parse_policy({"key_prefix": {"a/": {"policy": "write"}}})
+    p2 = parse_policy({"key_prefix": {"a/": {"policy": "deny"}}})
+    a = Authorizer([p1, p2])
+    assert not a.key_read("a/x")
+
+
+def test_resolver_unknown_token_and_cache():
+    tokens = {"s1": {"secret_id": "s1", "policies": ["p1"]}}
+    policies = {"p1": {"id": "p1", "rules": json.dumps(
+        {"key_prefix": {"": {"policy": "read"}}}
+    )}}
+    r = ACLResolver(tokens.get, policies.get, enabled=True,
+                    default_policy="deny", ttl_s=60)
+    with pytest.raises(ACLError):
+        r.resolve("nope")
+    a = r.resolve("s1")
+    assert a.key_read("anything") and not a.key_write("anything")
+    # Anonymous under default deny.
+    assert not r.resolve("").key_read("x")
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end
+# ---------------------------------------------------------------------------
+
+MASTER = "root-token"
+
+
+@contextlib.asynccontextmanager
+async def acl_stack(default_policy="deny", master=MASTER):
+    net = InMemoryNetwork()
+    agent = Agent(
+        AgentConfig(node_name="dev", bootstrap_expect=1,
+                    gossip_interval_scale=0.05, sync_interval_s=0.3,
+                    sync_retry_interval_s=0.2, reconcile_interval_s=0.2,
+                    acl_enabled=True, acl_default_policy=default_policy,
+                    acl_master_token=master, acl_agent_token=master),
+        gossip_transport=net.new_transport("dev:gossip"),
+        rpc_transport=net.new_transport("dev:rpc"),
+    )
+    await agent.start()
+    await wait_until(lambda: agent.delegate.is_leader(), msg="leader")
+    api = HTTPApi(agent)
+    addr = await api.start()
+    try:
+        yield agent, addr
+    finally:
+        await api.stop()
+        await agent.shutdown()
+
+
+class TestHTTPEnforcement:
+    async def test_anonymous_denied_master_allowed(self):
+        async with acl_stack() as (_agent, addr):
+            st, _, body = await http_call(addr, "PUT", "/v1/kv/app/x", b"v")
+            assert st == 403, body
+            st, _, _b = await http_call(addr, "GET", "/v1/kv/app/x")
+            assert st == 403
+            st, _, ok = await http_call(
+                addr, "PUT", f"/v1/kv/app/x?token={MASTER}", b"v"
+            )
+            assert st == 200 and ok is True
+            st, _, rows = await http_call(
+                addr, "GET", "/v1/kv/app/x",
+                headers={"X-Consul-Token": MASTER},
+            )
+            assert st == 200 and rows
+
+    async def test_policy_token_read_write_deny_precedence(self):
+        async with acl_stack() as (_agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            # Policy: read under app/, write on the exact app/rw,
+            # deny under app/secret/.
+            rules = json.dumps({
+                "key_prefix": {"app/": {"policy": "read"},
+                               "app/secret/": {"policy": "deny"}},
+                "key": {"app/rw": {"policy": "write"}},
+            })
+            st, _, pol = await http_call(
+                addr, "PUT", "/v1/acl/policy",
+                json.dumps({"Name": "app", "Rules": rules}).encode(),
+                headers=mk,
+            )
+            assert st == 200, pol
+            st, _, tok = await http_call(
+                addr, "PUT", "/v1/acl/token",
+                json.dumps({"Policies": [pol["ID"]]}).encode(),
+                headers=mk,
+            )
+            assert st == 200, tok
+            secret = tok["SecretID"]
+            hdr = {"X-Consul-Token": secret}
+
+            # Seed data as master.
+            for k in ("app/a", "app/secret/s", "outside"):
+                st, _, _x = await http_call(
+                    addr, "PUT", f"/v1/kv/{k}?token={MASTER}", b"v")
+                assert st == 200
+
+            # read allowed under app/
+            st, _, rows = await http_call(addr, "GET", "/v1/kv/app/a",
+                                          headers=hdr)
+            assert st == 200 and rows
+            # write denied under app/ (read-only)
+            st, _, _x = await http_call(addr, "PUT", "/v1/kv/app/a", b"w",
+                                        headers=hdr)
+            assert st == 403
+            # exact write rule allows the write
+            st, _, ok = await http_call(addr, "PUT", "/v1/kv/app/rw", b"w",
+                                        headers=hdr)
+            assert st == 200 and ok is True
+            # deny rule beats the read prefix
+            st, _, _x = await http_call(addr, "GET", "/v1/kv/app/secret/s",
+                                        headers=hdr)
+            assert st == 403
+            # outside any rule: default deny
+            st, _, _x = await http_call(addr, "GET", "/v1/kv/outside",
+                                        headers=hdr)
+            assert st == 403
+
+            # Recursive list is FILTERED, not denied (consul/filter.go):
+            # app/secret/s drops out, app/a and app/rw remain.
+            st, _, rows = await http_call(addr, "GET", "/v1/kv/app?recurse",
+                                          headers=hdr)
+            assert st == 200
+            keys = {r["Key"] for r in rows}
+            assert keys == {"app/a", "app/rw"}
+
+    async def test_service_catalog_enforcement(self):
+        async with acl_stack() as (_agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/catalog/register",
+                json.dumps({"Node": "n1", "Address": "10.0.0.1",
+                            "Service": {"Service": "web", "Port": 80}}
+                           ).encode(),
+            )
+            assert st == 403
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/catalog/register",
+                json.dumps({"Node": "n1", "Address": "10.0.0.1",
+                            "Service": {"Service": "web", "Port": 80}}
+                           ).encode(),
+                headers=mk,
+            )
+            assert st == 200
+            st, _, _x = await http_call(addr, "GET",
+                                        "/v1/health/service/web")
+            assert st == 403
+            st, _, rows = await http_call(addr, "GET",
+                                          "/v1/health/service/web",
+                                          headers=mk)
+            assert st == 200 and rows
+
+    async def test_token_secrets_redacted_without_acl_write(self):
+        async with acl_stack() as (_agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            rules = json.dumps({"acl": "read"})
+            st, _, pol = await http_call(
+                addr, "PUT", "/v1/acl/policy",
+                json.dumps({"Name": "aclread", "Rules": rules}).encode(),
+                headers=mk,
+            )
+            assert st == 200
+            st, _, tok = await http_call(
+                addr, "PUT", "/v1/acl/token",
+                json.dumps({"Policies": [pol["ID"]]}).encode(),
+                headers=mk,
+            )
+            assert st == 200
+            st, _, tokens = await http_call(
+                addr, "GET", "/v1/acl/tokens",
+                headers={"X-Consul-Token": tok["SecretID"]},
+            )
+            assert st == 200
+            assert all(t["SecretID"] == "<hidden>" for t in tokens)
+
+
+class TestBootstrap:
+    async def test_bootstrap_once(self):
+        async with acl_stack(master="") as (_agent, addr):
+            st, _, tok = await http_call(addr, "PUT", "/v1/acl/bootstrap")
+            assert st == 200 and tok["Type"] == "management"
+            secret = tok["SecretID"]
+            # The bootstrap token is a working management token.
+            st, _, ok = await http_call(
+                addr, "PUT", f"/v1/kv/x?token={secret}", b"v")
+            assert st == 200 and ok is True
+            # Second bootstrap is refused.
+            st, _, err = await http_call(addr, "PUT", "/v1/acl/bootstrap")
+            assert st == 400
+            assert "no longer allowed" in str(err)
