@@ -136,6 +136,16 @@ class Agent:
         # the agent token too).
         self.cache = AgentCache(rpc=self._agent_rpc)
         self.checks: dict[str, CheckRunner] = {}
+        # DNS behavior knobs (dns_config block); DNSServer reads these
+        # live, so reload changes DNS behavior without a restart.
+        self.dns_only_passing = True
+        self.dns_node_ttl_s = 0.0
+        # Config-file-sourced definitions (loadServices/loadChecks),
+        # swapped wholesale on reload.
+        self._config_services: list[dict] = []
+        self._config_checks: list[dict] = []
+        self._config_service_ids: set[str] = set()
+        self._config_check_ids: set[str] = set()
         self.events: list[UserEvent] = []  # dedup ring, newest last
         self.event_index = 0  # monotonic, the X-Consul-Index for /event/list
         self._event_seen: set[tuple[int, str]] = set()
@@ -200,6 +210,63 @@ class Agent:
         for runner in self.checks.values():
             runner.stop()
         await self.delegate.shutdown()
+
+    # ------------------------------------------------------------------
+    # config-sourced definitions + reload (agent.go loadServices /
+    # loadChecks / reloadConfigInternal)
+    # ------------------------------------------------------------------
+
+    def load_definitions(self, services: list[dict],
+                         checks: list[dict]) -> None:
+        """(Re)apply config-file service/check definitions: definitions
+        no longer present are deregistered, the rest re-registered —
+        the reload path changes checks without an agent restart."""
+        self._config_services = [dict(s) for s in services]
+        self._config_checks = [dict(c) for c in checks]
+        new_svc_ids = set()
+        for svc in services:
+            svc = dict(svc)
+            svc.setdefault("service", svc.pop("name", ""))
+            sid = svc.get("id") or svc["service"]
+            svc["id"] = sid
+            new_svc_ids.add(sid)
+            svc_checks = [dict(c) for c in svc.pop("checks", [])]
+            self.add_service(svc, svc_checks)
+        new_check_ids = set()
+        for chk in checks:
+            chk = dict(chk)
+            cid = chk.get("check_id") or chk.get("id") or chk.get("name", "")
+            chk["check_id"] = cid  # add_check registers under check_id
+            new_check_ids.add(cid)
+            self.add_check(chk)
+        for sid in self._config_service_ids - new_svc_ids:
+            self.remove_service(sid)
+        for cid in self._config_check_ids - new_check_ids:
+            self.remove_check(cid)
+        self._config_service_ids = new_svc_ids
+        self._config_check_ids = new_check_ids
+
+    def reload(self, apply: dict) -> None:
+        """Apply a reloadable-config diff (see config.reloadable_diff):
+        service/check definitions swap in place (a field absent from the
+        diff keeps its current definitions); scalar knobs update."""
+        from consul_tpu.agent.config import thaw
+
+        if "services" in apply or "checks" in apply:
+            services = (
+                [thaw(s) for s in apply["services"]]
+                if "services" in apply
+                else self._config_services
+            )
+            checks = (
+                [thaw(c) for c in apply["checks"]]
+                if "checks" in apply
+                else self._config_checks
+            )
+            self.load_definitions(services, checks)
+        for knob in ("dns_only_passing", "dns_node_ttl_s"):
+            if knob in apply:
+                setattr(self, knob, apply[knob])
 
     # ------------------------------------------------------------------
     # service & check registration (agent.go AddService/AddCheck)

@@ -224,16 +224,23 @@ class DNSServer:
     """agent/dns.go DNSServer: dispatch on the .consul name space."""
 
     def __init__(self, agent: Agent, domain: str = "consul",
-                 node_ttl: int = 0, only_passing: bool = True,
                  seed: int = 0):
         self.agent = agent
         self.domain = domain.strip(".").lower()
-        self.node_ttl = node_ttl
-        self.only_passing = only_passing
         self._rng = random.Random(seed)
         self._udp: Optional[asyncio.DatagramTransport] = None
         self._inflight: set[asyncio.Task] = set()
         self.addr = ""
+
+    # DNS behavior follows the agent's live config knobs (dns_config
+    # block; reloadable without restart — agent.go reloadConfigInternal).
+    @property
+    def node_ttl(self) -> int:
+        return int(getattr(self.agent, "dns_node_ttl_s", 0.0))
+
+    @property
+    def only_passing(self) -> bool:
+        return bool(getattr(self.agent, "dns_only_passing", True))
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         loop = asyncio.get_running_loop()

@@ -277,15 +277,11 @@ class KVS(_Endpoint):
     def _filter_keys(self, body: dict, out: dict, field: str, key_of):
         """filterACL on list results: entries the token cannot read are
         dropped, not denied (consul/filter.go FilterKeys)."""
-        if not self.server.acl.enabled or field not in out:
-            return out
-        dc = body.get("dc")
-        if dc and dc != self.server.config.datacenter:
-            return out
-        authz = self.server.acl_resolve(body)
-        out[field] = [
-            item for item in out[field] if authz.key_read(key_of(item))
-        ]
+        authz = self._authz(body)
+        if authz is not None and field in out:
+            out[field] = [
+                item for item in out[field] if authz.key_read(key_of(item))
+            ]
         return out
 
 
@@ -314,21 +310,35 @@ class Session(_Endpoint):
             idx, rec = self.server.store.session_get(body["id"], ws=ws)
             return idx, {"sessions": [rec] if rec else []}
 
-        return await self._read("Session.Get", body, run)
+        out = await self._read("Session.Get", body, run)
+        return self._filter_sessions(body, out)
 
     async def list(self, body: dict):
-        return await self._read(
+        out = await self._read(
             "Session.List", body,
             lambda ws: _wrap(self.server.store.session_list(ws=ws), "sessions"),
         )
+        return self._filter_sessions(body, out)
 
     async def node_sessions(self, body: dict):
-        return await self._read(
+        out = await self._read(
             "Session.NodeSessions", body,
             lambda ws: _wrap(
                 self.server.store.node_sessions(body["node"], ws=ws), "sessions"
             ),
         )
+        return self._filter_sessions(body, out)
+
+    def _filter_sessions(self, body: dict, out: dict) -> dict:
+        """filterACL session:read per session's node (consul/filter.go
+        FilterSessions): unreadable sessions drop out of lists."""
+        authz = self._authz(body)
+        if authz is not None and "sessions" in out:
+            out["sessions"] = [
+                s for s in out["sessions"]
+                if authz.session_read(s.get("node", ""))
+            ]
+        return out
 
     async def renew(self, body: dict):
         fwd = await self.server.forward("Session.Renew", body)
@@ -353,6 +363,8 @@ class Coordinate(_Endpoint):
     flushed as one raft entry per CoordinateUpdatePeriod."""
 
     async def update(self, body: dict):
+        # coordinate_endpoint.go Update: node write on the subject node.
+        self.server.acl_check(body, "node", body.get("node", ""), WRITE)
         fwd = await self.server.forward("Coordinate.Update", body)
         if fwd is not None:
             return fwd
@@ -381,7 +393,23 @@ class Coordinate(_Endpoint):
 class Txn(_Endpoint):
     """txn_endpoint.go — read-only op sets skip raft (Txn.Read)."""
 
+    def _check_txn_acls(self, body: dict, write: bool) -> None:
+        """txn_endpoint.go Apply/Read vet each op's key against the
+        token (the single-op KV enforcement must not be bypassable
+        through /v1/txn)."""
+        for op in body.get("ops") or []:
+            kv = op.get("kv") if isinstance(op, dict) else None
+            if not kv:
+                continue
+            key = (kv.get("entry") or {}).get("key", "")
+            verb = kv.get("verb", "")
+            want = READ if (not write or verb in ("get", "get-tree",
+                                                  "check-index",
+                                                  "check-session")) else WRITE
+            self.server.acl_check(body, "key", key, want)
+
     async def apply(self, body: dict):
+        self._check_txn_acls(body, write=True)
         fwd = await self.server.forward("Txn.Apply", body)
         if fwd is not None:
             return fwd
@@ -410,6 +438,7 @@ class Txn(_Endpoint):
         }
 
     async def read(self, body: dict):
+        self._check_txn_acls(body, write=False)
         fwd = await self.server.forward("Txn.Read", body, read=True)
         if fwd is not None:
             return fwd

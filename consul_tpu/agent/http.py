@@ -706,6 +706,15 @@ class HTTPApi:
     # -- events -----------------------------------------------------------
 
     async def event_fire(self, req, m) -> HTTPResponse:
+        # event_endpoint.go Fire: event write on the name.  Enforced on
+        # server agents (which hold the resolver); client agents defer
+        # to the serf plane (deviation: the reference resolves through
+        # its servers from clients too).
+        delegate = self.agent.delegate
+        if hasattr(delegate, "acl_check"):
+            delegate.acl_check(
+                {"token": req.token()}, "event", m.group("name"), "write"
+            )
         eid = await self.agent.fire_event(m.group("name"), req.body)
         return HTTPResponse(200, {"id": eid, "name": m.group("name")})
 

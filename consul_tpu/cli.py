@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
         sp = sub.add_parser(name, help=help)
         sp.set_defaults(fn=fn)
         sp.add_argument("-http-addr", default=DEFAULT_HTTP)
+        sp.add_argument("-token", default="",
+                        help="ACL token (or X-Consul-Token equivalent)")
         return sp
 
     # agent ---------------------------------------------------------------
@@ -62,14 +64,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="single-server dev mode")
     sp.add_argument("-server", action="store_true")
     sp.add_argument("-node", default="")
-    sp.add_argument("-datacenter", default="dc1")
-    sp.add_argument("-bootstrap-expect", type=int, default=1)
+    sp.add_argument("-datacenter", default=None)
+    sp.add_argument("-bootstrap-expect", type=int, default=None)
     sp.add_argument("-join", action="append", default=[])
-    sp.add_argument("-bind", default="127.0.0.1")
+    sp.add_argument("-bind", default=None)
     sp.add_argument("-serf-port", type=int, default=0)
     sp.add_argument("-rpc-port", type=int, default=0)
-    sp.add_argument("-http-port", type=int, default=8500)
-    sp.add_argument("-dns-port", type=int, default=8600)
+    sp.add_argument("-http-port", type=int, default=None)
+    sp.add_argument("-dns-port", type=int, default=None)
+    sp.add_argument("-config-file", action="append", default=[],
+                    dest="config_file", help="JSON/HCL config file")
+    sp.add_argument("-config-dir", action="append", default=[],
+                    dest="config_dir")
 
     # cluster membership --------------------------------------------------
     cmd("members", cmd_members, "list gossip pool members")
@@ -123,7 +129,6 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["list", "create", "delete"])
     sp.add_argument("arg", nargs="?", default="",
                     help="JSON definition, id, or secret")
-    sp.add_argument("-token", default="")
 
     sp = cmd("operator", cmd_operator, "cluster operator tools")
     sp.add_argument("subsystem", choices=["raft"])
@@ -144,38 +149,96 @@ def build_parser() -> argparse.ArgumentParser:
 # ---------------------------------------------------------------------------
 
 
+def build_runtime(args):
+    """Files + flags → RuntimeConfig (the CLI half of config/builder.go:
+    -config-file/-config-dir in order, flags last)."""
+    from consul_tpu.agent.config import Builder
+
+    b = Builder()
+    for path in args.config_file:
+        b.add_file(path)
+    for path in args.config_dir:
+        b.add_dir(path)
+    flags = {
+        "node_name": args.node or None,
+        "datacenter": args.datacenter,
+        "server": True if (args.server or args.dev) else None,
+        "bootstrap_expect": 1 if args.dev else args.bootstrap_expect,
+        "bind_addr": args.bind,
+        "ports_http": args.http_port,
+        "ports_dns": args.dns_port,
+    }
+    b.add_flags(flags)
+    rc = b.build()
+    if not args.node and rc.node_name == "node" and args.dev:
+        rc = __import__("dataclasses").replace(rc, node_name="dev")
+    return rc
+
+
 async def cmd_agent(args) -> int:
     from consul_tpu.agent import Agent, AgentConfig
+    from consul_tpu.agent.config import reloadable_diff, thaw
     from consul_tpu.agent.dns import DNSServer
     from consul_tpu.agent.http import HTTPApi
     from consul_tpu.net.transport import UDPTransport
 
-    node = args.node or ("dev" if args.dev else "node")
-    server_mode = args.server or args.dev
+    rc = build_runtime(args)
+    node = rc.node_name
+    server_mode = rc.server
 
-    gossip = UDPTransport(args.bind, args.serf_port)
-    rpc = UDPTransport(args.bind, args.rpc_port)
+    gossip = UDPTransport(rc.bind_addr, args.serf_port)
+    rpc = UDPTransport(rc.bind_addr, args.rpc_port)
     await gossip.start()
     await rpc.start()
     agent = Agent(
         AgentConfig(
             node_name=node,
-            datacenter=args.datacenter,
+            datacenter=rc.datacenter,
             server=server_mode,
-            bootstrap_expect=1 if args.dev else args.bootstrap_expect,
+            bootstrap_expect=rc.bootstrap_expect,
+            profile=rc.gossip_profile(),
+            gossip_interval_scale=rc.gossip_interval_scale,
+            acl_enabled=rc.acl_enabled,
+            acl_default_policy=rc.acl_default_policy,
+            acl_master_token=rc.acl_master_token,
+            acl_agent_token=rc.acl_agent_token,
         ),
         gossip_transport=gossip,
         rpc_transport=rpc,
     )
     await agent.start()
+    agent.load_definitions(
+        [thaw(s) for s in rc.services], [thaw(c) for c in rc.checks]
+    )
+    agent.dns_only_passing = rc.dns_only_passing
+    agent.dns_node_ttl_s = rc.dns_node_ttl_s
     api = HTTPApi(agent)
-    http_addr = await api.start(args.bind, args.http_port)
+    http_addr = await api.start(rc.bind_addr, rc.ports_http)
     dns = DNSServer(agent)
-    dns_addr = await dns.start(args.bind, args.dns_port)
+    dns_addr = await dns.start(rc.bind_addr, rc.ports_dns)
+
+    # SIGHUP: re-read the same sources, apply the reloadable subset
+    # (agent.go reloadConfigInternal).
+    def on_hup():
+        nonlocal rc
+        try:
+            new_rc = build_runtime(args)
+            apply = reloadable_diff(rc, new_rc)
+            agent.reload(apply)
+            rc = new_rc
+            print(f"==> Reloaded configuration ({len(apply)} change(s))")
+        except Exception as e:  # noqa: BLE001 - keep running on bad config
+            print(f"==> Reload failed: {e}", file=sys.stderr)
+        sys.stdout.flush()
+
+    try:
+        asyncio.get_running_loop().add_signal_handler(signal.SIGHUP, on_hup)
+    except (NotImplementedError, AttributeError):  # pragma: no cover
+        pass
 
     print("==> consul-tpu agent running!")
     print(f"         Node name: {node}")
-    print(f"        Datacenter: {args.datacenter}")
+    print(f"        Datacenter: {rc.datacenter}")
     print(f"            Server: {server_mode}")
     print(f"         HTTP addr: {http_addr}")
     print(f"          DNS addr: {dns_addr} (udp)")
@@ -183,8 +246,9 @@ async def cmd_agent(args) -> int:
     print(f"          RPC addr: {rpc.local_addr()}")
     sys.stdout.flush()
 
-    if args.join:
-        n = await agent.join(args.join)
+    join_addrs = list(args.join) + [str(a) for a in rc.retry_join]
+    if join_addrs:
+        n = await agent.join(join_addrs)
         print(f"==> Joined {n} node(s)")
         sys.stdout.flush()
 
