@@ -61,6 +61,9 @@ class AgentConfig:
     # Token the agent itself uses for anti-entropy catalog writes
     # (agent/config acl.tokens.agent).
     acl_agent_token: str = ""
+    # Serf gossip snapshot + auto-rejoin (serf/snapshot.go).
+    serf_snapshot_path: str = ""
+    rejoin_after_leave: bool = False
 
 
 @dataclasses.dataclass
@@ -98,6 +101,8 @@ class Agent:
                     acl_enabled=config.acl_enabled,
                     acl_default_policy=config.acl_default_policy,
                     acl_master_token=config.acl_master_token,
+                    serf_snapshot_path=config.serf_snapshot_path,
+                    rejoin_after_leave=config.rejoin_after_leave,
                 ),
                 gossip_transport,
                 rpc_transport,

@@ -60,6 +60,10 @@ class RuntimeConfig:
     datacenter: str = "dc1"
     server: bool = False
     bootstrap_expect: int = 1
+    # Persistence root: the serf gossip snapshot lives at
+    # <data_dir>/serf/local.snapshot (config "data_dir").
+    data_dir: str = ""
+    rejoin_after_leave: bool = False
     bind_addr: str = "127.0.0.1"
     ports_http: int = 8500
     ports_dns: int = 8600

@@ -741,6 +741,45 @@ class ACL(_Endpoint):
         return {"policy": rec}
 
 
+class Snapshot(_Endpoint):
+    """snapshot_endpoint.go: atomic save/restore of the full state.
+    The reference gates both on management tokens; approximated here as
+    operator read (save) / operator write (restore)."""
+
+    async def save(self, body: dict):
+        from consul_tpu.agent.snapshot import write_archive
+
+        self.server.acl_check(body, "operator", "", READ)
+        fwd = await self.server.forward("Snapshot.Save", body)
+        if fwd is not None:
+            return fwd
+        # Saved from the leader for a consistent, current view
+        # (snapshot_endpoint.go defaults to consistent mode).
+        raft = self.server.raft
+        index = raft.last_index() if raft else 0
+        term = raft.last_term() if raft else 0
+        blob = write_archive(
+            self.server.fsm.snapshot(), index, term, self.server.node_id
+        )
+        return {"archive": blob, "index": index}
+
+    async def restore(self, body: dict):
+        from consul_tpu.agent.snapshot import SnapshotError, read_archive
+
+        self.server.acl_check(body, "operator", "", WRITE)
+        fwd = await self.server.forward("Snapshot.Restore", body)
+        if fwd is not None:
+            return fwd
+        try:
+            state, meta = read_archive(body["archive"])
+        except SnapshotError as e:
+            raise ValueError(str(e)) from e
+        await self.server.raft_apply(
+            MessageType.SNAPSHOT_RESTORE, {"state": state}
+        )
+        return {"result": True, "meta": meta}
+
+
 class Subscribe(_Endpoint):
     """agent/rpc/subscribe/subscribe.go:45 — server-streaming change
     subscriptions: a snapshot of current state (closed by an
@@ -788,5 +827,6 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "Internal": Internal(server),
         "Operator": Operator(server),
         "ACL": ACL(server),
+        "Snapshot": Snapshot(server),
         "Subscribe": Subscribe(server),
     }

@@ -59,6 +59,10 @@ class MessageType(enum.IntEnum):
     ACL_POLICY_DELETE = 20
     CONFIG_ENTRY = 22
     FEDERATION_STATE = 27
+    # Not a reference command type: the reference installs user-snapshot
+    # restores through raft.Restore/InstallSnapshot; here the unpacked
+    # state rides one replicated log entry instead (agent/snapshot.py).
+    SNAPSHOT_RESTORE = 96
 
 
 class ConsulFSM(FSM):
@@ -93,6 +97,7 @@ class ConsulFSM(FSM):
             MessageType.PREPARED_QUERY: self._apply_prepared_query,
             MessageType.TXN: self._apply_txn,
             MessageType.AUTOPILOT: self._apply_autopilot,
+            MessageType.SNAPSHOT_RESTORE: self._apply_snapshot_restore,
             MessageType.ACL_TOKEN_SET: self._apply_acl_token_set,
             MessageType.ACL_TOKEN_DELETE: self._apply_acl_token_delete,
             MessageType.ACL_POLICY_SET: self._apply_acl_policy_set,
@@ -326,6 +331,12 @@ class ConsulFSM(FSM):
             if have != int(body.get("modify_index", 0)):
                 return False
         self.store.config_entry_set(idx, cfg)
+        return True
+
+    def _apply_snapshot_restore(self, idx: int, body: dict) -> Any:
+        """Install a user snapshot on every replica at the same log
+        position (snapshot_endpoint.go Restore -> raft.Restore)."""
+        self.restore(body["state"])
         return True
 
     def _apply_acl_token_set(self, idx: int, body: dict) -> Any:

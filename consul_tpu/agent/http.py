@@ -242,13 +242,17 @@ class HTTPApi:
             indent = 4 if req.flag("pretty") else None
             payload = (json.dumps(out, indent=indent) + "\n").encode()
             ctype = "application/json"
-        status_text = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                       405: "Method Not Allowed",
+        status_text = {200: "OK", 400: "Bad Request", 403: "Forbidden",
+                       404: "Not Found", 405: "Method Not Allowed",
                        500: "Internal Server Error"}.get(resp.status, "OK")
+        # A handler-supplied Content-Type overrides the default (single
+        # Content-Type per RFC 9110).
+        extra = dict(resp.headers)
+        ctype = extra.pop("Content-Type", ctype)
         head = [f"HTTP/1.1 {resp.status} {status_text}",
                 f"Content-Type: {ctype}",
                 f"Content-Length: {len(payload)}"]
-        for k, v in resp.headers.items():
+        for k, v in extra.items():
             head.append(f"{k}: {v}")
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
         await writer.drain()
@@ -358,6 +362,9 @@ class HTTPApi:
         # operator
         r("GET", r"/v1/operator/raft/configuration", self.operator_raft)
         r("GET", r"/v1/operator/autopilot/health", self.operator_health)
+        # snapshot (http_register.go /v1/snapshot)
+        r("GET", r"/v1/snapshot", self.snapshot_save)
+        r("PUT", r"/v1/snapshot", self.snapshot_restore)
         # acl (http_register.go /v1/acl/*)
         r("PUT", r"/v1/acl/bootstrap", self.acl_bootstrap)
         r("PUT", r"/v1/acl/token", self.acl_token_set)
@@ -857,6 +864,22 @@ class HTTPApi:
             **req.dc_option(),
         })
         return HTTPResponse(200, out.get("result", True))
+
+    # -- snapshot ------------------------------------------------------------
+
+    async def snapshot_save(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Snapshot.Save", dict(req.query_options()))
+        return HTTPResponse(
+            200, None, raw=out.get("archive", b""),
+            headers={"X-Consul-Index": str(out.get("index", 0)),
+                     "Content-Type": "application/x-gzip"},
+        )
+
+    async def snapshot_restore(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Snapshot.Restore", {
+            "archive": req.body, **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result", True)))
 
     # -- acl -----------------------------------------------------------------
 

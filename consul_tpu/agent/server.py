@@ -90,6 +90,15 @@ class ServerConfig:
     # LAN->WAN flooder cadence (agent/consul/flood.go loop).
     wan_profile: GossipProfile = WAN
     flood_interval_s: float = 1.0
+    # Serf gossip snapshot + auto-rejoin (serf/snapshot.go, RejoinAfterLeave).
+    serf_snapshot_path: str = ""
+    rejoin_after_leave: bool = False
+    # Autopilot (consul/autopilot/autopilot.go): dead raft servers are
+    # pruned once they have been failed for the grace window, never
+    # removing more than (voters-1)//2 so quorum is preserved.
+    autopilot_cleanup_dead_servers: bool = True
+    autopilot_interval_s: float = 10.0
+    autopilot_grace_s: float = 10.0
     # ACL system (agent/config: acl.enabled / default_policy / tokens.master).
     acl_enabled: bool = False
     acl_default_policy: str = "allow"   # "allow" | "deny"
@@ -158,6 +167,8 @@ class Server:
                 profile=config.profile,
                 interval_scale=config.gossip_interval_scale,
                 on_event=self._on_serf_event,
+                snapshot_path=config.serf_snapshot_path or None,
+                rejoin_after_leave=config.rejoin_after_leave,
             ),
             gossip_transport,
         )
@@ -209,6 +220,12 @@ class Server:
             await self.serf_wan.start()
             self._tasks.append(asyncio.create_task(self._flood_loop()))
         self._tasks.append(asyncio.create_task(self._serf_event_pump()))
+        # Snapshot auto-rejoin BEFORE bootstrap so a restarted server
+        # re-discovers the established cluster instead of re-expecting
+        # (serf/snapshot.go AliveNodes + server_serf.go RejoinAfterLeave).
+        rejoined = await self.serf.auto_rejoin()
+        if rejoined:
+            log.info("auto-rejoined %d node(s) from gossip snapshot", rejoined)
         await self._maybe_bootstrap()
 
     async def join(self, addrs: list[str]) -> int:
@@ -512,6 +529,7 @@ class Server:
                 asyncio.create_task(self._tombstone_gc_loop()),
                 asyncio.create_task(self._session_ttl_loop()),
                 asyncio.create_task(self._coordinate_flush_loop()),
+                asyncio.create_task(self._autopilot_loop()),
             ]
             self._reconcile_wake.set()
         else:
@@ -628,6 +646,38 @@ class Server:
         _, node = self.store.node(m.name)
         if node is not None:
             await self.raft_apply(MessageType.DEREGISTER, {"node": m.name})
+
+    async def _autopilot_loop(self) -> None:
+        """Autopilot CleanupDeadServers (autopilot.go:192 pruneDead
+        Servers): raft voters whose serf member has been FAILED past the
+        grace window are removed — but never more than (voters-1)//2 in
+        one pass, so a partition can't talk the leader into destroying
+        its own quorum (autopilot.go removalLimit)."""
+        if not self.config.autopilot_cleanup_dead_servers:
+            return
+        while not self._shutdown:
+            await asyncio.sleep(self.config.autopilot_interval_s)
+            try:
+                if self.raft is None or not self.raft.is_leader():
+                    continue
+                now = time.monotonic()
+                dead = []
+                for m in list(self.serf.members.values()):
+                    if (
+                        self._is_peer_server(m)
+                        and m.status == MemberStatus.FAILED
+                        and m.tags.get("id") in self.raft.voters
+                        and m.tags.get("id") != self.node_id
+                        and (m.leave_time or now) + self.config.autopilot_grace_s
+                        <= now
+                    ):
+                        dead.append(m.tags["id"])
+                limit = max((len(self.raft.voters) - 1) // 2, 0)
+                for node_id in dead[:limit]:
+                    log.info("autopilot: removing dead server %s", node_id)
+                    await self.raft.remove_server(node_id)
+            except Exception:
+                log.exception("autopilot loop failed")
 
     async def _tombstone_gc_loop(self) -> None:
         """Time-based tombstone reaping (leader.go:292 + tombstone GC):

@@ -1,0 +1,92 @@
+"""User-facing snapshot save/restore: atomic state archives.
+
+Equivalent of ``snapshot/snapshot.go`` + ``archive.go`` (SURVEY.md
+§2.3): a snapshot is a gzipped tar containing
+
+    meta.json    raft index/term + the saving node (archive.go writeMeta)
+    state.bin    msgpack of the FSM snapshot (the whole state store)
+    SHA256SUMS   manifest over the other two files, verified byte-for-
+                 byte on restore (archive.go checksums — a corrupted or
+                 tampered archive is rejected before any state changes)
+
+Restore is leader-driven and replicated: the unpacked state rides ONE
+raft entry (the Restore message), so every replica installs the same
+snapshot at the same log position — the in-process counterpart of the
+reference's raft.Restore + InstallSnapshot propagation
+(consul/snapshot_endpoint.go).
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+import time
+from typing import Any, Optional
+
+import msgpack
+
+
+class SnapshotError(Exception):
+    """Bad archive: corrupt, tampered, or incomplete."""
+
+
+def _tar_add(tar: tarfile.TarFile, name: str, data: bytes) -> None:
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = 0  # deterministic archives
+    tar.addfile(info, io.BytesIO(data))
+
+
+def write_archive(state: Any, index: int, term: int, node: str) -> bytes:
+    """Pack an FSM snapshot into the tar.gz + SHA256SUMS format."""
+    state_bin = msgpack.packb(state, use_bin_type=True)
+    meta = json.dumps(
+        {"index": index, "term": term, "node": node, "version": 1}
+    ).encode()
+    sums = "".join(
+        f"{hashlib.sha256(data).hexdigest()}  {name}\n"
+        for name, data in (("meta.json", meta), ("state.bin", state_bin))
+    ).encode()
+    buf = io.BytesIO()
+    # mtime=0: archives for identical state are byte-identical.
+    with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as gz:
+        with tarfile.open(fileobj=gz, mode="w") as tar:
+            _tar_add(tar, "meta.json", meta)
+            _tar_add(tar, "state.bin", state_bin)
+            _tar_add(tar, "SHA256SUMS", sums)
+    return buf.getvalue()
+
+
+def read_archive(blob: bytes) -> tuple[Any, dict]:
+    """Unpack + verify; returns (state, meta).  Raises SnapshotError on
+    any integrity failure (archive.go read + checksum verify)."""
+    try:
+        with gzip.GzipFile(fileobj=io.BytesIO(blob)) as gz:
+            with tarfile.open(fileobj=gz, mode="r") as tar:
+                files = {}
+                for member in tar.getmembers():
+                    fh = tar.extractfile(member)
+                    if fh is not None:
+                        files[member.name] = fh.read()
+    except (OSError, tarfile.TarError, EOFError) as e:
+        raise SnapshotError(f"unreadable archive: {e}") from e
+    for required in ("meta.json", "state.bin", "SHA256SUMS"):
+        if required not in files:
+            raise SnapshotError(f"archive missing {required}")
+    expected: dict[str, str] = {}
+    for line in files["SHA256SUMS"].decode().splitlines():
+        digest, _, name = line.partition("  ")
+        if name:
+            expected[name] = digest
+    for name in ("meta.json", "state.bin"):
+        actual = hashlib.sha256(files[name]).hexdigest()
+        if expected.get(name) != actual:
+            raise SnapshotError(f"checksum mismatch for {name}")
+    meta = json.loads(files["meta.json"])
+    state = msgpack.unpackb(
+        files["state.bin"], raw=False, strict_map_key=False
+    )
+    return state, meta

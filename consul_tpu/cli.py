@@ -21,6 +21,7 @@ import json
 import math
 import signal
 import sys
+from pathlib import Path
 from typing import Optional
 
 from consul_tpu.api import ConsulClient, parse_watch
@@ -76,6 +77,8 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="config_file", help="JSON/HCL config file")
     sp.add_argument("-config-dir", action="append", default=[],
                     dest="config_dir")
+    sp.add_argument("-data-dir", default=None, dest="data_dir",
+                    help="persistence root (serf snapshot, rejoin state)")
 
     # cluster membership --------------------------------------------------
     cmd("members", cmd_members, "list gossip pool members")
@@ -130,6 +133,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("arg", nargs="?", default="",
                     help="JSON definition, id, or secret")
 
+    sp = cmd("snapshot", cmd_snapshot, "save/restore cluster state")
+    sp.add_argument("verb", choices=["save", "restore"])
+    sp.add_argument("file")
+
     sp = cmd("operator", cmd_operator, "cluster operator tools")
     sp.add_argument("subsystem", choices=["raft"])
     sp.add_argument("action", choices=["list-peers"])
@@ -167,6 +174,7 @@ def build_runtime(args):
         "bind_addr": args.bind,
         "ports_http": args.http_port,
         "ports_dns": args.dns_port,
+        "data_dir": args.data_dir,
     }
     b.add_flags(flags)
     rc = b.build()
@@ -202,6 +210,12 @@ async def cmd_agent(args) -> int:
             acl_default_policy=rc.acl_default_policy,
             acl_master_token=rc.acl_master_token,
             acl_agent_token=rc.acl_agent_token,
+            serf_snapshot_path=(
+                str(Path(rc.data_dir) / "serf" / "local.snapshot")
+                if rc.data_dir and server_mode
+                else ""
+            ),
+            rejoin_after_leave=rc.rejoin_after_leave,
         ),
         gossip_transport=gossip,
         rpc_transport=rpc,
@@ -492,6 +506,29 @@ async def cmd_acl(args) -> int:
     else:
         await c.acl.policy_delete(args.arg)
         print("deleted")
+    return 0
+
+
+async def cmd_snapshot(args) -> int:
+    """command/snapshot: save streams the archive to disk, restore
+    uploads and installs it (inspect via the SHA256SUMS manifest)."""
+    c = _client(args)
+    if args.verb == "save":
+        status, _, data = await c.request("GET", "/v1/snapshot")
+        if status != 200:
+            print(f"Error: HTTP {status}: {data}", file=sys.stderr)
+            return 1
+        with open(args.file, "wb") as fh:
+            fh.write(data if isinstance(data, bytes) else bytes(data))
+        print(f"Saved snapshot to {args.file}")
+        return 0
+    with open(args.file, "rb") as fh:
+        blob = fh.read()
+    status, _, data = await c.request("PUT", "/v1/snapshot", raw_body=blob)
+    if status != 200:
+        print(f"Error: HTTP {status}: {data}", file=sys.stderr)
+        return 1
+    print("Restored snapshot")
     return 0
 
 

@@ -152,6 +152,14 @@ class ClusterConfig:
     # owner consumes nothing from the queue, e.g. the WAN pool — the
     # queue would otherwise grow unboundedly under member churn).
     queue_events: bool = True
+    # Gossip snapshot for restart recovery (serf/snapshot.go:17-60):
+    # member list + Lamport clocks replayed on start, auto-rejoin
+    # through previously-alive members.
+    snapshot_path: Optional[str] = None
+    rejoin_after_leave: bool = False  # server_serf.go:108
+    # Failed-member reconnect attempts (serf.go:1547-1612 reconnect
+    # loop: every ReconnectInterval=30s until ReconnectTimeout).
+    reconnect_interval_s: float = 30.0
 
 
 def encode_tags(tags: dict[str, str]) -> bytes:
@@ -202,6 +210,22 @@ class Cluster:
         # cache is serf's coordClient/coordCache pair, serf.go:82-90).
         self.vivaldi = VivaldiClient() if config.coordinates else None
         self.coord_cache: dict[str, "Coordinate"] = {}
+
+        # Gossip snapshot: replay BEFORE the clocks first tick so the
+        # restored Lamport times dedup pre-crash events (snapshot.go
+        # Replay -> serf.go eventMinTime).
+        self.snapshotter = None
+        self.previous = None
+        if config.snapshot_path:
+            from consul_tpu.eventing.snapshot import Snapshotter
+
+            self.snapshotter = Snapshotter(config.snapshot_path)
+            self.previous = self.snapshotter.replay()
+            self.clock.witness(self.previous.clock)
+            self.event_clock.witness(self.previous.event_clock)
+            self.query_clock.witness(self.previous.query_clock)
+            self.event_min_time = self.previous.event_clock + 1
+            self.query_min_time = self.previous.query_clock + 1
 
         self.memberlist = Memberlist(
             MemberlistConfig(
@@ -259,6 +283,41 @@ class Cluster:
         self.query_clock.increment()
         await self.memberlist.start()
         self._tasks.append(asyncio.create_task(self._reap_loop()))
+        self._tasks.append(asyncio.create_task(self._reconnect_loop()))
+
+    async def auto_rejoin(self) -> int:
+        """Rejoin through the snapshot's previously-alive members
+        (snapshot.go AliveNodes -> serf auto-rejoin); refused after a
+        graceful leave unless RejoinAfterLeave."""
+        prev = self.previous
+        if prev is None or (prev.left and not self.config.rejoin_after_leave):
+            return 0
+        addrs = [
+            addr for name, addr in prev.alive.items()
+            if name != self.config.name and addr
+        ]
+        if not addrs:
+            return 0
+        return await self.join(addrs)
+
+    async def _reconnect_loop(self) -> None:
+        """serf.go:1547-1612: periodically pick one failed member and
+        attempt to re-establish contact via push/pull; success flows
+        back through the normal alive path."""
+        interval = self.config.reconnect_interval_s * self.config.interval_scale
+        while True:
+            await asyncio.sleep(interval)
+            failed = [
+                m for m in self.members.values()
+                if m.status == MemberStatus.FAILED and m.addr
+            ]
+            if not failed:
+                continue
+            target = failed[int(time.monotonic() * 1000) % len(failed)]
+            try:
+                await self.memberlist.join([target.addr])
+            except Exception:  # noqa: BLE001 - still down, retry later
+                pass
 
     async def join(self, addrs: list[str]) -> int:
         n = await self.memberlist.join(addrs)
@@ -282,11 +341,15 @@ class Cluster:
             },
         )
         await asyncio.sleep(self.config.interval_scale * 0.5)
+        if self.snapshotter is not None:
+            self.snapshotter.leave()
         await self.memberlist.leave()
 
     async def shutdown(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self.snapshotter is not None:
+            self.snapshotter.close()
         await self.memberlist.shutdown()
 
     def local_member(self) -> Member:
@@ -613,6 +676,8 @@ class Cluster:
                 self._handle_leave_intent({**body, "prune": False})
             else:
                 self._handle_join_intent(body)
+        if self.snapshotter is not None:
+            self.snapshotter.alive(m.name, m.addr)
         self._emit(Event(type=EventType.MEMBER_JOIN, members=[m]))
 
     def _on_node_leave(self, node: Node) -> None:
@@ -620,6 +685,8 @@ class Cluster:
         if m is None:
             return
         m.leave_time = time.monotonic()
+        if self.snapshotter is not None:
+            self.snapshotter.not_alive(m.name)
         if node.status == NodeStatus.LEFT or m.status == MemberStatus.LEAVING:
             m.status = MemberStatus.LEFT
             self._emit(Event(type=EventType.MEMBER_LEAVE, members=[m]))
@@ -635,6 +702,12 @@ class Cluster:
         self._emit(Event(type=EventType.MEMBER_UPDATE, members=[m]))
 
     def _emit(self, event: Event) -> None:
+        if self.snapshotter is not None:
+            self.snapshotter.update_clock(
+                self.clock.time(),
+                self.event_clock.time(),
+                self.query_clock.time(),
+            )
         if self.config.queue_events:
             self.events.put_nowait(event)
         if self.config.on_event is not None:

@@ -626,17 +626,23 @@ class Memberlist:
                 self._suspect_node(
                     {"inc": snap["inc"], "node": snap["name"], "from": self.config.name}
                 )
-            else:
+            elif status == NodeStatus.LEFT:
                 # Preserve leave-vs-die: a LEFT snapshot replays as a
                 # self-authored obituary so _dead_node classifies it LEFT
                 # (mergeState keeps StateLeft distinct, state.go:1283+).
-                author = (
-                    snap["name"]
-                    if status == NodeStatus.LEFT
-                    else self.config.name
-                )
                 self._dead_node(
-                    {"inc": snap["inc"], "node": snap["name"], "from": author}
+                    {"inc": snap["inc"], "node": snap["name"],
+                     "from": snap["name"]}
+                )
+            else:
+                # A remote DEAD becomes a *suspicion* (state.go:1299
+                # mergeState: "If the remote node believes a node is
+                # dead, we prefer to suspect that node instead of
+                # declaring it dead instantly") — crucially, a restarted
+                # node merging its own obituary refutes it this way.
+                self._suspect_node(
+                    {"inc": snap["inc"], "node": snap["name"],
+                     "from": self.config.name}
                 )
         if self.config.merge_remote_state is not None and body.get("user"):
             self.config.merge_remote_state(body["user"], body.get("join", False))
