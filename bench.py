@@ -67,6 +67,25 @@ def main() -> None:
     bcast_report = run_broadcast(bcast_cfg, steps=60, seed=0, warmup=True)
     bcast_summary = bcast_report.summary()
 
+    # Host-plane KV/HTTP throughput vs the reference's published numbers
+    # (bench/results-0.7.1.md: 3,780 PUT/s, 9,774 stale GET/s).  Run in
+    # a clean subprocess: the host plane never touches JAX, and this
+    # process's TPU-tunnel service threads would otherwise steal ~1/3
+    # of the asyncio loop and understate the numbers.
+    import json as _json
+    import subprocess
+    import sys
+
+    try:
+        kv = _json.loads(
+            subprocess.run(
+                [sys.executable, "-m", "consul_tpu.bench_kv"],
+                capture_output=True, text=True, timeout=120, check=True,
+            ).stdout.strip().splitlines()[-1]
+        )
+    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
+        kv = {"kv_bench_error": str(e)}
+
     print(
         json.dumps(
             {
@@ -89,6 +108,7 @@ def main() -> None:
                     # The headline scan is unsharded: the whole 1M-node
                     # population lives and steps on ONE chip.
                     "nodes_per_chip": N,
+                    **kv,
                 },
             }
         )

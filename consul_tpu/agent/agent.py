@@ -64,6 +64,8 @@ class AgentConfig:
     # Serf gossip snapshot + auto-rejoin (serf/snapshot.go).
     serf_snapshot_path: str = ""
     rejoin_after_leave: bool = False
+    # Gossip encryption key, base64 (config "encrypt"; consul keygen).
+    encrypt_key: str = ""
 
 
 @dataclasses.dataclass
@@ -85,6 +87,12 @@ class Agent:
         wan_transport: Optional[Transport] = None,
     ):
         self.config = config
+        # Shared keyring for LAN (and WAN) gossip (security.go).
+        self.keyring = None
+        if config.encrypt_key:
+            from consul_tpu.net.security import Keyring
+
+            self.keyring = Keyring.from_b64(config.encrypt_key)
         if config.server:
             if rpc_transport is None:
                 raise ValueError("server agents need an rpc transport")
@@ -103,6 +111,7 @@ class Agent:
                     acl_master_token=config.acl_master_token,
                     serf_snapshot_path=config.serf_snapshot_path,
                     rejoin_after_leave=config.rejoin_after_leave,
+                    keyring=self.keyring,
                 ),
                 gossip_transport,
                 rpc_transport,
@@ -119,6 +128,7 @@ class Agent:
                     datacenter=config.datacenter,
                     profile=config.profile,
                     gossip_interval_scale=config.gossip_interval_scale,
+                    keyring=self.keyring,
                 ),
                 gossip_transport,
                 rpc_transport,
@@ -192,6 +202,19 @@ class Agent:
         if self.config.acl_agent_token and "token" not in body:
             body = {**body, "token": self.config.acl_agent_token}
         return await self.rpc(method, body)
+
+    async def keyring_operation(self, op: str, key_b64: str = "") -> dict:
+        """operator keyring (operator_endpoint.go KeyringOperation):
+        fan the op over the LAN pool (and the WAN pool on servers)."""
+        pools = [("lan", self.serf)]
+        wan = getattr(self.delegate, "serf_wan", None)
+        if wan is not None:
+            pools.append(("wan", wan))
+        out = {}
+        for label, pool in pools:
+            fn = getattr(pool, op.replace("-", "_"))
+            out[label] = await (fn(key_b64) if key_b64 else fn())
+        return out
 
     async def cached_rpc(self, cache_type: str, body: dict):
         """Read through the agent cache (agent.go cache-backed RPCs with
@@ -297,7 +320,9 @@ class Agent:
 
     def add_check(self, defn: dict) -> None:
         cid = defn.get("check_id") or defn.get("name")
-        runner = build_check_runner(defn, self._notify_check)
+        runner = build_check_runner(
+            defn, self._notify_check, alias_lookup=self._alias_lookup
+        )
         record = {
             k: v
             for k, v in defn.items()
@@ -315,6 +340,26 @@ class Agent:
         if runner is not None:
             self.checks[cid] = runner
             runner.start()
+
+    def _alias_lookup(self, service_ref: str):
+        """Statuses of the checks attached to a local service (matched
+        by id OR name), or None when no such service is registered
+        (alias.go local path)."""
+        ids = {
+            ls.service.get("id") or ls.service.get("service")
+            for ls in self.local.services.values()
+            if not ls.deleted and (
+                ls.service.get("id") == service_ref
+                or ls.service.get("service") == service_ref
+            )
+        }
+        if not ids:
+            return None
+        return [
+            lc.check.get("status", "")
+            for lc in self.local.checks.values()
+            if not lc.deleted and lc.check.get("service_id") in ids
+        ]
 
     def remove_check(self, check_id: str) -> bool:
         runner = self.checks.pop(check_id, None)

@@ -11,6 +11,10 @@ definition and reports status transitions into the agent's LocalState
   CheckTCP      check.go:512 — connect() success = passing
   CheckHTTP     check.go:333 — GET; 2xx passing, 429 warning, else
                 critical (body captured as output)
+  CheckAlias    alias.go:23 — mirrors the health of another locally
+                registered service: any critical -> critical, any
+                warning -> warning, all passing -> passing, service
+                missing -> critical
 
 Timeouts, first-run randomization (to avoid thundering herds after an
 agent restart) and output truncation follow the reference's behavior.
@@ -215,12 +219,61 @@ class CheckHTTP(_PeriodicCheck):
         return HEALTH_CRITICAL, output
 
 
-def build_check_runner(defn: dict, notify: Notify) -> Optional[CheckRunner]:
+class CheckAlias(CheckRunner):
+    """alias.go:23 CheckAlias: reflect another service's health."""
+
+    def __init__(self, check_id: str, alias_service: str,
+                 lookup: Callable[[str], Optional[list[str]]],
+                 notify: Notify, interval_s: float = 1.0):
+        self.check_id = check_id
+        self.alias_service = alias_service
+        self.lookup = lookup
+        self.notify = notify
+        self.interval_s = interval_s
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        while True:
+            statuses = self.lookup(self.alias_service)
+            if statuses is None:
+                self.notify(self.check_id, HEALTH_CRITICAL,
+                            "aliased service is not registered")
+            elif HEALTH_CRITICAL in statuses:
+                self.notify(self.check_id, HEALTH_CRITICAL,
+                            "aliased check is critical")
+            elif HEALTH_WARNING in statuses:
+                self.notify(self.check_id, HEALTH_WARNING,
+                            "aliased check is warning")
+            else:
+                # No checks at all counts as passing (alias.go
+                # CheckIfServiceIDExists + empty check set).
+                self.notify(self.check_id, HEALTH_PASSING,
+                            "all checks passing")
+            await asyncio.sleep(self.interval_s)
+
+
+def build_check_runner(
+    defn: dict,
+    notify: Notify,
+    alias_lookup: Optional[Callable[[str], Optional[list[str]]]] = None,
+) -> Optional[CheckRunner]:
     """Map a check definition dict to its executor (agent.go
-    addCheck dispatch): ttl | script/args | tcp | http."""
+    addCheck dispatch): ttl | script/args | tcp | http | alias."""
     cid = defn.get("check_id") or defn.get("name")
     interval = _seconds(defn.get("interval", 10.0))
     timeout = _seconds(defn.get("timeout", 0.0))
+    if defn.get("alias_service"):
+        if alias_lookup is None:
+            return None
+        return CheckAlias(cid, defn["alias_service"], alias_lookup, notify,
+                          interval_s=interval or 1.0)
     if defn.get("ttl"):
         return CheckTTL(cid, _seconds(defn["ttl"]), notify)
     if defn.get("script") or defn.get("args"):

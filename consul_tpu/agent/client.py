@@ -38,6 +38,7 @@ class ClientConfig:
     profile: GossipProfile = LAN
     gossip_interval_scale: float = 1.0
     tags: dict = dataclasses.field(default_factory=dict)
+    keyring: object = None  # gossip encryption (security.go)
 
 
 REBALANCE_INTERVAL_S = 120.0  # router/manager.go clientRPCMinReuseDuration
@@ -113,6 +114,7 @@ class Client:
                 tags=tags,
                 profile=config.profile,
                 interval_scale=config.gossip_interval_scale,
+                keyring=config.keyring,
             ),
             gossip_transport,
         )

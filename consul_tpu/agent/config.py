@@ -73,6 +73,8 @@ class RuntimeConfig:
     retry_join: tuple = ()
     retry_join_wan: tuple = ()
     log_level: str = "info"
+    # Gossip encryption key, base64 (config "encrypt"; consul keygen).
+    encrypt: str = ""
     # Gossip tuning blocks (resolved to profiles via gossip_profile()).
     gossip_lan: tuple = ()   # ((key, value), ...) hashable overrides
     gossip_wan: tuple = ()

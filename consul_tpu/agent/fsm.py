@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional
 
 from consul_tpu.consensus.raft import FSM, Entry
 from consul_tpu.store.state import StateStore
+from consul_tpu.telemetry import metrics
 from consul_tpu.stream import (
     TOPIC_KV,
     TOPIC_SERVICE_HEALTH,
@@ -122,7 +123,14 @@ class ConsulFSM(FSM):
             else None
         )
         try:
+            import time as _time
+
+            _t0 = _time.monotonic()
             result = handler(entry.index, body)
+            metrics().measure_since(
+                f"consul.fsm.{MessageType(msg_type & ~IGNORE_UNKNOWN_FLAG).name.lower()}",
+                _t0,
+            )
         except (ValueError, KeyError, TypeError) as e:
             # Domain errors (bad registration, missing session, malformed
             # body...) are a *result*, not an FSM failure: every replica

@@ -31,12 +31,14 @@ import urllib.parse
 from typing import Any, Callable, Optional
 
 from consul_tpu.agent.agent import Agent
+from consul_tpu.agent.bexpr import FilterError
 from consul_tpu.agent.rpc import (
     ERR_ACL_NOT_FOUND,
     ERR_PERMISSION_DENIED,
     RPCError,
 )
 from consul_tpu.agent.server import _parse_ttl
+from consul_tpu.telemetry import metrics
 from consul_tpu.version import __version__
 
 log = logging.getLogger("consul_tpu.http")
@@ -47,7 +49,13 @@ _ACRONYMS = {
 }
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def _camel_key(key: str) -> str:
+    # Memoized: response shapes reuse a small fixed key vocabulary, and
+    # key camelization dominated the hot read path before caching.
     parts = [p.capitalize() for p in key.split("_")]
     parts = [_ACRONYMS.get(p, p) for p in parts]
     return "".join(parts)
@@ -201,22 +209,28 @@ class HTTPApi:
                 pass
 
     async def _read_request(self, reader) -> Optional[HTTPRequest]:
+        # One readuntil for the whole head (request line + headers):
+        # measurably faster than a readline loop on keep-alive
+        # connections, where header parsing is per-request overhead.
+        # CRLF line endings required (RFC 9112 §2.2 — bare-LF requests
+        # are not recognized).
         try:
-            request_line = await reader.readline()
-        except (ConnectionError, asyncio.IncompleteReadError):
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError):
             return None
-        if not request_line:
+        if not head:
             return None
+        lines = head.decode("latin-1").split("\r\n")
         try:
-            method, target, _version = request_line.decode().split()
+            method, target, _version = lines[0].split()
         except ValueError:
             return None
         headers: dict[str, str] = {}
-        while True:
-            line = await reader.readline()
-            if line in (b"\r\n", b"\n", b""):
-                break
-            name, _, value = line.decode().partition(":")
+        for line in lines[1:]:
+            if not line:
+                continue
+            name, _, value = line.partition(":")
             headers[name.strip().lower()] = value.strip()
         body = b""
         if "content-length" in headers:
@@ -258,6 +272,16 @@ class HTTPApi:
         await writer.drain()
 
     async def _dispatch(self, req: HTTPRequest) -> HTTPResponse:
+        import time as _time
+
+        metrics().incr_counter(f"http.{req.method}")
+        _t0 = _time.monotonic()
+        try:
+            return await self._dispatch_inner(req)
+        finally:
+            metrics().measure_since("http.request", _t0)
+
+    async def _dispatch_inner(self, req: HTTPRequest) -> HTTPResponse:
         path_matched = False
         for method, pattern, handler in self.routes:
             m = pattern.match(req.path)
@@ -267,7 +291,22 @@ class HTTPApi:
             if method != req.method:
                 continue
             try:
-                return await handler(req, m)
+                resp = await handler(req, m)
+                # ?filter= bexpr filtering on list results (http.go
+                # parseFilter → go-bexpr), evaluated against the
+                # camelized row shape the client sees.
+                if "filter" in req.query and isinstance(resp.body, list):
+                    from consul_tpu.agent.bexpr import create_filter
+
+                    flt = create_filter(req.query["filter"])
+                    resp.body = [
+                        row
+                        for row, crow in zip(resp.body, camelize(resp.body))
+                        if flt.match(crow)
+                    ]
+                return resp
+            except FilterError as e:
+                return HTTPResponse(400, {"error": f"bad filter: {e}"})
             except RPCError as e:
                 # http.go:1067-1080: ACL failures are 403s, the rest of
                 # the RPC error space is a 500.
@@ -299,6 +338,7 @@ class HTTPApi:
         r("GET", r"/v1/status/leader", self.status_leader)
         r("GET", r"/v1/status/peers", self.status_peers)
         # agent
+        r("GET", r"/v1/agent/metrics", self.agent_metrics)
         r("GET", r"/v1/agent/self", self.agent_self)
         r("GET", r"/v1/agent/members", self.agent_members)
         r("GET", r"/v1/agent/services", self.agent_services)
@@ -362,6 +402,11 @@ class HTTPApi:
         # operator
         r("GET", r"/v1/operator/raft/configuration", self.operator_raft)
         r("GET", r"/v1/operator/autopilot/health", self.operator_health)
+        # keyring (operator_endpoint.go /v1/operator/keyring)
+        r("GET", r"/v1/operator/keyring", self.keyring_list)
+        r("POST", r"/v1/operator/keyring", self.keyring_install)
+        r("PUT", r"/v1/operator/keyring", self.keyring_use)
+        r("DELETE", r"/v1/operator/keyring", self.keyring_remove)
         # snapshot (http_register.go /v1/snapshot)
         r("GET", r"/v1/snapshot", self.snapshot_save)
         r("PUT", r"/v1/snapshot", self.snapshot_restore)
@@ -392,6 +437,11 @@ class HTTPApi:
             if data is None:
                 return HTTPResponse(404, None, headers=_meta_headers(meta))
         return HTTPResponse(200, data, headers=_meta_headers(meta))
+
+    async def agent_metrics(self, req, m) -> HTTPResponse:
+        """/v1/agent/metrics (agent_endpoint.go AgentMetrics): the
+        in-memory sink's aggregated view."""
+        return HTTPResponse(200, KeyedMap(metrics().snapshot()))
 
     # -- status ---------------------------------------------------------
 
@@ -864,6 +914,41 @@ class HTTPApi:
             **req.dc_option(),
         })
         return HTTPResponse(200, out.get("result", True))
+
+    # -- keyring -------------------------------------------------------------
+
+    async def _keyring_op(self, req, op: str, need_key: bool) -> HTTPResponse:
+        key = ""
+        if need_key:
+            body = _decamelize(req.json())
+            key = body.get("key", "")
+            if not key:
+                return HTTPResponse(400, {"error": "missing Key"})
+        try:
+            out = await self.agent.keyring_operation(op, key)
+        except ValueError as e:
+            return HTTPResponse(400, {"error": str(e)})
+        # keys (base64) and errors (node names) are DATA keys: shield
+        # them from camelization or they come back unusable.
+        shaped = KeyedMap({
+            label: {**res,
+                    "keys": KeyedMap(res.get("keys", {})),
+                    "errors": KeyedMap(res.get("errors", {}))}
+            for label, res in out.items()
+        })
+        return HTTPResponse(200, shaped)
+
+    async def keyring_list(self, req, m) -> HTTPResponse:
+        return await self._keyring_op(req, "list_keys", need_key=False)
+
+    async def keyring_install(self, req, m) -> HTTPResponse:
+        return await self._keyring_op(req, "install_key", need_key=True)
+
+    async def keyring_use(self, req, m) -> HTTPResponse:
+        return await self._keyring_op(req, "use_key", need_key=True)
+
+    async def keyring_remove(self, req, m) -> HTTPResponse:
+        return await self._keyring_op(req, "remove_key", need_key=True)
 
     # -- snapshot ------------------------------------------------------------
 

@@ -38,6 +38,7 @@ from typing import Any, AsyncIterator, Callable, Optional
 import msgpack
 
 from consul_tpu.net.transport import Stream, Transport
+from consul_tpu.telemetry import metrics
 from consul_tpu.store.memdb import WatchSet
 from consul_tpu.store.state import StateStore
 
@@ -157,6 +158,8 @@ async def blocking_query(
     wait = min(wait, MAX_QUERY_TIME)
     wait += (rng or random).random() * wait / JITTER_FRACTION
     deadline = time.monotonic() + wait
+    # rpc.go:796 metrics.IncrCounter rpc.queries_blocking.
+    metrics().incr_counter("rpc.queries_blocking")
 
     while True:
         ws = WatchSet()

@@ -99,6 +99,8 @@ class ServerConfig:
     autopilot_cleanup_dead_servers: bool = True
     autopilot_interval_s: float = 10.0
     autopilot_grace_s: float = 10.0
+    # Gossip encryption keyring (shared LAN/WAN, security.go).
+    keyring: object = None
     # ACL system (agent/config: acl.enabled / default_policy / tokens.master).
     acl_enabled: bool = False
     acl_default_policy: str = "allow"   # "allow" | "deny"
@@ -169,6 +171,7 @@ class Server:
                 on_event=self._on_serf_event,
                 snapshot_path=config.serf_snapshot_path or None,
                 rejoin_after_leave=config.rejoin_after_leave,
+                keyring=config.keyring,
             ),
             gossip_transport,
         )
@@ -189,6 +192,7 @@ class Server:
                     profile=config.wan_profile,
                     interval_scale=config.gossip_interval_scale,
                     queue_events=False,  # router reads members directly
+                    keyring=config.keyring,
                 ),
                 wan_transport,
             )

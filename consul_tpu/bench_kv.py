@@ -1,0 +1,99 @@
+"""KV/HTTP throughput benchmark against the reference's published plane.
+
+The reference ships KV numbers measured with ``boom`` (keep-alive HTTP
+load generator) against a 3-server cluster (bench/results-0.7.1.md:
+3,780 PUT/s at :34, 9,774 stale GET/s at :110).  This module spins a
+dev-mode server agent with the real HTTP server on a real TCP socket
+and drives it with keep-alive worker connections — same protocol shape,
+one process (client cost included, which only understates us).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+async def _keepalive_worker(addr: str, requests) -> None:
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.open_connection(host, int(port))
+    try:
+        for method, path, body in requests:
+            head = (
+                f"{method} {path} HTTP/1.1\r\nHost: bench\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            )
+            writer.write(head.encode() + body)
+            await writer.drain()
+            await reader.readline()
+            clen = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                if line.lower().startswith(b"content-length:"):
+                    clen = int(line.split(b":")[1])
+            await reader.readexactly(clen)
+    finally:
+        writer.close()
+
+
+async def _run(workers: int, per_worker: int) -> dict:
+    from consul_tpu.agent.agent import Agent, AgentConfig
+    from consul_tpu.agent.http import HTTPApi
+    from consul_tpu.net.transport import InMemoryNetwork
+
+    net = InMemoryNetwork()
+    agent = Agent(
+        AgentConfig(node_name="bench", bootstrap_expect=1,
+                    gossip_interval_scale=0.05, sync_interval_s=30,
+                    sync_retry_interval_s=30, reconcile_interval_s=30),
+        gossip_transport=net.new_transport("bench:gossip"),
+        rpc_transport=net.new_transport("bench:rpc"),
+    )
+    await agent.start()
+    deadline = asyncio.get_running_loop().time() + 15
+    while not agent.delegate.is_leader():
+        if asyncio.get_running_loop().time() > deadline:
+            raise RuntimeError("no leader for kv bench")
+        await asyncio.sleep(0.05)
+    api = HTTPApi(agent)
+    addr = await api.start()
+    try:
+        puts = [
+            [("PUT", f"/v1/kv/bench/{w}/{i}", b"x" * 64)
+             for i in range(per_worker)]
+            for w in range(workers)
+        ]
+        t0 = time.perf_counter()
+        await asyncio.gather(*[_keepalive_worker(addr, r) for r in puts])
+        put_rate = workers * per_worker / (time.perf_counter() - t0)
+
+        gets = [
+            [("GET", f"/v1/kv/bench/{w}/{i % per_worker}?stale", b"")
+             for i in range(per_worker)]
+            for w in range(workers)
+        ]
+        t0 = time.perf_counter()
+        await asyncio.gather(*[_keepalive_worker(addr, r) for r in gets])
+        get_rate = workers * per_worker / (time.perf_counter() - t0)
+    finally:
+        await api.stop()
+        await agent.shutdown()
+    return {
+        "kv_put_per_s": round(put_rate, 1),
+        "kv_stale_get_per_s": round(get_rate, 1),
+        # bench/results-0.7.1.md:34,110
+        "kv_put_vs_reference": round(put_rate / 3780.0, 2),
+        "kv_stale_get_vs_reference": round(get_rate / 9774.0, 2),
+    }
+
+
+def run_kv_bench(workers: int = 8, per_worker: int = 500) -> dict:
+    return asyncio.run(_run(workers, per_worker))
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_kv_bench()))

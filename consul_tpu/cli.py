@@ -133,6 +133,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("arg", nargs="?", default="",
                     help="JSON definition, id, or secret")
 
+    cmd("keygen", cmd_keygen, "generate a gossip encryption key")
+    sp = cmd("keyring", cmd_keyring, "manage gossip encryption keys")
+    sp.add_argument("verb", choices=["list", "install", "use", "remove"])
+    sp.add_argument("key", nargs="?", default="")
+
     sp = cmd("snapshot", cmd_snapshot, "save/restore cluster state")
     sp.add_argument("verb", choices=["save", "restore"])
     sp.add_argument("file")
@@ -210,6 +215,7 @@ async def cmd_agent(args) -> int:
             acl_default_policy=rc.acl_default_policy,
             acl_master_token=rc.acl_master_token,
             acl_agent_token=rc.acl_agent_token,
+            encrypt_key=rc.encrypt,
             serf_snapshot_path=(
                 str(Path(rc.data_dir) / "serf" / "local.snapshot")
                 if rc.data_dir and server_mode
@@ -506,6 +512,30 @@ async def cmd_acl(args) -> int:
     else:
         await c.acl.policy_delete(args.arg)
         print("deleted")
+    return 0
+
+
+async def cmd_keygen(args) -> int:
+    """command/keygen: a fresh 32-byte key, base64."""
+    from consul_tpu.net.security import generate_key
+
+    print(generate_key())
+    return 0
+
+
+async def cmd_keyring(args) -> int:
+    """command/keyring: -list/-install/-use/-remove over
+    /v1/operator/keyring."""
+    c = _client(args)
+    method = {"list": "GET", "install": "POST", "use": "PUT",
+              "remove": "DELETE"}[args.verb]
+    body = {"Key": args.key} if args.verb != "list" else None
+    status, _, data = await c.request(method, "/v1/operator/keyring",
+                                      body=body)
+    if status != 200:
+        print(f"Error: HTTP {status}: {data}", file=sys.stderr)
+        return 1
+    print(json.dumps(data, indent=2, default=str))
     return 0
 
 

@@ -41,6 +41,7 @@ from consul_tpu.net.transport import Transport
 from consul_tpu.net.vivaldi import Coordinate, VivaldiClient
 from consul_tpu.eventing.lamport import LamportClock
 from consul_tpu.protocol import GossipProfile, LAN
+from consul_tpu.telemetry import metrics
 
 log = logging.getLogger("consul_tpu.eventing")
 
@@ -160,6 +161,9 @@ class ClusterConfig:
     # Failed-member reconnect attempts (serf.go:1547-1612 reconnect
     # loop: every ReconnectInterval=30s until ReconnectTimeout).
     reconnect_interval_s: float = 30.0
+    # AES-GCM gossip keyring (memberlist/security.go + serf/keymanager);
+    # rotated cluster-wide through internal queries.
+    keyring: Optional["Keyring"] = None
 
 
 def encode_tags(tags: dict[str, str]) -> bytes:
@@ -244,6 +248,7 @@ class Cluster:
                 notify_ping_complete=(
                     self._on_ping_complete if self.vivaldi else None
                 ),
+                keyring=config.keyring,
             ),
             transport,
         )
@@ -517,6 +522,11 @@ class Cluster:
                     msg["addr"],
                 )
             )
+        if msg["name"].startswith("_serf_"):
+            # Internal queries (serf/internal_query.go): handled by the
+            # serf layer itself, never surfaced to the application.
+            asyncio.ensure_future(self._handle_internal_query(handle))
+            return True
         self._emit(
             Event(
                 type=EventType.QUERY,
@@ -553,6 +563,68 @@ class Cluster:
     # ------------------------------------------------------------------
     # membership intents (serf.go handleNodeJoinIntent / LeaveIntent)
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # keyring management (serf/keymanager.go + internal_query.go)
+    # ------------------------------------------------------------------
+
+    async def _handle_internal_query(self, handle: QueryResponseHandle) -> None:
+        """serf/internal_query.go serfQueries: _serf_install-key /
+        _serf_use-key / _serf_remove-key / _serf_list-keys applied to
+        the local keyring, result returned to the originator."""
+        op = handle.name[len("_serf_"):]
+        resp: dict = {"result": True, "error": "", "keys": []}
+        keyring = self.config.keyring
+        try:
+            arg = handle.payload.decode() if handle.payload else ""
+            if keyring is None:
+                raise ValueError("encryption is not enabled")
+            if op == "install-key":
+                keyring.install(arg)
+            elif op == "use-key":
+                keyring.use(arg)
+            elif op == "remove-key":
+                keyring.remove(arg)
+            elif op == "list-keys":
+                resp["keys"] = keyring.list_keys()
+            else:
+                return  # unknown internal query: stay silent
+        except ValueError as e:
+            resp = {"result": False, "error": str(e), "keys": []}
+        try:
+            await handle.respond(msgpack.packb(resp, use_bin_type=True))
+        except Exception:  # noqa: BLE001 - originator may be gone
+            log.debug("internal query response failed", exc_info=True)
+
+    async def _key_operation(self, op: str, key_b64: str = "") -> dict:
+        """KeyManager.{InstallKey,UseKey,RemoveKey,ListKeys}: broadcast
+        the op as an internal query and tally per-node outcomes."""
+        result = await self.query(f"_serf_{op}", key_b64.encode())
+        out = {"num_nodes": len(self.alive_members()),
+               "num_resp": len(result.responses),
+               "errors": {}, "keys": {}}
+        for node, payload in result.responses:
+            try:
+                body = msgpack.unpackb(payload, raw=False)
+            except Exception:  # noqa: BLE001
+                continue
+            if not body.get("result", False):
+                out["errors"][node] = body.get("error", "failed")
+            for k in body.get("keys", []):
+                out["keys"][k] = out["keys"].get(k, 0) + 1
+        return out
+
+    async def install_key(self, key_b64: str) -> dict:
+        return await self._key_operation("install-key", key_b64)
+
+    async def use_key(self, key_b64: str) -> dict:
+        return await self._key_operation("use-key", key_b64)
+
+    async def remove_key(self, key_b64: str) -> dict:
+        return await self._key_operation("remove-key", key_b64)
+
+    async def list_keys(self) -> dict:
+        return await self._key_operation("list-keys")
 
     def _save_recent_intent(self, kind: SerfMessageType, msg: dict) -> bool:
         """Buffer an intent for a not-yet-known member so it can replay
@@ -616,14 +688,21 @@ class Cluster:
     def _get_broadcasts(self, overhead: int, limit: int) -> list[bytes]:
         """Drain serf broadcasts into the gossip packet, each message
         retransmitted up to the budget (delegate.go:137-171)."""
+        # serf.go:1675 serf.queue.* depth gauges, emitted at drain time.
+        metrics().set_gauge("serf.queue.Event", len(self._broadcast_queue))
         return self._broadcast_queue.get_broadcasts(overhead, limit)
 
     async def _send_direct(self, t: SerfMessageType, body: dict, addr: str) -> None:
         from consul_tpu.net import wire
 
         payload = bytes([t]) + msgpack.packb(body, use_bin_type=True)
+        # Through the memberlist seal so query responses stay encrypted
+        # when the keyring is on (security.go applies to ALL packets).
         await self.memberlist.transport.write_to(
-            wire.encode(wire.MessageType.USER, payload), addr
+            self.memberlist._seal(
+                wire.encode(wire.MessageType.USER, payload)
+            ),
+            addr,
         )
 
     def _on_user_msg(self, payload: bytes) -> None:

@@ -33,6 +33,8 @@ import time
 from typing import Callable, Optional
 
 from consul_tpu.net import wire
+from consul_tpu.net.security import Keyring, SecurityError
+from consul_tpu.telemetry import metrics
 from consul_tpu.net.broadcast_queue import TransmitLimitedQueue
 from consul_tpu.net.suspicion import Suspicion
 from consul_tpu.net.transport import Stream, Transport
@@ -95,6 +97,10 @@ class MemberlistConfig:
     # peer's ack including any such payload.
     ack_payload: Optional[Callable[[], dict]] = None
     notify_ping_complete: Optional[Callable[[Node, float, dict], None]] = None
+    # AES-GCM gossip encryption (memberlist/security.go): when set,
+    # every outbound packet/frame is sealed with the primary key and
+    # unencrypted inbound traffic is dropped (GossipVerifyIncoming).
+    keyring: Optional["Keyring"] = None
 
     def s(self, ms: float) -> float:
         """Protocol ms -> scaled seconds."""
@@ -112,6 +118,8 @@ class _Awareness:
 
     def apply_delta(self, delta: int) -> None:
         self.score = min(max(self.score + delta, 0), self._max - 1)
+        # awareness.go:50 emits the health score on every change.
+        metrics().set_gauge("memberlist.health.score", self.score)
 
     def scale_timeout(self, timeout: float) -> float:
         return timeout * (self.score + 1)
@@ -230,6 +238,32 @@ class Memberlist:
         self._seq += 1
         return self._seq
 
+    def _seal(self, payload: bytes) -> bytes:
+        """security.go encryptPayload: sealed payloads ride the ENCRYPT
+        message-type slot (net.go:44-59)."""
+        if self.config.keyring is None:
+            return payload
+        return bytes([wire.MessageType.ENCRYPT]) + self.config.keyring.encrypt(
+            payload
+        )
+
+    def _open(self, payload: bytes) -> Optional[bytes]:
+        """security.go decryptPayload + GossipVerifyIncoming: plaintext
+        traffic is rejected once encryption is on."""
+        if payload and payload[0] == wire.MessageType.ENCRYPT:
+            if self.config.keyring is None:
+                log.warning("dropping encrypted packet: no keyring")
+                return None
+            try:
+                return self.config.keyring.decrypt(payload[1:])
+            except SecurityError as e:
+                log.warning("dropping undecryptable packet: %s", e)
+                return None
+        if self.config.keyring is not None:
+            log.warning("dropping plaintext packet: encryption required")
+            return None
+        return payload
+
     async def _send_msg(self, addr: str, msg_type: wire.MessageType, body) -> None:
         """Send one message, piggybacking queued broadcasts up to the
         packet budget (state.go:597 gossip piggyback)."""
@@ -238,7 +272,7 @@ class Memberlist:
         extra = self._drain_broadcasts(budget)
         if extra:
             payload = wire.make_compound([payload] + extra)
-        await self.transport.write_to(payload, addr)
+        await self.transport.write_to(self._seal(payload), addr)
 
     def _drain_broadcasts(self, limit: int) -> list[bytes]:
         out = self.broadcasts.get_broadcasts(overhead=2, limit=limit)
@@ -253,6 +287,9 @@ class Memberlist:
         while not self._shutdown:
             payload, src, ts = await self.transport.recv_packet()
             try:
+                payload = self._open(payload)
+                if payload is None:
+                    continue
                 self._handle_packet(payload, src)
             except Exception:
                 log.exception("bad packet from %s", src)
@@ -263,6 +300,7 @@ class Memberlist:
                 self._handle_packet(part, src)
             return
         msg_type, body = wire.decode(payload)
+        metrics().incr_counter(f"memberlist.msg.{msg_type.name.lower()}")
         if msg_type == wire.MessageType.PING:
             self._on_ping(body, src)
         elif msg_type == wire.MessageType.INDIRECT_PING:
@@ -463,15 +501,15 @@ class Memberlist:
         except Exception:
             return False
         try:
-            await stream.send(
-                wire.encode(
-                    wire.MessageType.PING,
-                    {"seq": 0, "node": node.name, "from": self.config.name},
-                )
-            )
-            raw = await stream.recv(
+            await stream.send(self._seal(wire.encode(
+                wire.MessageType.PING,
+                {"seq": 0, "node": node.name, "from": self.config.name},
+            )))
+            raw = self._open(await stream.recv(
                 timeout=self.config.s(self.config.profile.probe_timeout_ms)
-            )
+            ))
+            if raw is None:
+                return False
             t, _ = wire.decode(raw)
             return t == wire.MessageType.ACK_RESP
         except Exception:
@@ -511,7 +549,9 @@ class Memberlist:
                     payload = (
                         msgs[0] if len(msgs) == 1 else wire.make_compound(msgs)
                     )
-                    await self.transport.write_to(payload, node.addr)
+                    await self.transport.write_to(
+                        self._seal(payload), node.addr
+                    )
             except Exception:
                 log.exception("gossip failed")
 
@@ -567,14 +607,14 @@ class Memberlist:
             addr, self.config.s(self.config.profile.probe_timeout_ms) * 4
         )
         try:
-            await stream.send(
-                wire.encode(
-                    wire.MessageType.PUSH_PULL, self._local_state_body(join)
-                )
-            )
-            raw = await stream.recv(
+            await stream.send(self._seal(wire.encode(
+                wire.MessageType.PUSH_PULL, self._local_state_body(join)
+            )))
+            raw = self._open(await stream.recv(
                 timeout=self.config.s(self.config.profile.probe_timeout_ms) * 4
-            )
+            ))
+            if raw is None:
+                raise ConnectionError("push/pull response rejected")
             t, body = wire.decode(raw)
             if t != wire.MessageType.PUSH_PULL:
                 raise ValueError(f"expected push/pull response, got {t}")
@@ -589,25 +629,23 @@ class Memberlist:
 
     async def _handle_stream(self, stream: Stream) -> None:
         try:
-            raw = await stream.recv(
+            raw = self._open(await stream.recv(
                 timeout=self.config.s(self.config.profile.probe_timeout_ms) * 8
-            )
+            ))
+            if raw is None:
+                return
             t, body = wire.decode(raw)
             if t == wire.MessageType.PUSH_PULL:
-                await stream.send(
-                    wire.encode(
-                        wire.MessageType.PUSH_PULL,
-                        self._local_state_body(body.get("join", False)),
-                    )
-                )
+                await stream.send(self._seal(wire.encode(
+                    wire.MessageType.PUSH_PULL,
+                    self._local_state_body(body.get("join", False)),
+                )))
                 self._merge_remote_state(body)
             elif t == wire.MessageType.PING:
-                await stream.send(
-                    wire.encode(
-                        wire.MessageType.ACK_RESP,
-                        self._ack_body(body.get("seq", 0)),
-                    )
-                )
+                await stream.send(self._seal(wire.encode(
+                    wire.MessageType.ACK_RESP,
+                    self._ack_body(body.get("seq", 0)),
+                )))
         except Exception:
             log.debug("stream handling failed", exc_info=True)
         finally:

@@ -18,8 +18,11 @@ reference's vendored ``go-immutable-radix``, which backs ``go-memdb``
   3. **Ordered iteration**: edges are sorted by label byte so prefix
      scans yield keys in lexicographic order (memdb iterator order).
 
-Pure Python; the hot-path C++ twin lives in ``native/`` (same API) and
-is selected at import time by ``consul_tpu.store`` when built.
+Pure Python by measurement, not by accident: with the C-backed msgpack
+codec underneath, the KV plane clears the reference's published numbers
+(bench/results-0.7.1.md: 3,780 PUT/s, 9,774 stale GET/s) — see
+``consul_tpu/bench_kv.py``, run as part of ``bench.py`` — so a native
+twin would buy nothing the benchmark can see.
 """
 
 from __future__ import annotations

@@ -1,0 +1,121 @@
+"""Telemetry: hierarchical counters/gauges/timers with an in-memory sink.
+
+Equivalent of ``lib/telemetry.go`` + the vendored ``armon/go-metrics``
+in-memory sink (SURVEY.md §5): hot paths emit named metrics —
+``memberlist.health.score`` (awareness.go:50), ``serf.queue.Event``
+(serf.go:1675), ``rpc.queries_blocking`` (rpc.go:796), ``consul.fsm.*``
+— into a process-global registry, exposed in the reference's
+/v1/agent/metrics JSON shape (Gauges/Counters/Samples).
+
+The statsd/dogstatsd/prometheus fanout sinks are out of scope; the
+in-memory sink is what the reference's own tests and the metrics
+endpoint read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class _Sample:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+
+    def snapshot(self, name: str) -> dict:
+        mean = self.total / self.count if self.count else 0.0
+        return {
+            "Name": name,
+            "Count": self.count,
+            "Sum": round(self.total, 6),
+            "Min": round(self.min, 6) if self.count else 0.0,
+            "Max": round(self.max, 6) if self.count else 0.0,
+            "Mean": round(mean, 6),
+        }
+
+
+class Metrics:
+    """go-metrics InmemSink: aggregated counters/gauges/timers."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, _Sample] = {}
+        self._gauges: dict[str, float] = {}
+        self._samples: dict[str, _Sample] = {}
+
+    def incr_counter(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters.setdefault(name, _Sample()).add(value)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def add_sample(self, name: str, value: float) -> None:
+        with self._lock:
+            self._samples.setdefault(name, _Sample()).add(value)
+
+    def measure_since(self, name: str, start: float) -> None:
+        """metrics.MeasureSince: elapsed milliseconds since ``start``
+        (a time.monotonic() value) as a timer sample."""
+        self.add_sample(name, (time.monotonic() - start) * 1000.0)
+
+    def snapshot(self) -> dict:
+        """The /v1/agent/metrics JSON shape (agent_endpoint.go
+        AgentMetrics -> InmemSink DisplayMetrics)."""
+        with self._lock:
+            return {
+                "Timestamp": time.strftime("%Y-%m-%d %H:%M:%S +0000 UTC",
+                                           time.gmtime()),
+                "Gauges": [
+                    {"Name": k, "Value": v}
+                    for k, v in sorted(self._gauges.items())
+                ],
+                "Counters": [
+                    s.snapshot(k) for k, s in sorted(self._counters.items())
+                ],
+                "Samples": [
+                    s.snapshot(k) for k, s in sorted(self._samples.items())
+                ],
+            }
+
+    def get_counter(self, name: str) -> int:
+        with self._lock:
+            s = self._counters.get(name)
+            return s.count if s else 0
+
+    def get_gauge(self, name: str) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._samples.clear()
+
+
+# Process-global registry (go-metrics global metrics, telemetry.go init).
+_global = Metrics()
+
+
+def metrics() -> Metrics:
+    return _global
+
+
+def set_global(m: Metrics) -> Metrics:
+    global _global
+    _global = m
+    return m

@@ -1,0 +1,295 @@
+"""Surface-fill features: telemetry counters, bexpr result filtering,
+AES-GCM gossip encryption + keyring rotation, alias checks.
+
+Parity models: armon/go-metrics inmem_test.go, go-bexpr evaluate_test,
+memberlist/security_test.go + keyring_test.go, serf/keymanager_test.go,
+agent/checks alias_test.go.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.telemetry import Metrics
+from consul_tpu.agent.bexpr import FilterError, create_filter
+from consul_tpu.net.security import (
+    Keyring,
+    SecurityError,
+    decode_key,
+    generate_key,
+)
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_aggregate_and_snapshot():
+    m = Metrics()
+    m.incr_counter("rpc.queries_blocking")
+    m.incr_counter("rpc.queries_blocking")
+    m.set_gauge("memberlist.health.score", 3)
+    m.add_sample("consul.fsm.kvs", 1.5)
+    m.add_sample("consul.fsm.kvs", 2.5)
+    snap = m.snapshot()
+    counters = {c["Name"]: c for c in snap["Counters"]}
+    assert counters["rpc.queries_blocking"]["Count"] == 2
+    gauges = {g["Name"]: g["Value"] for g in snap["Gauges"]}
+    assert gauges["memberlist.health.score"] == 3
+    samples = {s["Name"]: s for s in snap["Samples"]}
+    assert samples["consul.fsm.kvs"]["Mean"] == 2.0
+    assert samples["consul.fsm.kvs"]["Max"] == 2.5
+
+
+def test_metrics_emitted_by_live_cluster():
+    async def main():
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_http_dns import dev_stack, http_call
+        from consul_tpu.telemetry import metrics
+
+        metrics().reset()
+        async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+            await http_call(addr, "PUT", "/v1/kv/m1", b"x")
+            status, _, snap = await http_call(addr, "GET",
+                                              "/v1/agent/metrics")
+            assert status == 200
+            names = {c["Name"] for c in snap["Counters"]}
+            assert "http.PUT" in names and "http.GET" in names
+            sample_names = {s["Name"] for s in snap["Samples"]}
+            assert "consul.fsm.kvs" in sample_names
+            assert "http.request" in sample_names
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# bexpr ?filter=
+# ---------------------------------------------------------------------------
+
+ROWS = [
+    {"ServiceName": "web", "ServicePort": 80,
+     "ServiceTags": ["primary", "v2"],
+     "Node": {"Meta": {"env": "prod"}},
+     "Checks": [{"Status": "passing"}, {"Status": "warning"}]},
+    {"ServiceName": "db", "ServicePort": 5432,
+     "ServiceTags": [],
+     "Node": {"Meta": {}},
+     "Checks": [{"Status": "critical"}]},
+]
+
+
+def test_bexpr_operators():
+    f = create_filter('ServiceName == "web"')
+    assert f.apply(ROWS) == [ROWS[0]]
+    assert create_filter('ServiceName != "web"').apply(ROWS) == [ROWS[1]]
+    assert create_filter('"primary" in ServiceTags').apply(ROWS) == [ROWS[0]]
+    assert create_filter('"primary" not in ServiceTags').apply(ROWS) == [ROWS[1]]
+    assert create_filter('ServiceTags is empty').apply(ROWS) == [ROWS[1]]
+    assert create_filter('Node.Meta.env == "prod"').apply(ROWS) == [ROWS[0]]
+    assert create_filter('ServicePort == 5432').apply(ROWS) == [ROWS[1]]
+    assert create_filter('ServiceName matches "^w.b$"').apply(ROWS) == [ROWS[0]]
+    assert create_filter(
+        'Checks.Status == "critical" or ServicePort == 80'
+    ).apply(ROWS) == ROWS
+    assert create_filter(
+        'not (ServiceName == "db") and Checks.Status == "passing"'
+    ).apply(ROWS) == [ROWS[0]]
+
+
+def test_bexpr_errors():
+    with pytest.raises(FilterError):
+        create_filter('ServiceName == == "x"')
+    with pytest.raises(FilterError):
+        create_filter('ServiceName ==')
+    with pytest.raises(FilterError):
+        create_filter("")
+
+
+def test_http_filter_param():
+    async def main():
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (agent, addr, _dns, _dns_addr):
+            for name, port in (("web", 80), ("db", 5432)):
+                st, _, _x = await http_call(
+                    addr, "PUT", "/v1/catalog/register",
+                    json.dumps({"Node": f"n-{name}", "Address": "10.0.0.1",
+                                "Service": {"Service": name, "Port": port}}
+                               ).encode(),
+                )
+                assert st == 200
+            import urllib.parse
+
+            flt = urllib.parse.quote('ServiceName == "web"')
+            st, _, rows = await http_call(
+                addr, "GET", f"/v1/catalog/service/web?filter={flt}")
+            assert st == 200 and len(rows) == 1
+            st, _, rows = await http_call(
+                addr, "GET",
+                f"/v1/catalog/service/web?filter="
+                + urllib.parse.quote('ServicePort == 9999'))
+            assert st == 200 and rows == []
+            st, _, err = await http_call(
+                addr, "GET", "/v1/catalog/nodes?filter="
+                + urllib.parse.quote('Bogus =='))
+            assert st == 400
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# gossip encryption + keyring
+# ---------------------------------------------------------------------------
+
+
+def test_keyring_seal_open_and_rotation():
+    k1, k2 = generate_key(), generate_key()
+    ring = Keyring.from_b64(k1)
+    blob = ring.encrypt(b"gossip payload")
+    assert blob != b"gossip payload"
+    assert ring.decrypt(blob) == b"gossip payload"
+
+    # Rotation: install k2, switch primary, old ciphertext still opens.
+    ring.install(k2)
+    old_ct = ring.encrypt(b"before switch")
+    ring.use(k2)
+    assert ring.decrypt(old_ct) == b"before switch"
+    assert ring.primary_b64() == k2
+    with pytest.raises(ValueError):
+        ring.remove(k2)  # primary is protected
+    ring.remove(k1)
+    with pytest.raises(SecurityError):
+        ring.decrypt(old_ct)  # k1 is gone
+
+    stranger = Keyring.from_b64(generate_key())
+    with pytest.raises(SecurityError):
+        stranger.decrypt(ring.encrypt(b"secret"))
+
+
+def test_encrypted_cluster_forms_and_rejects_plaintext():
+    async def main():
+        from consul_tpu.eventing.cluster import Cluster, ClusterConfig
+        from consul_tpu.net.transport import InMemoryNetwork
+
+        key = generate_key()
+        net = InMemoryNetwork()
+
+        def mk(name, keyring):
+            return Cluster(
+                ClusterConfig(name=name, interval_scale=0.02,
+                              keyring=keyring),
+                net.new_transport(f"mem://{name}"),
+            )
+
+        c1 = mk("e1", Keyring.from_b64(key))
+        c2 = mk("e2", Keyring.from_b64(key))
+        intruder = mk("e3", None)  # no key
+        for c in (c1, c2, intruder):
+            await c.start()
+        assert await c2.join(["mem://e1"]) == 1
+        await wait_until(
+            lambda: len(c1.alive_members()) == 2
+            and len(c2.alive_members()) == 2,
+            msg="encrypted pair converges",
+        )
+        # A keyless node cannot join (its push/pull is rejected).
+        assert await intruder.join(["mem://e1"]) == 0
+        assert len(intruder.alive_members()) == 1
+        for c in (c1, c2, intruder):
+            await c.shutdown()
+
+    run(main())
+
+
+def test_cluster_wide_key_rotation_via_queries():
+    async def main():
+        from consul_tpu.eventing.cluster import Cluster, ClusterConfig
+        from consul_tpu.net.transport import InMemoryNetwork
+
+        k1, k2 = generate_key(), generate_key()
+        net = InMemoryNetwork()
+        nodes = [
+            Cluster(
+                ClusterConfig(name=f"k{i}", interval_scale=0.02,
+                              keyring=Keyring.from_b64(k1)),
+                net.new_transport(f"mem://k{i}"),
+            )
+            for i in range(3)
+        ]
+        for c in nodes:
+            await c.start()
+        for c in nodes[1:]:
+            await c.join(["mem://k0"])
+        await wait_until(
+            lambda: all(len(c.alive_members()) == 3 for c in nodes),
+            msg="encrypted trio",
+        )
+        # KeyManager dance: install -> use -> remove old, everywhere.
+        out = await nodes[0].install_key(k2)
+        assert not out["errors"] and out["num_resp"] >= 2
+        out = await nodes[0].use_key(k2)
+        assert not out["errors"]
+        out = await nodes[0].remove_key(k1)
+        assert not out["errors"]
+        out = await nodes[0].list_keys()
+        assert set(out["keys"]) == {k2}
+        # Gossip still flows on the new key.
+        await nodes[0].user_event("rotated", b"ok")
+        for c in nodes:
+            await c.shutdown()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# alias checks
+# ---------------------------------------------------------------------------
+
+
+def test_alias_check_mirrors_service_health():
+    async def main():
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_http_dns import dev_stack
+        from consul_tpu.store.state import HEALTH_CRITICAL, HEALTH_PASSING
+
+        async with dev_stack() as (agent, _addr, _dns, _dns_addr):
+            agent.add_service(
+                {"id": "web1", "service": "web", "port": 80},
+                checks=[{"check_id": "web-ttl", "name": "web ttl",
+                         "ttl": "60s"}],
+            )
+            agent.add_check({"check_id": "alias-web", "name": "alias web",
+                             "alias_service": "web1", "interval": "1s"})
+
+            def alias_status():
+                lc = agent.local.checks.get("alias-web")
+                return lc.check.get("status") if lc else None
+
+            # TTL check starts critical (untouched) -> alias critical.
+            await wait_until(
+                lambda: alias_status() == HEALTH_CRITICAL,
+                msg="alias mirrors critical",
+            )
+            # Heartbeat the TTL -> alias flips passing.
+            agent.update_ttl_check("web-ttl", HEALTH_PASSING, "beat")
+            await wait_until(
+                lambda: alias_status() == HEALTH_PASSING,
+                msg="alias mirrors passing",
+            )
+
+    run(main())
