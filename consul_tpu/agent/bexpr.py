@@ -91,10 +91,14 @@ def _resolve(row: Any, path: list[str]) -> list[Any]:
         values = nxt
         if not values:
             return []
-    # Final fan-out of trailing lists so `"x" in Tags` sees elements.
+    # Final fan-out: a trailing list selector exposes BOTH the list
+    # itself (so `in`/`is empty` see it) and its elements (so ==/matches
+    # compare against each element, go-bexpr any-match semantics).
     flat: list[Any] = []
     for v in values:
         flat.append(v)
+        if isinstance(v, list):
+            flat.extend(v)
     return flat
 
 

@@ -511,7 +511,13 @@ class PreparedQuery(_Endpoint):
         fwd = await self.server.forward("PreparedQuery.Execute", body, read=True)
         if fwd is not None:
             return fwd
-        query = self.server.store.prepared_query_resolve(body["query_id"])
+        if body.get("query") is not None:
+            # ExecuteRemote (prepared_query_endpoint.go:480): another
+            # DC's server shipped us the full query — queries are
+            # per-DC state, so failover carries the definition.
+            query = body["query"]
+        else:
+            query = self.server.store.prepared_query_resolve(body["query_id"])
         if query is None:
             return {"nodes": [], "service": "", "error": "query not found"}
         service = query["service"]["service"]
@@ -530,7 +536,51 @@ class PreparedQuery(_Endpoint):
         limit = int(query.get("limit", 0) or body.get("limit", 0) or 0)
         if limit:
             out = out[:limit]
+        if not out and body.get("query") is None:
+            remote = await self._execute_failover(query, body, limit)
+            if remote is not None:
+                return remote
         return {"nodes": out, "service": service, "meta": {"index": idx}}
+
+    async def _execute_failover(
+        self, query: dict, body: dict, limit: int
+    ) -> Optional[dict]:
+        """RTT-ranked cross-DC failover (prepared_query_endpoint.go
+        ExecuteRemote + queryFailover): when the local DC has no healthy
+        instances, walk the failover DCs — nearest_n by Vivaldi distance
+        over the WAN pool (router.go:534 GetDatacentersByDistance),
+        then any explicitly listed DCs — and return the first DC that
+        answers with instances."""
+        failover = (query.get("service") or {}).get("failover") or {}
+        nearest_n = int(failover.get("nearest_n", 0) or 0)
+        explicit = list(failover.get("datacenters") or ())
+        if nearest_n <= 0 and not explicit:
+            return None
+        ordered: list[str] = []
+        by_distance = [
+            dc for dc in self.server.router.get_datacenters_by_distance()
+            if dc != self.server.config.datacenter
+        ]
+        ordered.extend(by_distance[:nearest_n])
+        for dc in explicit:
+            if dc not in ordered and dc != self.server.config.datacenter:
+                ordered.append(dc)
+        for dc in ordered:
+            try:
+                out = await self.server._forward_dc(
+                    "PreparedQuery.Execute",
+                    {"query": query, "query_id": body.get("query_id", ""),
+                     "limit": limit, "dc": dc,
+                     "token": body.get("token", "")},
+                    dc,
+                )
+            except Exception:  # noqa: BLE001 - next DC
+                continue
+            if out and out.get("nodes"):
+                out["datacenter"] = dc
+                out["failovers"] = ordered.index(dc) + 1
+                return out
+        return None
 
 
 class Internal(_Endpoint):
@@ -625,6 +675,106 @@ class Operator(_Endpoint):
 def _wrap(idx_and_data: tuple[int, Any], key: str) -> tuple[int, dict]:
     idx, data = idx_and_data
     return idx, {key: data}
+
+
+class ConnectCA(_Endpoint):
+    """connect_ca_endpoint.go: roots + leaf signing.  The built-in CA
+    lives on the leader; the active root record is replicated so every
+    server serves Roots."""
+
+    async def roots(self, body: dict):
+        return await self._read(
+            "ConnectCA.Roots", body,
+            lambda ws: _wrap(self.server.store.ca_roots(ws=ws), "roots"),
+        )
+
+    async def sign(self, body: dict):
+        """Sign a leaf for a service (connect_ca_endpoint.go Sign):
+        leader-only (it holds the private key)."""
+        self.server.acl_check(
+            body, "service", body.get("service", ""), WRITE
+        )
+        fwd = await self.server.forward("ConnectCA.Sign", body)
+        if fwd is not None:
+            return fwd
+        ca = await self.server.connect_ca()
+        leaf = ca.sign_leaf(body["service"])
+        return {"leaf": leaf}
+
+
+class Intention(_Endpoint):
+    """intention_endpoint.go: CRUD + match + connect authorize."""
+
+    async def apply(self, body: dict):
+        intention = dict(body.get("intention") or {})
+        self.server.acl_check(
+            body, "service", intention.get("destination", ""), WRITE
+        )
+        if body.get("op") in ("create", "update"):
+            if not intention.get("destination"):
+                raise ValueError("intention requires a destination")
+            intention.setdefault("source", "*")
+            intention.setdefault("id", str(uuid.uuid4()))
+            intention.setdefault("action", "allow")
+            body = {**body, "intention": intention}
+        out = await self._write(
+            "Intention.Apply", MessageType.INTENTION, body
+        )
+        out.setdefault("intention", intention)
+        return out
+
+    async def list(self, body: dict):
+        return await self._read(
+            "Intention.List", body,
+            lambda ws: _wrap(self.server.store.intention_list(ws=ws),
+                             "intentions"),
+        )
+
+    async def get(self, body: dict):
+        def run(ws):
+            idx, rec = self.server.store.intention_get(body["id"], ws=ws)
+            return idx, {"intentions": [rec] if rec else []}
+
+        return await self._read("Intention.Get", body, run)
+
+    async def match(self, body: dict):
+        self.server.acl_check(
+            body, "service", body.get("destination", ""), READ
+        )
+        return await self._read(
+            "Intention.Match", body,
+            lambda ws: _wrap(
+                self.server.store.intention_match(
+                    body.get("destination", ""), ws=ws
+                ),
+                "intentions",
+            ),
+        )
+
+    async def check(self, body: dict):
+        """Connect authorize core (intention_endpoint.go Check +
+        consul/intention_endpoint.go Test): walk matching intentions by
+        precedence; first source match decides; default follows the ACL
+        default policy (intentions deny-by-default only when ACLs
+        do)."""
+        self.server.acl_check(
+            body, "service", body.get("destination", ""), READ
+        )
+        source = body.get("source", "")
+        _, matches = self.server.store.intention_match(
+            body.get("destination", "")
+        )
+        for intention in matches:
+            if intention["source"] in (source, "*"):
+                return {
+                    "allowed": intention.get("action", "allow") == "allow",
+                    "reason": f"matched intention {intention['id']}",
+                }
+        default_allow = (
+            not self.server.acl.enabled
+            or self.server.acl.default_policy == "allow"
+        )
+        return {"allowed": default_allow, "reason": "default policy"}
 
 
 class ACL(_Endpoint):
@@ -827,6 +977,8 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "Internal": Internal(server),
         "Operator": Operator(server),
         "ACL": ACL(server),
+        "ConnectCA": ConnectCA(server),
+        "Intention": Intention(server),
         "Snapshot": Snapshot(server),
         "Subscribe": Subscribe(server),
     }

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import time
 from typing import Any, Callable, Optional
 
 from consul_tpu.consensus.raft import FSM, Entry
@@ -66,6 +67,11 @@ class MessageType(enum.IntEnum):
     SNAPSHOT_RESTORE = 96
 
 
+_METRIC_NAMES = {
+    int(t): f"consul.fsm.{t.name.lower()}" for t in MessageType
+}
+
+
 class ConsulFSM(FSM):
     """Applies committed raft entries to a :class:`StateStore`.
 
@@ -98,6 +104,8 @@ class ConsulFSM(FSM):
             MessageType.PREPARED_QUERY: self._apply_prepared_query,
             MessageType.TXN: self._apply_txn,
             MessageType.AUTOPILOT: self._apply_autopilot,
+            MessageType.INTENTION: self._apply_intention,
+            MessageType.CONNECT_CA: self._apply_connect_ca,
             MessageType.SNAPSHOT_RESTORE: self._apply_snapshot_restore,
             MessageType.ACL_TOKEN_SET: self._apply_acl_token_set,
             MessageType.ACL_TOKEN_DELETE: self._apply_acl_token_delete,
@@ -123,13 +131,10 @@ class ConsulFSM(FSM):
             else None
         )
         try:
-            import time as _time
-
-            _t0 = _time.monotonic()
+            _t0 = time.monotonic()
             result = handler(entry.index, body)
             metrics().measure_since(
-                f"consul.fsm.{MessageType(msg_type & ~IGNORE_UNKNOWN_FLAG).name.lower()}",
-                _t0,
+                _METRIC_NAMES[msg_type & ~IGNORE_UNKNOWN_FLAG], _t0
             )
         except (ValueError, KeyError, TypeError) as e:
             # Domain errors (bad registration, missing session, malformed
@@ -340,6 +345,23 @@ class ConsulFSM(FSM):
                 return False
         self.store.config_entry_set(idx, cfg)
         return True
+
+    def _apply_intention(self, idx: int, body: dict) -> Any:
+        """fsm intention ops (commands_oss.go applyIntentionOperation)."""
+        op = body["op"]
+        if op in ("create", "update"):
+            self.store.intention_set(idx, body["intention"])
+            return body["intention"]["id"]
+        if op == "delete":
+            return self.store.intention_delete(idx, body["intention"]["id"])
+        raise ValueError(f"invalid intention operation {op!r}")
+
+    def _apply_connect_ca(self, idx: int, body: dict) -> Any:
+        """CA root records replicated through raft (connect_ca ops)."""
+        if body.get("op") == "set-root":
+            self.store.ca_root_set(idx, body["root"])
+            return True
+        raise ValueError(f"invalid connect-ca operation {body.get('op')!r}")
 
     def _apply_snapshot_restore(self, idx: int, body: dict) -> Any:
         """Install a user snapshot on every replica at the same log

@@ -46,6 +46,7 @@ log = logging.getLogger("consul_tpu.http")
 _ACRONYMS = {
     "Id": "ID", "Ttl": "TTL", "Dns": "DNS", "Http": "HTTP", "Tcp": "TCP",
     "Rpc": "RPC", "Wan": "WAN", "Lan": "LAN", "Cas": "CAS", "Acl": "ACL",
+    "Pem": "PEM", "Uri": "URI", "Ca": "CA",
 }
 
 
@@ -402,6 +403,19 @@ class HTTPApi:
         # operator
         r("GET", r"/v1/operator/raft/configuration", self.operator_raft)
         r("GET", r"/v1/operator/autopilot/health", self.operator_health)
+        # connect (http_register.go /v1/connect/* + agent connect)
+        r("GET", r"/v1/connect/ca/roots", self.connect_ca_roots)
+        r("GET", r"/v1/agent/connect/ca/roots", self.connect_ca_roots)
+        r("GET", r"/v1/agent/connect/ca/leaf/(?P<svc>.+)",
+          self.connect_ca_leaf)
+        r("POST", r"/v1/connect/intentions", self.intention_create)
+        r("GET", r"/v1/connect/intentions/check", self.intention_check)
+        r("GET", r"/v1/connect/intentions/(?P<iid>.+)", self.intention_get)
+        r("GET", r"/v1/connect/intentions", self.intention_list)
+        r("PUT", r"/v1/connect/intentions/(?P<iid>.+)", self.intention_update)
+        r("DELETE", r"/v1/connect/intentions/(?P<iid>.+)",
+          self.intention_delete)
+        r("POST", r"/v1/agent/connect/authorize", self.connect_authorize)
         # keyring (operator_endpoint.go /v1/operator/keyring)
         r("GET", r"/v1/operator/keyring", self.keyring_list)
         r("POST", r"/v1/operator/keyring", self.keyring_install)
@@ -914,6 +928,83 @@ class HTTPApi:
             **req.dc_option(),
         })
         return HTTPResponse(200, out.get("result", True))
+
+    # -- connect -------------------------------------------------------------
+
+    async def connect_ca_roots(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ConnectCA.Roots", dict(req.query_options()))
+        roots = out.get("roots") or []
+        return HTTPResponse(200, {
+            "active_root_id": next(
+                (r["id"] for r in roots if r.get("active")), ""
+            ),
+            "roots": roots,
+        }, headers=_meta_headers(out.get("meta")))
+
+    async def connect_ca_leaf(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ConnectCA.Sign", {
+            "service": m.group("svc"), **req.dc_option(),
+        })
+        return HTTPResponse(200, out.get("leaf"))
+
+    async def intention_create(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Intention.Apply", {
+            "op": "create", "intention": _decamelize(req.json()),
+            **req.dc_option(),
+        })
+        return HTTPResponse(200, {"id": out.get("result")})
+
+    async def intention_update(self, req, m) -> HTTPResponse:
+        intention = _decamelize(req.json())
+        intention["id"] = m.group("iid")
+        out = await self.agent.rpc("Intention.Apply", {
+            "op": "update", "intention": intention, **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result")))
+
+    async def intention_delete(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Intention.Apply", {
+            "op": "delete", "intention": {"id": m.group("iid")},
+            **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result")))
+
+    async def intention_get(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Intention.Get", {
+            "id": m.group("iid"), **req.query_options(),
+        })
+        rows = out.get("intentions") or []
+        if not rows:
+            return HTTPResponse(404, {"error": "intention not found"})
+        return HTTPResponse(200, rows[0],
+                            headers=_meta_headers(out.get("meta")))
+
+    async def intention_list(self, req, m) -> HTTPResponse:
+        return await self._rpc_read(req, "Intention.List", {}, "intentions")
+
+    async def intention_check(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("Intention.Check", {
+            "source": req.query.get("source", ""),
+            "destination": req.query.get("destination", ""),
+            **req.query_options(),
+        })
+        return HTTPResponse(200, {"allowed": out.get("allowed", False)})
+
+    async def connect_authorize(self, req, m) -> HTTPResponse:
+        """agent_endpoint.go AgentConnectAuthorize: a proxy presents the
+        client cert's SPIFFE URI; authorization = intention check on
+        (client service -> target service)."""
+        body = _decamelize(req.json())
+        target = body.get("target", "")
+        uri = body.get("client_cert_uri", "")
+        source = uri.rsplit("/svc/", 1)[-1] if "/svc/" in uri else uri
+        out = await self.agent.rpc("Intention.Check", {
+            "source": source, "destination": target, **req.dc_option(),
+        })
+        return HTTPResponse(200, {
+            "authorized": out.get("allowed", False),
+            "reason": out.get("reason", ""),
+        })
 
     # -- keyring -------------------------------------------------------------
 

@@ -1,0 +1,11 @@
+"""Connect service mesh core: built-in CA + SPIFFE identities +
+intention-based authorization (agent/connect + agent/consul connect
+endpoints; proxycfg/xDS are out of scope — no Envoy in this world)."""
+
+from consul_tpu.connect.ca import (
+    BuiltinCA,
+    spiffe_service,
+    verify_leaf,
+)
+
+__all__ = ["BuiltinCA", "spiffe_service", "verify_leaf"]

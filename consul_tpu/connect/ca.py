@@ -1,0 +1,171 @@
+"""Connect built-in CA: root generation, leaf signing, rotation.
+
+Equivalent of the reference's built-in CA provider
+(``agent/connect/ca/provider_consul.go`` + ``agent/connect/``): an EC
+P-256 root certificate per datacenter signs short-lived leaf
+certificates whose URI SAN is the service's SPIFFE identity
+
+    spiffe://<trust-domain>/ns/default/dc/<dc>/svc/<service>
+
+(``agent/connect/uri_service.go``).  Rotation generates a new root and
+marks it active; old roots stay in the store so already-issued leaves
+keep verifying until they expire (``leader_connect.go`` root
+rotation — cross-signing is not modeled).
+"""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from typing import Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+LEAF_TTL = datetime.timedelta(hours=72)   # ca config LeafCertTTL default
+ROOT_TTL = datetime.timedelta(days=10 * 365)
+
+
+def spiffe_service(trust_domain: str, dc: str, service: str) -> str:
+    return f"spiffe://{trust_domain}/ns/default/dc/{dc}/svc/{service}"
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+class BuiltinCA:
+    """One datacenter's signing authority."""
+
+    def __init__(self, dc: str, trust_domain: Optional[str] = None):
+        self.dc = dc
+        self.trust_domain = trust_domain or f"{uuid.uuid4()}.consul"
+        self._key: Optional[ec.EllipticCurvePrivateKey] = None
+        self._cert: Optional[x509.Certificate] = None
+        self.root_id = ""
+
+    # ------------------------------------------------------------------
+    # roots
+    # ------------------------------------------------------------------
+
+    def generate_root(self) -> dict:
+        """A fresh self-signed root (provider_consul.go GenerateRoot);
+        returns the store record for connect_ca_roots."""
+        self._key = ec.generate_private_key(ec.SECP256R1())
+        self.root_id = str(uuid.uuid4())
+        name = x509.Name([
+            x509.NameAttribute(
+                NameOID.COMMON_NAME, f"Consul CA {self.root_id[:8]}"
+            ),
+        ])
+        now = _now()
+        self._cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(self._key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=1))
+            .not_valid_after(now + ROOT_TTL)
+            .add_extension(
+                x509.BasicConstraints(ca=True, path_length=0), critical=True
+            )
+            .add_extension(
+                x509.SubjectAlternativeName([
+                    x509.UniformResourceIdentifier(
+                        f"spiffe://{self.trust_domain}"
+                    )
+                ]),
+                critical=False,
+            )
+            .sign(self._key, hashes.SHA256())
+        )
+        return {
+            "id": self.root_id,
+            "name": f"Consul CA Root Cert",
+            "root_cert": self.root_pem(),
+            "trust_domain": self.trust_domain,
+            "active": True,
+        }
+
+    def root_pem(self) -> str:
+        assert self._cert is not None
+        return self._cert.public_bytes(serialization.Encoding.PEM).decode()
+
+    def rotate(self) -> dict:
+        """New active root; the caller stores it (old roots retained)."""
+        return self.generate_root()
+
+    # ------------------------------------------------------------------
+    # leaves
+    # ------------------------------------------------------------------
+
+    def sign_leaf(self, service: str) -> dict:
+        """Issue a leaf for a service (provider_consul.go Sign): EC key
+        + cert with the SPIFFE URI SAN, signed by the active root."""
+        assert self._cert is not None and self._key is not None
+        key = ec.generate_private_key(ec.SECP256R1())
+        uri = spiffe_service(self.trust_domain, self.dc, service)
+        now = _now()
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name([
+                x509.NameAttribute(NameOID.COMMON_NAME, service),
+            ]))
+            .issuer_name(self._cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=1))
+            .not_valid_after(now + LEAF_TTL)
+            .add_extension(
+                x509.SubjectAlternativeName(
+                    [x509.UniformResourceIdentifier(uri)]
+                ),
+                critical=False,
+            )
+            .add_extension(
+                x509.BasicConstraints(ca=False, path_length=None),
+                critical=True,
+            )
+            .sign(self._key, hashes.SHA256())
+        )
+        return {
+            "service": service,
+            "uri": uri,
+            "cert_pem": cert.public_bytes(
+                serialization.Encoding.PEM
+            ).decode(),
+            "key_pem": key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            ).decode(),
+            "root_id": self.root_id,
+            "valid_before": cert.not_valid_after_utc.isoformat(),
+        }
+
+
+def verify_leaf(leaf_pem: str, root_pem: str) -> Optional[str]:
+    """Verify a leaf against a root; returns its SPIFFE URI when valid,
+    None otherwise (connect/tls.go verification core)."""
+    try:
+        leaf = x509.load_pem_x509_certificate(leaf_pem.encode())
+        root = x509.load_pem_x509_certificate(root_pem.encode())
+        leaf.verify_directly_issued_by(root)
+    except Exception:  # noqa: BLE001 - any failure = invalid
+        return None
+    now = _now()
+    if not (leaf.not_valid_before_utc <= now <= leaf.not_valid_after_utc):
+        return None
+    try:
+        san = leaf.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName
+        )
+        uris = san.value.get_values_for_type(
+            x509.UniformResourceIdentifier
+        )
+        return uris[0] if uris else None
+    except x509.ExtensionNotFound:
+        return None

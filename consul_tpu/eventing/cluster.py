@@ -164,6 +164,9 @@ class ClusterConfig:
     # AES-GCM gossip keyring (memberlist/security.go + serf/keymanager);
     # rotated cluster-wide through internal queries.
     keyring: Optional["Keyring"] = None
+    # Event coalescing windows (serf/coalesce.go; 0 = deliver raw).
+    coalesce_period_s: float = 0.0
+    quiescent_period_s: float = 0.0
 
 
 def encode_tags(tags: dict[str, str]) -> bytes:
@@ -214,6 +217,19 @@ class Cluster:
         # cache is serf's coordClient/coordCache pair, serf.go:82-90).
         self.vivaldi = VivaldiClient() if config.coordinates else None
         self.coord_cache: dict[str, "Coordinate"] = {}
+
+        # Event coalescer shim (serf/coalesce.go): bursty member/user
+        # events collapse to their latest state per subject.
+        self._coalescer = None
+        if config.coalesce_period_s > 0:
+            from consul_tpu.eventing.coalesce import Coalescer
+
+            self._coalescer = Coalescer(
+                self._emit_raw,
+                config.coalesce_period_s * config.interval_scale,
+                (config.quiescent_period_s or config.coalesce_period_s / 4)
+                * config.interval_scale,
+            )
 
         # Gossip snapshot: replay BEFORE the clocks first tick so the
         # restored Lamport times dedup pre-crash events (snapshot.go
@@ -353,6 +369,8 @@ class Cluster:
     async def shutdown(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._coalescer is not None:
+            self._coalescer.stop()
         if self.snapshotter is not None:
             self.snapshotter.close()
         await self.memberlist.shutdown()
@@ -781,6 +799,11 @@ class Cluster:
         self._emit(Event(type=EventType.MEMBER_UPDATE, members=[m]))
 
     def _emit(self, event: Event) -> None:
+        if self._coalescer is not None and self._coalescer.handle(event):
+            return
+        self._emit_raw(event)
+
+    def _emit_raw(self, event: Event) -> None:
         if self.snapshotter is not None:
             self.snapshotter.update_clock(
                 self.clock.time(),

@@ -99,6 +99,17 @@ def _schemas() -> list[TableSchema]:
         TableSchema("prepared_queries", primary=lambda r: _b(r["id"])),
         TableSchema("acl_tokens", primary=lambda r: _b(r["secret_id"])),
         TableSchema("acl_policies", primary=lambda r: _b(r["id"])),
+        # Connect: service-to-service intentions + CA roots
+        # (state/intention.go, state/connect_ca.go).
+        TableSchema(
+            "intentions",
+            primary=lambda r: _b(r["id"]),
+            indexes=(
+                IndexSchema("destination",
+                            key=lambda r: _b(r["destination"])),
+            ),
+        ),
+        TableSchema("connect_ca_roots", primary=lambda r: _b(r["id"])),
         TableSchema("index", primary=lambda r: _b(r["key"])),
     ]
 
@@ -897,6 +908,80 @@ class StateStore:
         self._bump(tx, idx, "acl_policies")
         tx.commit()
         return True
+
+    # -- connect: intentions + CA roots (state/intention.go) ----------------
+
+    @_writer
+    def intention_set(self, idx: int, intention: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("intentions", _b(intention["id"]))
+        rec = dict(intention)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("intentions", rec)
+        self._bump(tx, idx, "intentions")
+        tx.commit()
+
+    def intention_get(self, iid: str, ws=None):
+        tx = self.db.txn()
+        return self.max_index("intentions", tx=tx), tx.get(
+            "intentions", _b(iid), ws=ws
+        )
+
+    def intention_list(self, ws=None):
+        tx = self.db.txn()
+        return self.max_index("intentions", tx=tx), tx.records(
+            "intentions", ws=ws
+        )
+
+    @_writer
+    def intention_delete(self, idx: int, iid: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.get("intentions", _b(iid)) is None:
+            tx.abort()
+            return False
+        tx.delete("intentions", _b(iid))
+        self._bump(tx, idx, "intentions")
+        tx.commit()
+        return True
+
+    def intention_match(self, destination: str, ws=None):
+        """Intentions whose destination matches the service exactly or
+        by wildcard, most precedent first (state/intention.go
+        IntentionMatch: exact > wildcard)."""
+        tx = self.db.txn()
+        idx = self.max_index("intentions", tx=tx)
+        out = [
+            r for r in tx.records("intentions", ws=ws)
+            if r["destination"] in (destination, "*")
+        ]
+        out.sort(key=lambda r: (r["destination"] == "*",
+                                r.get("source", "*") == "*"))
+        return idx, out
+
+    @_writer
+    def ca_root_set(self, idx: int, root: dict) -> None:
+        tx = self.db.txn(write=True)
+        if root.get("active"):
+            # Only one active root at a time (connect_ca.go).
+            for r in tx.records("connect_ca_roots"):
+                if r.get("active") and r["id"] != root["id"]:
+                    r = dict(r)
+                    r["active"] = False
+                    tx.insert("connect_ca_roots", r)
+        rec = dict(root)
+        existing = tx.get("connect_ca_roots", _b(root["id"]))
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("connect_ca_roots", rec)
+        self._bump(tx, idx, "connect_ca_roots")
+        tx.commit()
+
+    def ca_roots(self, ws=None):
+        tx = self.db.txn()
+        return self.max_index("connect_ca_roots", tx=tx), tx.records(
+            "connect_ca_roots", ws=ws
+        )
 
     # ------------------------------------------------------------------
     # transactions (state/txn.go TxnRW / TxnRO)

@@ -1,0 +1,232 @@
+"""Connect MVP (built-in CA, SPIFFE leaves, intentions), prepared-query
+cross-DC failover, and serf event coalescing.
+
+Parity models: agent/connect/ca/provider_consul_test.go,
+consul/intention_endpoint_test.go, consul/prepared_query_endpoint_test
+(queryFailover), serf/coalesce_test.go.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.connect import BuiltinCA, spiffe_service, verify_leaf
+
+
+def run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# ---------------------------------------------------------------------------
+# CA unit
+# ---------------------------------------------------------------------------
+
+
+def test_ca_root_and_leaf_lifecycle():
+    ca = BuiltinCA("dc1")
+    root = ca.generate_root()
+    assert root["active"] and root["trust_domain"] == ca.trust_domain
+
+    leaf = ca.sign_leaf("web")
+    expected_uri = spiffe_service(ca.trust_domain, "dc1", "web")
+    assert leaf["uri"] == expected_uri
+    assert "BEGIN CERTIFICATE" in leaf["cert_pem"]
+    assert "BEGIN PRIVATE KEY" in leaf["key_pem"]
+
+    # The leaf verifies against the signing root and yields its URI.
+    assert verify_leaf(leaf["cert_pem"], root["root_cert"]) == expected_uri
+
+    # ...but not against an unrelated root.
+    other = BuiltinCA("dc1")
+    other_root = other.generate_root()
+    assert verify_leaf(leaf["cert_pem"], other_root["root_cert"]) is None
+
+
+def test_ca_rotation_keeps_old_root_verifiable():
+    ca = BuiltinCA("dc1")
+    root1 = ca.generate_root()
+    leaf1 = ca.sign_leaf("db")
+    root2 = ca.rotate()
+    leaf2 = ca.sign_leaf("db")
+    assert root1["id"] != root2["id"]
+    # New leaves chain to the new root; old leaves still chain to the
+    # old (retained) root.
+    assert verify_leaf(leaf2["cert_pem"], root2["root_cert"]) is not None
+    assert verify_leaf(leaf1["cert_pem"], root1["root_cert"]) is not None
+    assert verify_leaf(leaf1["cert_pem"], root2["root_cert"]) is None
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_connect_http_leaf_and_intentions():
+    async def main():
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+            # Leaf + roots: the leaf must verify against the active root.
+            st, _, leaf = await http_call(
+                addr, "GET", "/v1/agent/connect/ca/leaf/web")
+            assert st == 200, leaf
+            st, _, roots = await http_call(addr, "GET", "/v1/connect/ca/roots")
+            assert st == 200 and roots["Roots"]
+            active = next(
+                r for r in roots["Roots"] if r["ID"] == roots["ActiveRootID"]
+            )
+            assert verify_leaf(leaf["CertPEM"], active["RootCert"]) \
+                == leaf["URI"]
+
+            # Intentions: deny web -> db, everything else default-allow
+            # (ACLs disabled).
+            st, _, created = await http_call(
+                addr, "POST", "/v1/connect/intentions",
+                json.dumps({"SourceName": "web", "DestinationName": "db"}
+                           ).encode())
+            # Our shape uses source/destination.
+            st, _, created = await http_call(
+                addr, "POST", "/v1/connect/intentions",
+                json.dumps({"Source": "web", "Destination": "db",
+                            "Action": "deny"}).encode())
+            assert st == 200 and created["ID"]
+
+            st, _, out = await http_call(
+                addr, "GET",
+                "/v1/connect/intentions/check?source=web&destination=db")
+            assert st == 200 and out["Allowed"] is False
+            st, _, out = await http_call(
+                addr, "GET",
+                "/v1/connect/intentions/check?source=api&destination=db")
+            assert st == 200 and out["Allowed"] is True
+
+            # Wildcard deny beats default but loses to exact allow.
+            st, _, _x = await http_call(
+                addr, "POST", "/v1/connect/intentions",
+                json.dumps({"Source": "*", "Destination": "db",
+                            "Action": "deny"}).encode())
+            st, _, _x = await http_call(
+                addr, "POST", "/v1/connect/intentions",
+                json.dumps({"Source": "billing", "Destination": "db",
+                            "Action": "allow"}).encode())
+            st, _, out = await http_call(
+                addr, "GET",
+                "/v1/connect/intentions/check?source=billing&destination=db")
+            assert out["Allowed"] is True
+            st, _, out = await http_call(
+                addr, "GET",
+                "/v1/connect/intentions/check?source=other&destination=db")
+            assert out["Allowed"] is False
+
+            # Proxy authorize with the leaf's SPIFFE URI as client cert.
+            st, _, out = await http_call(
+                addr, "POST", "/v1/agent/connect/authorize",
+                json.dumps({"Target": "db",
+                            "ClientCertURI": leaf["URI"]}).encode())
+            assert st == 200 and out["Authorized"] is False  # web->db deny
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# prepared-query cross-DC failover
+# ---------------------------------------------------------------------------
+
+
+def test_prepared_query_fails_over_to_remote_dc():
+    async def main():
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_multidc_host import start_two_dcs, shutdown_all
+
+        dc1, dc2 = await start_two_dcs()
+        # Failover needs the WAN view: wait until every dc1 server's
+        # router can see dc2 (the flooder finishes federating).
+        await wait_until(
+            lambda: all(
+                "dc2" in s.router.servers_by_dc() for s in dc1
+            ),
+            msg="dc1 servers see dc2 over WAN",
+        )
+        # 'web' exists ONLY in dc2.
+        await dc2[0].rpc_client.call(
+            "b0.dc2:rpc", "Catalog.Register",
+            {"node": "n2", "address": "10.2.0.1",
+             "service": {"id": "web1", "service": "web", "port": 80}},
+        )
+        out = await dc1[0].rpc_client.call(
+            "a0.dc1:rpc", "PreparedQuery.Apply",
+            {"op": "create",
+             "query": {"name": "find-web",
+                       "service": {"service": "web",
+                                   "failover": {"nearest_n": 1}}}},
+        )
+        qid = out["result"]
+        res = await dc1[0].rpc_client.call(
+            "a0.dc1:rpc", "PreparedQuery.Execute", {"query_id": qid}
+        )
+        assert res["nodes"], res
+        assert res["datacenter"] == "dc2"
+        assert res["failovers"] == 1
+        assert res["nodes"][0]["service"]["id"] == "web1"
+        await shutdown_all(*dc1, *dc2)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_member_event_coalescing():
+    async def main():
+        from consul_tpu.eventing.cluster import (
+            Cluster,
+            ClusterConfig,
+            EventType,
+        )
+        from consul_tpu.net.transport import InMemoryNetwork
+
+        net = InMemoryNetwork()
+        events = []
+        c1 = Cluster(
+            ClusterConfig(name="c1", interval_scale=0.02,
+                          coalesce_period_s=10.0,  # * scale = 200ms
+                          on_event=lambda ev: events.append(ev)),
+            net.new_transport("mem://c1"),
+        )
+        await c1.start()
+        others = []
+        for i in range(4):
+            c = Cluster(ClusterConfig(name=f"m{i}", interval_scale=0.02),
+                        net.new_transport(f"mem://m{i}"))
+            await c.start()
+            await c.join(["mem://c1"])
+            others.append(c)
+        # A burst of joins coalesces: wait past the window, then the
+        # join events arrive batched (fewer events than joins, members
+        # grouped by type), not one per transition.
+        await wait_until(
+            lambda: sum(
+                len(e.members)
+                for e in events
+                if e.type == EventType.MEMBER_JOIN
+            ) >= 5,
+            msg="all joins delivered (coalesced)",
+        )
+        join_events = [e for e in events if e.type == EventType.MEMBER_JOIN]
+        total_members = sum(len(e.members) for e in join_events)
+        assert total_members >= 5
+        assert len(join_events) < total_members  # batching happened
+        for c in [c1] + others:
+            await c.shutdown()
+
+    run(main())
