@@ -66,6 +66,9 @@ class AgentConfig:
     rejoin_after_leave: bool = False
     # Gossip encryption key, base64 (config "encrypt"; consul keygen).
     encrypt_key: str = ""
+    # WAN replication (forwarded to ServerConfig).
+    primary_datacenter: str = ""
+    acl_replication_token: str = ""
 
 
 @dataclasses.dataclass
@@ -112,6 +115,8 @@ class Agent:
                     serf_snapshot_path=config.serf_snapshot_path,
                     rejoin_after_leave=config.rejoin_after_leave,
                     keyring=self.keyring,
+                    primary_datacenter=config.primary_datacenter,
+                    acl_replication_token=config.acl_replication_token,
                 ),
                 gossip_transport,
                 rpc_transport,

@@ -64,6 +64,9 @@ class RuntimeConfig:
     # <data_dir>/serf/local.snapshot (config "data_dir").
     data_dir: str = ""
     rejoin_after_leave: bool = False
+    # WAN replication (secondary DCs pull from the primary).
+    primary_datacenter: str = ""
+    acl_replication_token: str = ""
     bind_addr: str = "127.0.0.1"
     ports_http: int = 8500
     ports_dns: int = 8600

@@ -260,6 +260,17 @@ class HTTPApi:
         status_text = {200: "OK", 400: "Bad Request", 403: "Forbidden",
                        404: "Not Found", 405: "Method Not Allowed",
                        500: "Internal Server Error"}.get(resp.status, "OK")
+        encoding = ""
+        if (
+            "gzip" in req.headers.get("accept-encoding", "")
+            and len(payload) >= 256
+        ):
+            # http.go wraps handlers in gziphandler for the same cutoff
+            # class of responses.
+            import gzip as _gzip
+
+            payload = _gzip.compress(payload)
+            encoding = "gzip"
         # A handler-supplied Content-Type overrides the default (single
         # Content-Type per RFC 9110).
         extra = dict(resp.headers)
@@ -267,6 +278,8 @@ class HTTPApi:
         head = [f"HTTP/1.1 {resp.status} {status_text}",
                 f"Content-Type: {ctype}",
                 f"Content-Length: {len(payload)}"]
+        if encoding:
+            head.append(f"Content-Encoding: {encoding}")
         for k, v in extra.items():
             head.append(f"{k}: {v}")
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
@@ -339,6 +352,7 @@ class HTTPApi:
         r("GET", r"/v1/status/leader", self.status_leader)
         r("GET", r"/v1/status/peers", self.status_peers)
         # agent
+        r("GET", r"/v1/agent/host", self.agent_host)
         r("GET", r"/v1/agent/metrics", self.agent_metrics)
         r("GET", r"/v1/agent/self", self.agent_self)
         r("GET", r"/v1/agent/members", self.agent_members)
@@ -451,6 +465,29 @@ class HTTPApi:
             if data is None:
                 return HTTPResponse(404, None, headers=_meta_headers(meta))
         return HTTPResponse(200, data, headers=_meta_headers(meta))
+
+    async def agent_host(self, req, m) -> HTTPResponse:
+        """/v1/agent/host (agent/debug/host.go:20-40): platform info
+        for the debug bundle."""
+        import os
+        import platform
+        import sys as _sys
+        import time as _time
+
+        la = os.getloadavg() if hasattr(os, "getloadavg") else (0, 0, 0)
+        return HTTPResponse(200, KeyedMap({
+            "Host": KeyedMap({
+                "Hostname": platform.node(),
+                "OS": platform.system().lower(),
+                "Platform": platform.platform(),
+                "KernelArch": platform.machine(),
+                "Uptime": _time.monotonic(),
+            }),
+            "CPU": KeyedMap({"Count": os.cpu_count(),
+                             "LoadAvg": list(la)}),
+            "Runtime": KeyedMap({"Python": _sys.version.split()[0]}),
+            "CollectionTime": int(_time.time() * 1e9),
+        }))
 
     async def agent_metrics(self, req, m) -> HTTPResponse:
         """/v1/agent/metrics (agent_endpoint.go AgentMetrics): the

@@ -101,6 +101,12 @@ class ServerConfig:
     autopilot_grace_s: float = 10.0
     # Gossip encryption keyring (shared LAN/WAN, security.go).
     keyring: object = None
+    # WAN replication (leader.go:834-979 + {acl,config}_replication.go):
+    # non-primary DCs pull config entries + ACL policies/tokens from the
+    # primary and converge their local raft state.
+    primary_datacenter: str = ""
+    replication_interval_s: float = 30.0
+    acl_replication_token: str = ""
     # ACL system (agent/config: acl.enabled / default_policy / tokens.master).
     acl_enabled: bool = False
     acl_default_policy: str = "allow"   # "allow" | "deny"
@@ -562,6 +568,7 @@ class Server:
                 asyncio.create_task(self._session_ttl_loop()),
                 asyncio.create_task(self._coordinate_flush_loop()),
                 asyncio.create_task(self._autopilot_loop()),
+                asyncio.create_task(self._replication_loop()),
             ]
             self._reconcile_wake.set()
         else:
@@ -710,6 +717,117 @@ class Server:
                     await self.raft.remove_server(node_id)
             except Exception:
                 log.exception("autopilot loop failed")
+
+    def _is_secondary(self) -> bool:
+        return bool(
+            self.config.primary_datacenter
+            and self.config.primary_datacenter != self.config.datacenter
+        )
+
+    async def _replication_loop(self) -> None:
+        """Primary→secondary replication (config_replication.go +
+        acl_replication.go): rate-limited pull loops on the secondary's
+        leader; remote state is diffed against local and converged
+        through the local raft."""
+        if not self._is_secondary():
+            return
+        while not self._shutdown:
+            await asyncio.sleep(self.config.replication_interval_s)
+            try:
+                if self.raft is None or not self.raft.is_leader():
+                    continue
+                await self._replicate_config_entries()
+                await self._replicate_acl()
+            except Exception:
+                log.exception("replication round failed")
+
+    @staticmethod
+    def _strip_indexes(rec: dict) -> dict:
+        return {k: v for k, v in rec.items()
+                if k not in ("create_index", "modify_index")}
+
+    async def _replicate_config_entries(self) -> None:
+        primary = self.config.primary_datacenter
+        out = await self._forward_dc(
+            "ConfigEntry.List",
+            {"dc": primary, "token": self.config.acl_replication_token},
+            primary,
+        )
+        # Autopilot settings are per-DC (the reference keeps them in a
+        # separate table); never replicate or delete them.
+        remote = {(e["kind"], e["name"]): self._strip_indexes(e)
+                  for e in out.get("entries", [])
+                  if e.get("kind") != "autopilot-config"}
+        _, local_list = self.store.config_entries_by_kind(None)
+        local = {(e["kind"], e["name"]): self._strip_indexes(e)
+                 for e in local_list
+                 if e.get("kind") != "autopilot-config"}
+        for key, entry in remote.items():
+            if local.get(key) != entry:
+                await self.raft_apply(
+                    MessageType.CONFIG_ENTRY, {"op": "set", "entry": entry}
+                )
+        for kind, name in set(local) - set(remote):
+            await self.raft_apply(
+                MessageType.CONFIG_ENTRY,
+                {"op": "delete", "entry": {"kind": kind, "name": name}},
+            )
+
+    async def _replicate_acl(self) -> None:
+        """ACL policies + tokens from the primary (acl_replication.go;
+        needs an acl:write replication token or the primary redacts
+        secrets, which we refuse to store)."""
+        primary = self.config.primary_datacenter
+        token = self.config.acl_replication_token
+        pol_out = await self._forward_dc(
+            "ACL.PolicyList", {"dc": primary, "token": token}, primary
+        )
+        remote_pols = {p["id"]: self._strip_indexes(p)
+                       for p in pol_out.get("policies", [])}
+        _, local_list = self.store.acl_policy_list()
+        local_pols = {p["id"]: self._strip_indexes(p) for p in local_list}
+        for pid, pol in remote_pols.items():
+            if local_pols.get(pid) != pol:
+                await self.raft_apply(
+                    MessageType.ACL_POLICY_SET, {"policy": pol}
+                )
+        for pid in set(local_pols) - set(remote_pols):
+            await self.raft_apply(
+                MessageType.ACL_POLICY_DELETE, {"id": pid}
+            )
+
+        tok_out = await self._forward_dc(
+            "ACL.TokenList", {"dc": primary, "token": token}, primary
+        )
+        remote_toks = {}
+        for t in tok_out.get("tokens", []):
+            if t.get("secret_id") == "<hidden>":
+                log.warning(
+                    "ACL replication token lacks acl:write on the "
+                    "primary; skipping token replication"
+                )
+                break
+            remote_toks[t["secret_id"]] = self._strip_indexes(t)
+        else:
+            _, local_tok_list = self.store.acl_token_list()
+            local_toks = {t["secret_id"]: self._strip_indexes(t)
+                          for t in local_tok_list}
+            for sid, tok in remote_toks.items():
+                if local_toks.get(sid) != tok:
+                    await self.raft_apply(
+                        MessageType.ACL_TOKEN_SET, {"token": tok}
+                    )
+            for sid in set(local_toks) - set(remote_toks):
+                # DC-local tokens survive replication: management tokens
+                # (a secondary's own bootstrap) and tokens marked local
+                # (the reference's token.Local flag, acl_replication.go).
+                t = local_toks[sid]
+                if t.get("type") == "management" or t.get("local"):
+                    continue
+                await self.raft_apply(
+                    MessageType.ACL_TOKEN_DELETE, {"secret_id": sid}
+                )
+            self.acl.invalidate()
 
     async def _tombstone_gc_loop(self) -> None:
         """Time-based tombstone reaping (leader.go:292 + tombstone GC):

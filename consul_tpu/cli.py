@@ -133,6 +133,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("arg", nargs="?", default="",
                     help="JSON definition, id, or secret")
 
+    sp = cmd("debug", cmd_debug, "capture a debug bundle")
+    sp.add_argument("-output", default="consul-debug.tar.gz")
+
     cmd("keygen", cmd_keygen, "generate a gossip encryption key")
     sp = cmd("keyring", cmd_keyring, "manage gossip encryption keys")
     sp.add_argument("verb", choices=["list", "install", "use", "remove"])
@@ -216,6 +219,8 @@ async def cmd_agent(args) -> int:
             acl_master_token=rc.acl_master_token,
             acl_agent_token=rc.acl_agent_token,
             encrypt_key=rc.encrypt,
+            primary_datacenter=rc.primary_datacenter,
+            acl_replication_token=rc.acl_replication_token,
             serf_snapshot_path=(
                 str(Path(rc.data_dir) / "serf" / "local.snapshot")
                 if rc.data_dir and server_mode
@@ -512,6 +517,39 @@ async def cmd_acl(args) -> int:
     else:
         await c.acl.policy_delete(args.arg)
         print("deleted")
+    return 0
+
+
+async def cmd_debug(args) -> int:
+    """command/debug: capture agent state (self, members, metrics,
+    host) into a tar.gz bundle for offline analysis."""
+    import io
+    import tarfile
+    import time as _time
+
+    c = _client(args)
+    captures = {}
+    for name, path in (
+        ("self.json", "/v1/agent/self"),
+        ("members.json", "/v1/agent/members"),
+        ("metrics.json", "/v1/agent/metrics"),
+        ("host.json", "/v1/agent/host"),
+    ):
+        status, _, data = await c.request("GET", path)
+        captures[name] = json.dumps(
+            data if status == 200 else {"error": status}, indent=2,
+            default=str,
+        ).encode()
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for name, data in captures.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(data))
+    with open(args.output, "wb") as fh:
+        fh.write(buf.getvalue())
+    print(f"Saved debug bundle to {args.output}")
     return 0
 
 

@@ -786,12 +786,15 @@ class StateStore:
         )
 
     def config_entries_by_kind(
-        self, kind: str, ws: Optional[WatchSet] = None
+        self, kind: Optional[str], ws: Optional[WatchSet] = None
     ) -> tuple[int, list[dict]]:
+        """Entries of one kind, or ALL entries when kind is None (the
+        replication pull reads everything)."""
         tx = self.db.txn()
+        prefix = (_b(kind) + SEP) if kind else b""
         return (
             self.max_index("config_entries", tx=tx),
-            tx.records("config_entries", _b(kind) + SEP, ws=ws),
+            tx.records("config_entries", prefix, ws=ws),
         )
 
     @_writer

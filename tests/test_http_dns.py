@@ -45,6 +45,10 @@ async def http_call(addr, method, path, body=b"", headers=None):
     for line in head_lines[1:]:
         k, _, v = line.partition(":")
         hdrs[k.strip().lower()] = v.strip()
+    if hdrs.get("content-encoding") == "gzip":
+        import gzip as _gzip
+
+        payload = _gzip.decompress(payload)
     if hdrs.get("content-type", "").startswith("application/json"):
         data = json.loads(payload) if payload.strip() else None
     else:

@@ -1,0 +1,118 @@
+"""WAN replication: secondary datacenters pull config entries and ACL
+policies/tokens from the primary (config_replication.go +
+acl_replication.go, leader.go:834-979)."""
+
+import asyncio
+
+import pytest
+
+from helpers import wait_for as wait_until
+from helpers import wait_for_leader
+
+from consul_tpu.agent.server import Server, ServerConfig
+from consul_tpu.net.transport import InMemoryNetwork
+
+
+def run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def make_repl_server(lan, wan, rpc, name, dc, primary=""):
+    cfg = ServerConfig(
+        node_name=name,
+        datacenter=dc,
+        bootstrap_expect=1,
+        gossip_interval_scale=0.05,
+        reconcile_interval_s=0.2,
+        coordinate_update_period_s=0.1,
+        session_ttl_sweep_s=0.1,
+        flood_interval_s=0.1,
+        primary_datacenter=primary,
+        replication_interval_s=0.2,
+    )
+    return Server(
+        cfg,
+        gossip_transport=lan.new_transport(f"{name}.{dc}:gossip"),
+        rpc_transport=rpc.new_transport(f"{name}.{dc}:rpc"),
+        wan_transport=wan.new_transport(f"{name}.{dc}:wan"),
+    )
+
+
+class TestWANReplication:
+    async def test_config_and_acl_replicate_to_secondary(self):
+        lan1, lan2 = InMemoryNetwork(), InMemoryNetwork()
+        wan, rpc = InMemoryNetwork(), InMemoryNetwork()
+        p = make_repl_server(lan1, wan, rpc, "p0", "dc1")
+        s = make_repl_server(lan2, wan, rpc, "s0", "dc2", primary="dc1")
+        await p.start()
+        await s.start()
+        await wait_for_leader([p])
+        await wait_for_leader([s])
+        await s.join_wan(["p0.dc1:wan"])
+
+        # Writes land in the PRIMARY only.
+        await p.rpc_client.call(
+            "p0.dc1:rpc", "ConfigEntry.Apply",
+            {"op": "set", "entry": {"kind": "service-defaults",
+                                    "name": "web", "protocol": "http"}},
+        )
+        await p.rpc_client.call(
+            "p0.dc1:rpc", "ACL.PolicySet",
+            {"policy": {"id": "pol-1", "name": "ro", "rules": "{}"}},
+        )
+        await p.rpc_client.call(
+            "p0.dc1:rpc", "ACL.TokenSet",
+            {"acl_token": {"secret_id": "tok-1", "policies": ["pol-1"]}},
+        )
+
+        # The secondary's pull loop converges them.
+        await wait_until(
+            lambda: s.store.config_entry_get("service-defaults", "web")[1]
+            is not None,
+            timeout=15, msg="config entry replicated",
+        )
+        await wait_until(
+            lambda: s.store.acl_policy_get("pol-1") is not None,
+            timeout=15, msg="acl policy replicated",
+        )
+        await wait_until(
+            lambda: s.store.acl_token_get("tok-1") is not None,
+            timeout=15, msg="acl token replicated",
+        )
+        entry = s.store.config_entry_get("service-defaults", "web")[1]
+        assert entry["protocol"] == "http"
+
+        # Deletions replicate too.
+        await p.rpc_client.call(
+            "p0.dc1:rpc", "ConfigEntry.Apply",
+            {"op": "delete",
+             "entry": {"kind": "service-defaults", "name": "web"}},
+        )
+        await p.rpc_client.call(
+            "p0.dc1:rpc", "ACL.PolicyDelete", {"id": "pol-1"}
+        )
+        await wait_until(
+            lambda: s.store.config_entry_get("service-defaults", "web")[1]
+            is None,
+            timeout=15, msg="config entry deletion replicated",
+        )
+        await wait_until(
+            lambda: s.store.acl_policy_get("pol-1") is None,
+            timeout=15, msg="acl policy deletion replicated",
+        )
+        # The replicated world is usable locally: the token still
+        # resolves in dc2 (tokens were not deleted).
+        assert s.store.acl_token_get("tok-1") is not None
+
+        await p.shutdown()
+        await s.shutdown()
+
+    async def test_primary_runs_no_replication(self):
+        lan, wan, rpc = (InMemoryNetwork(), InMemoryNetwork(),
+                         InMemoryNetwork())
+        p = make_repl_server(lan, wan, rpc, "q0", "dc1", primary="dc1")
+        await p.start()
+        await wait_for_leader([p])
+        # primary == own dc: the loop exits immediately (no self-pull).
+        assert not p._is_secondary()
+        await p.shutdown()

@@ -254,6 +254,35 @@ def test_cluster_wide_key_rotation_via_queries():
     run(main())
 
 
+def test_agent_host_and_gzip():
+    """/v1/agent/host (debug/host.go) + gzip responses on
+    Accept-Encoding (http.go gziphandler)."""
+
+    async def main():
+        import gzip
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+            st, _, host = await http_call(addr, "GET", "/v1/agent/host")
+            assert st == 200
+            assert host["Host"]["Hostname"] and host["CPU"]["Count"] >= 1
+
+            # Big responses compress when the client asks (http_call
+            # transparently decompresses; the header proves it).
+            st, hdrs, decoded = await http_call(
+                addr, "GET", "/v1/agent/metrics",
+                headers={"Accept-Encoding": "gzip"},
+            )
+            assert st == 200
+            assert hdrs.get("content-encoding") == "gzip"
+            assert "Counters" in decoded
+
+    run(main())
+
+
 # ---------------------------------------------------------------------------
 # alias checks
 # ---------------------------------------------------------------------------
