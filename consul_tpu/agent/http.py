@@ -257,7 +257,8 @@ class HTTPApi:
             indent = 4 if req.flag("pretty") else None
             payload = (json.dumps(out, indent=indent) + "\n").encode()
             ctype = "application/json"
-        status_text = {200: "OK", 400: "Bad Request", 403: "Forbidden",
+        status_text = {200: "OK", 307: "Temporary Redirect",
+                       400: "Bad Request", 403: "Forbidden",
                        404: "Not Found", 405: "Method Not Allowed",
                        500: "Internal Server Error"}.get(resp.status, "OK")
         encoding = ""
@@ -348,6 +349,9 @@ class HTTPApi:
 
     def _register_routes(self) -> None:
         r = self._route
+        # UI (http.go handleUI when EnableUI; single-page here)
+        r("GET", r"/ui(?:/.*)?", self.ui_index)
+        r("GET", r"/", self.ui_redirect)
         # status
         r("GET", r"/v1/status/leader", self.status_leader)
         r("GET", r"/v1/status/peers", self.status_peers)
@@ -493,6 +497,19 @@ class HTTPApi:
         """/v1/agent/metrics (agent_endpoint.go AgentMetrics): the
         in-memory sink's aggregated view."""
         return HTTPResponse(200, KeyedMap(metrics().snapshot()))
+
+    async def ui_index(self, req, m) -> HTTPResponse:
+        from consul_tpu.agent.ui import UI_HTML
+
+        return HTTPResponse(
+            200, None, raw=UI_HTML.encode(),
+            headers={"Content-Type": "text/html; charset=utf-8"},
+        )
+
+    async def ui_redirect(self, req, m) -> HTTPResponse:
+        return HTTPResponse(
+            307, None, raw=b"", headers={"Location": "/ui"}
+        )
 
     # -- status ---------------------------------------------------------
 

@@ -795,6 +795,9 @@ class Server:
             await self.raft_apply(
                 MessageType.ACL_POLICY_DELETE, {"id": pid}
             )
+        # Replicated policy changes must flush cached authorizers even
+        # when token replication below is skipped.
+        self.acl.invalidate()
 
         tok_out = await self._forward_dc(
             "ACL.TokenList", {"dc": primary, "token": token}, primary
@@ -827,7 +830,7 @@ class Server:
                 await self.raft_apply(
                     MessageType.ACL_TOKEN_DELETE, {"secret_id": sid}
                 )
-            self.acl.invalidate()
+            self.acl.invalidate()  # token set changed too
 
     async def _tombstone_gc_loop(self) -> None:
         """Time-based tombstone reaping (leader.go:292 + tombstone GC):

@@ -7,5 +7,12 @@ from consul_tpu.connect.ca import (
     spiffe_service,
     verify_leaf,
 )
+from consul_tpu.connect.service import ConnectError, Service
 
-__all__ = ["BuiltinCA", "spiffe_service", "verify_leaf"]
+__all__ = [
+    "BuiltinCA",
+    "ConnectError",
+    "Service",
+    "spiffe_service",
+    "verify_leaf",
+]

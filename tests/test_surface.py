@@ -254,6 +254,28 @@ def test_cluster_wide_key_rotation_via_queries():
     run(main())
 
 
+def test_ui_served():
+    """/ui serves the single-page dashboard; / redirects to it
+    (http.go handleUI)."""
+
+    async def main():
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+            st, hdrs, body = await http_call(addr, "GET", "/ui")
+            assert st == 200
+            assert hdrs.get("content-type", "").startswith("text/html")
+            text = body.decode() if isinstance(body, bytes) else str(body)
+            assert "consul-tpu" in text and "/v1/catalog/services" in text
+            st, hdrs, _b = await http_call(addr, "GET", "/")
+            assert st == 307 and hdrs.get("location") == "/ui"
+
+    run(main())
+
+
 def test_agent_host_and_gzip():
     """/v1/agent/host (debug/host.go) + gzip responses on
     Accept-Encoding (http.go gziphandler)."""
