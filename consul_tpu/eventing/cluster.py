@@ -26,6 +26,7 @@ import asyncio
 import dataclasses
 import enum
 import logging
+import random
 import time
 from typing import Any, Callable, Optional
 
@@ -124,6 +125,7 @@ class QueryResponseHandle:
     name: str
     payload: bytes
     origin_addr: str
+    relay_factor: int = 0
 
     async def respond(self, payload: bytes) -> None:
         await self.cluster._send_query_response(self, payload)
@@ -444,6 +446,7 @@ class Cluster:
         payload: bytes,
         timeout_s: Optional[float] = None,
         want_ack: bool = False,
+        relay_factor: int = 0,
     ) -> QueryResult:
         """Broadcast a query and collect acks + (node, response) pairs
         until the timeout (serf query semantics; default timeout =
@@ -472,6 +475,7 @@ class Cluster:
             "addr": self.memberlist.transport.local_addr(),
             "node": self.config.name,
             "flags": QUERY_FLAG_ACK if want_ack else 0,
+            "relay_factor": relay_factor,
             "name": name,
             "payload": payload,
         }
@@ -490,8 +494,9 @@ class Cluster:
                         responses.get(), left
                     )
                     if kind == "ack":
-                        result.acks.append(node)
-                    else:
+                        if node not in result.acks:
+                            result.acks.append(node)
+                    elif node not in (n for n, _ in result.responses):
                         result.responses.append((node, payload))
                 except asyncio.TimeoutError:
                     break
@@ -525,11 +530,13 @@ class Cluster:
             name=msg["name"],
             payload=bytes(msg["payload"]),
             origin_addr=msg["addr"],
+            relay_factor=int(msg.get("relay_factor", 0)),
         )
         if msg["flags"] & QUERY_FLAG_ACK and msg["node"] != self.config.name:
+            # Acks are relayed like responses (query.go handleQuery
+            # relays the ack through relayFactor members too).
             asyncio.ensure_future(
-                self._send_direct(
-                    SerfMessageType.QUERY_RESPONSE,
+                self._respond_with_relay(
                     {
                         "ltime": ltime,
                         "id": msg["id"],
@@ -538,6 +545,7 @@ class Cluster:
                         "payload": b"",
                     },
                     msg["addr"],
+                    int(msg.get("relay_factor", 0)),
                 )
             )
         if msg["name"].startswith("_serf_"):
@@ -559,17 +567,50 @@ class Cluster:
     async def _send_query_response(
         self, handle: QueryResponseHandle, payload: bytes
     ) -> None:
-        await self._send_direct(
-            SerfMessageType.QUERY_RESPONSE,
-            {
-                "ltime": handle.ltime,
-                "id": handle.id,
-                "from": self.config.name,
-                "flags": 0,
-                "payload": payload,
-            },
-            handle.origin_addr,
+        body = {
+            "ltime": handle.ltime,
+            "id": handle.id,
+            "from": self.config.name,
+            "flags": 0,
+            "payload": payload,
+        }
+        await self._respond_with_relay(
+            body, handle.origin_addr, handle.relay_factor
         )
+
+    async def _respond_with_relay(
+        self, body: dict, origin_addr: str, relay_factor: int
+    ) -> None:
+        """Direct send + relay redundancy (serf query.go relayResponse):
+        the message also travels through relay_factor random members so
+        a lossy direct path doesn't lose it; the originator dedups by
+        node.  A failing direct send must not abort the relays — they
+        exist for exactly that case."""
+        try:
+            await self._send_direct(
+                SerfMessageType.QUERY_RESPONSE, body, origin_addr
+            )
+        except Exception:  # noqa: BLE001 - relays below still fire
+            log.debug("direct query response failed", exc_info=True)
+        if relay_factor <= 0:
+            return
+        inner = bytes([SerfMessageType.QUERY_RESPONSE]) + msgpack.packb(
+            body, use_bin_type=True
+        )
+        candidates = [
+            m for m in self.alive_members()
+            if m.name != self.config.name and m.addr != origin_addr
+        ]
+        random.shuffle(candidates)
+        for m in candidates[:relay_factor]:
+            try:
+                await self._send_direct(
+                    SerfMessageType.RELAY,
+                    {"dest_addr": origin_addr, "payload": inner},
+                    m.addr,
+                )
+            except Exception:  # noqa: BLE001 - best-effort per relay
+                log.debug("relay send failed", exc_info=True)
 
     def _handle_query_response(self, msg: dict) -> None:
         q = self._query_responses.get(msg["id"])
@@ -710,17 +751,28 @@ class Cluster:
         metrics().set_gauge("serf.queue.Event", len(self._broadcast_queue))
         return self._broadcast_queue.get_broadcasts(overhead, limit)
 
-    async def _send_direct(self, t: SerfMessageType, body: dict, addr: str) -> None:
+    async def _forward_relay(self, body: dict) -> None:
+        try:
+            await self._send_raw(bytes(body["payload"]), body["dest_addr"])
+        except Exception:  # noqa: BLE001 - relay is best-effort
+            log.debug("relay forward failed", exc_info=True)
+
+    async def _send_raw(self, serf_payload: bytes, addr: str) -> None:
+        """One serf message straight to an address, through the
+        memberlist seal so it stays encrypted when the keyring is on
+        (security.go applies to ALL packets)."""
         from consul_tpu.net import wire
 
-        payload = bytes([t]) + msgpack.packb(body, use_bin_type=True)
-        # Through the memberlist seal so query responses stay encrypted
-        # when the keyring is on (security.go applies to ALL packets).
         await self.memberlist.transport.write_to(
             self.memberlist._seal(
-                wire.encode(wire.MessageType.USER, payload)
+                wire.encode(wire.MessageType.USER, serf_payload)
             ),
             addr,
+        )
+
+    async def _send_direct(self, t: SerfMessageType, body: dict, addr: str) -> None:
+        await self._send_raw(
+            bytes([t]) + msgpack.packb(body, use_bin_type=True), addr
         )
 
     def _on_user_msg(self, payload: bytes) -> None:
@@ -735,6 +787,10 @@ class Cluster:
             rebroadcast = self._handle_query(body)
         elif t == SerfMessageType.QUERY_RESPONSE:
             self._handle_query_response(body)
+        elif t == SerfMessageType.RELAY:
+            # messages.go relayHeader: unwrap and forward the embedded
+            # message to its final destination (sealed like any packet).
+            asyncio.ensure_future(self._forward_relay(body))
         elif t == SerfMessageType.JOIN:
             rebroadcast = self._handle_join_intent(body)
         elif t == SerfMessageType.LEAVE:

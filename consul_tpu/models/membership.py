@@ -430,18 +430,19 @@ def membership_round(
         # Lifeguard health score: failed probes degrade, acked probes
         # recover (awareness.go:14-49 ApplyDelta call sites in
         # state.go probeNode / handleAckPayload).
+        # A failed probe matures into suspicion after the probe cycle
+        # plus the timeout scaled by the health score GOING INTO the
+        # probe (awareness.go:64 ScaleTimeout: a degraded observer waits
+        # longer, trading detection latency for false-positive
+        # immunity); the score then drifts with this probe's outcome.
+        can_pend = failed & (state.probe_pending_at == NEVER)
+        matures_at = (
+            t + cfg.probe_interval_ticks + awareness * cfg.probe_timeout_ticks
+        )
         awareness = jnp.clip(
             awareness + failed.astype(jnp.int32)
             - (probing & ~failed).astype(jnp.int32),
             0, cfg.profile.awareness_max_multiplier - 1,
-        )
-        # A failed probe matures into suspicion after the probe cycle
-        # plus the awareness-scaled timeout (awareness.go:64
-        # ScaleTimeout: a degraded observer waits longer, trading
-        # detection latency for false-positive immunity).
-        can_pend = failed & (state.probe_pending_at == NEVER)
-        matures_at = (
-            t + cfg.probe_interval_ticks + awareness * cfg.probe_timeout_ticks
         )
         probe_pending_at = jnp.where(
             can_pend, matures_at, state.probe_pending_at

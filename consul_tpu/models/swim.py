@@ -117,6 +117,10 @@ class SwimConfig:
         return self.profile.probe_interval_ticks
 
     @property
+    def probe_timeout_ticks(self) -> int:
+        return self.profile.probe_timeout_ticks
+
+    @property
     def confirmations_k(self) -> int:
         # state.go:1186-1196: k = SuspicionMult - 2, or 0 if n-2 < k.
         k = self.profile.suspicion_mult - 2
@@ -157,6 +161,7 @@ class SwimState(NamedTuple):
     tx_refute: jax.Array        # int32[n]
     ref_era: jax.Array          # int32[n]
     probe_pending_at: jax.Array # int32[n] — NEVER if no failed probe pending
+    awareness: jax.Array        # int32[n] — Lifeguard health score
     subject_inc: jax.Array      # int32 scalar — subject's own incarnation
     tick: jax.Array             # int32 scalar
 
@@ -176,6 +181,7 @@ def swim_init(cfg: SwimConfig) -> SwimState:
         tx_refute=z,
         ref_era=z,
         probe_pending_at=jnp.full((n,), NEVER, jnp.int32),
+        awareness=z,
         subject_inc=jnp.int32(0),
         tick=jnp.int32(0),
     )
@@ -200,7 +206,7 @@ def _lifeguard_timeout_ticks(cfg: SwimConfig, confirmations: jax.Array) -> jax.A
 def swim_round(state: SwimState, key: jax.Array, cfg: SwimConfig) -> SwimState:
     n, f = cfg.n, cfg.subject
     t = state.tick
-    k_gossip, k_loss, k_probe, k_pfail = jax.random.split(key, 4)
+    k_gossip, k_loss, k_probe, k_pfail, k_aware = jax.random.split(key, 5)
 
     subject_dead_now = jnp.logical_and(
         jnp.logical_not(cfg.subject_alive), t >= cfg.fail_at_tick
@@ -366,12 +372,36 @@ def swim_round(state: SwimState, key: jax.Array, cfg: SwimConfig) -> SwimState:
     )
     probe_failed = probed_f & bernoulli_mask(k_pfail, (n,), p_fail) & is_probe_tick
     # Failed probes mature into suspicion at the end of the probe cycle
-    # (direct timeout + indirect probes fill the interval, state.go:283-497).
-    matures_at = t + cfg.probe_interval_ticks
+    # (direct timeout + indirect probes fill the interval,
+    # state.go:283-497), stretched by the prober's health score going
+    # INTO the probe (awareness.go:64 ScaleTimeout — a degraded observer
+    # trades detection latency for false-positive immunity).
+    matures_at = (
+        t
+        + cfg.probe_interval_ticks
+        + state.awareness * cfg.probe_timeout_ticks
+    )
     probe_pending_at = jnp.where(
         probe_failed & (state.probe_pending_at == NEVER),
         matures_at,
         state.probe_pending_at,
+    )
+    # Health score drift (awareness.go ApplyDelta call sites): probes of
+    # ANY target move the score — failures (of the subject, or loss on a
+    # live peer) degrade it, successes recover it.
+    probing_any = is_probe_tick & can_send & not_subject
+    other_failed = (
+        probing_any
+        & ~probed_f
+        & bernoulli_mask(k_aware, (n,), cfg.probe_fail_prob_alive)
+    )
+    any_failed = probe_failed | other_failed
+    awareness = jnp.clip(
+        state.awareness
+        + any_failed.astype(jnp.int32)
+        - (probing_any & ~any_failed).astype(jnp.int32),
+        0,
+        cfg.profile.awareness_max_multiplier - 1,
     )
     # Mature pending probes -> local suspicion at the prober's current
     # incarnation for the subject + broadcast, if the view is still ALIVE
@@ -413,6 +443,7 @@ def swim_round(state: SwimState, key: jax.Array, cfg: SwimConfig) -> SwimState:
         tx_refute=tx_refute,
         ref_era=ref_era,
         probe_pending_at=probe_pending_at,
+        awareness=awareness,
         subject_inc=subject_inc,
         tick=t + 1,
     )

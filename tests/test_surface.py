@@ -305,6 +305,66 @@ def test_agent_host_and_gzip():
     run(main())
 
 
+def test_query_relay_factor_survives_blocked_direct_path():
+    """serf query.go relayResponse: with relay_factor, responses also
+    travel through random members, surviving a broken direct path."""
+
+    async def main():
+        from consul_tpu.eventing.cluster import (
+            Cluster,
+            ClusterConfig,
+            EventType,
+        )
+        from consul_tpu.net.transport import InMemoryNetwork
+
+        blocked: set = set()
+        net = InMemoryNetwork(
+            drop_fn=lambda payload, src, dst: (src, dst) in blocked
+        )
+
+        def responder(cluster):
+            def on_event(ev):
+                if ev.type == EventType.QUERY and ev.query:
+                    asyncio.ensure_future(
+                        ev.query.respond(cluster.config.name.encode())
+                    )
+            return on_event
+
+        nodes = []
+        for i in range(3):
+            c = Cluster(
+                ClusterConfig(name=f"q{i}", interval_scale=0.02),
+                net.new_transport(f"mem://q{i}"),
+            )
+            c.config.on_event = responder(c)
+            await c.start()
+            nodes.append(c)
+        for c in nodes[1:]:
+            await c.join(["mem://q0"])
+        await wait_until(
+            lambda: all(len(c.alive_members()) == 3 for c in nodes),
+            msg="trio forms",
+        )
+
+        # Sever the direct q1 -> q0 path.
+        blocked.add(("mem://q1", "mem://q0"))
+
+        # Without relay, q1's response is lost.
+        res = await nodes[0].query("ping", b"", timeout_s=1.0)
+        assert "q1" not in {n for n, _ in res.responses}
+
+        # With relay_factor, it arrives through q2 — acks included
+        # (query.go relays acks the same way).
+        res = await nodes[0].query("ping", b"", timeout_s=2.0,
+                                   relay_factor=2, want_ack=True)
+        assert {n for n, _ in res.responses} >= {"q1", "q2"}
+        assert "q1" in res.acks
+        for c in nodes:
+            await c.shutdown()
+
+    run(main())
+
+
 # ---------------------------------------------------------------------------
 # alias checks
 # ---------------------------------------------------------------------------
