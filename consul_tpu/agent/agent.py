@@ -69,6 +69,10 @@ class AgentConfig:
     # WAN replication (forwarded to ServerConfig).
     primary_datacenter: str = ""
     acl_replication_token: str = ""
+    # Client TLS bootstrap (agent/auto-config + auto_encrypt_endpoint):
+    # fetch an agent-kind SPIFFE leaf + CA roots from the servers at
+    # startup.
+    auto_encrypt: bool = False
 
 
 @dataclasses.dataclass
@@ -166,6 +170,7 @@ class Agent:
         self._config_checks: list[dict] = []
         self._config_service_ids: set[str] = set()
         self._config_check_ids: set[str] = set()
+        self.tls_identity = None  # auto-encrypt result (leaf + roots)
         self.events: list[UserEvent] = []  # dedup ring, newest last
         self.event_index = 0  # monotonic, the X-Consul-Index for /event/list
         self._event_seen: set[tuple[int, str]] = set()
@@ -230,6 +235,44 @@ class Agent:
     async def start(self) -> None:
         await self.delegate.start()
         self.syncer.start()
+        # TLS identity: servers mint theirs locally; clients ask the
+        # servers (auto-encrypt).  Stored as self.tls_identity =
+        # {"leaf": {...}, "roots": [...]} for transports/proxies to use.
+        if self.config.auto_encrypt and not self.is_server():
+            self._auto_encrypt_task = asyncio.create_task(
+                self._auto_encrypt_loop()
+            )
+
+    async def _auto_encrypt_loop(self) -> None:
+        """Fetch, then RENEW: retry with backoff until the servers
+        answer (a fresh client may join before a leader exists), and
+        re-sign at half the leaf's remaining lifetime so expiry and CA
+        rotation never strand a stale identity (auto_encrypt.go renews
+        at a fraction of the TTL)."""
+        backoff = 0.2
+        while True:
+            try:
+                out = await self.rpc(
+                    "AutoEncrypt.Sign", {"node": self.config.node_name}
+                )
+                self.tls_identity = out
+                log.info("auto-encrypt: TLS identity issued (%s)",
+                         out["leaf"]["uri"])
+                backoff = 0.2
+                import datetime
+
+                expires = datetime.datetime.fromisoformat(
+                    out["leaf"]["valid_before"]
+                )
+                remaining = (
+                    expires - datetime.datetime.now(datetime.timezone.utc)
+                ).total_seconds()
+                await asyncio.sleep(max(remaining / 2, 60.0))
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - keep retrying
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
 
     async def join(self, addrs: list[str]) -> int:
         return await self.delegate.join(addrs)
@@ -240,6 +283,9 @@ class Agent:
     async def shutdown(self) -> None:
         self.syncer.stop()
         self.cache.stop()
+        task = getattr(self, "_auto_encrypt_task", None)
+        if task is not None:
+            task.cancel()
         for runner in self.checks.values():
             runner.stop()
         await self.delegate.shutdown()

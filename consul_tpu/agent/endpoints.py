@@ -777,6 +777,21 @@ class Intention(_Endpoint):
         return {"allowed": default_allow, "reason": "default policy"}
 
 
+class AutoEncrypt(_Endpoint):
+    """consul/auto_encrypt_endpoint.go: a CLIENT agent bootstraps its
+    TLS identity — an agent-kind SPIFFE leaf + the CA roots — in one
+    RPC at startup, before it can do anything else."""
+
+    async def sign(self, body: dict):
+        fwd = await self.server.forward("AutoEncrypt.Sign", body)
+        if fwd is not None:
+            return fwd
+        ca = await self.server.connect_ca()
+        leaf = ca.sign_leaf(body.get("node", ""), kind="agent")
+        _, roots = self.server.store.ca_roots()
+        return {"leaf": leaf, "roots": roots}
+
+
 class ACL(_Endpoint):
     """acl_endpoint.go — token/policy CRUD + one-shot bootstrap.
 
@@ -977,6 +992,7 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "Internal": Internal(server),
         "Operator": Operator(server),
         "ACL": ACL(server),
+        "AutoEncrypt": AutoEncrypt(server),
         "ConnectCA": ConnectCA(server),
         "Intention": Intention(server),
         "Snapshot": Snapshot(server),

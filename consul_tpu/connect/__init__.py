@@ -4,6 +4,7 @@ endpoints; proxycfg/xDS are out of scope — no Envoy in this world)."""
 
 from consul_tpu.connect.ca import (
     BuiltinCA,
+    spiffe_agent,
     spiffe_service,
     verify_leaf,
 )
@@ -13,6 +14,7 @@ __all__ = [
     "BuiltinCA",
     "ConnectError",
     "Service",
+    "spiffe_agent",
     "spiffe_service",
     "verify_leaf",
 ]

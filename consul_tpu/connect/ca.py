@@ -32,6 +32,11 @@ def spiffe_service(trust_domain: str, dc: str, service: str) -> str:
     return f"spiffe://{trust_domain}/ns/default/dc/{dc}/svc/{service}"
 
 
+def spiffe_agent(trust_domain: str, dc: str, node: str) -> str:
+    """agent/connect/uri_agent.go SpiffeIDAgent."""
+    return f"spiffe://{trust_domain}/agent/client/dc/{dc}/id/{node}"
+
+
 def _now() -> datetime.datetime:
     return datetime.datetime.now(datetime.timezone.utc)
 
@@ -102,12 +107,17 @@ class BuiltinCA:
     # leaves
     # ------------------------------------------------------------------
 
-    def sign_leaf(self, service: str) -> dict:
-        """Issue a leaf for a service (provider_consul.go Sign): EC key
-        + cert with the SPIFFE URI SAN, signed by the active root."""
+    def sign_leaf(self, service: str, kind: str = "service") -> dict:
+        """Issue a leaf (provider_consul.go Sign): EC key + cert with
+        the SPIFFE URI SAN, signed by the active root.  ``kind`` picks
+        the identity shape: a service, or an AGENT (auto-encrypt's
+        client TLS bootstrap, auto_encrypt_endpoint.go Sign)."""
         assert self._cert is not None and self._key is not None
         key = ec.generate_private_key(ec.SECP256R1())
-        uri = spiffe_service(self.trust_domain, self.dc, service)
+        if kind == "agent":
+            uri = spiffe_agent(self.trust_domain, self.dc, service)
+        else:
+            uri = spiffe_service(self.trust_domain, self.dc, service)
         now = _now()
         cert = (
             x509.CertificateBuilder()

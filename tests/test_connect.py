@@ -315,3 +315,50 @@ def test_member_event_coalescing():
             await c.shutdown()
 
     run(main())
+
+
+def test_auto_encrypt_client_bootstrap():
+    """auto_encrypt_endpoint.go Sign: a client agent fetches an
+    agent-kind SPIFFE leaf + CA roots from the servers at startup."""
+
+    async def main():
+        from consul_tpu.agent.agent import Agent, AgentConfig
+        from consul_tpu.net.transport import InMemoryNetwork
+
+        net = InMemoryNetwork()
+        server = Agent(
+            AgentConfig(node_name="srv", bootstrap_expect=1,
+                        gossip_interval_scale=0.05, sync_interval_s=0.3,
+                        sync_retry_interval_s=0.2,
+                        reconcile_interval_s=0.2),
+            gossip_transport=net.new_transport("srv:gossip"),
+            rpc_transport=net.new_transport("srv:rpc"),
+        )
+        await server.start()
+        await wait_until(lambda: server.delegate.is_leader(), msg="leader")
+
+        client = Agent(
+            AgentConfig(node_name="cli", server=False,
+                        gossip_interval_scale=0.05, sync_interval_s=0.3,
+                        sync_retry_interval_s=0.2, auto_encrypt=True),
+            gossip_transport=net.new_transport("cli:gossip"),
+            rpc_transport=net.new_transport("cli:rpc"),
+        )
+        await client.start()
+        await client.join(["srv:gossip"])
+
+        await wait_until(
+            lambda: client.tls_identity is not None,
+            timeout=15, msg="auto-encrypt identity issued",
+        )
+        ident = client.tls_identity
+        leaf, roots = ident["leaf"], ident["roots"]
+        assert "/agent/client/dc/dc1/id/cli" in leaf["uri"]
+        active = next(r for r in roots if r.get("active"))
+        assert verify_leaf(leaf["cert_pem"], active["root_cert"]) \
+            == leaf["uri"]
+
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
