@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import functools
 import json
 import logging
 import re
@@ -48,9 +49,6 @@ _ACRONYMS = {
     "Rpc": "RPC", "Wan": "WAN", "Lan": "LAN", "Cas": "CAS", "Acl": "ACL",
     "Pem": "PEM", "Uri": "URI", "Ca": "CA",
 }
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=4096)
