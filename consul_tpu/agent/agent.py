@@ -280,6 +280,10 @@ class Agent:
     async def leave(self) -> None:
         await self.delegate.leave()
 
+    async def force_leave(self, node: str) -> bool:
+        """agent.go ForceLeave -> serf.RemoveFailedNode."""
+        return await self.serf.remove_failed_node(node)
+
     async def shutdown(self) -> None:
         self.syncer.stop()
         self.cache.stop()

@@ -354,6 +354,8 @@ class HTTPApi:
         r("GET", r"/v1/status/leader", self.status_leader)
         r("GET", r"/v1/status/peers", self.status_peers)
         # agent
+        r("PUT", r"/v1/agent/force-leave/(?P<node>.+)",
+          self.agent_force_leave)
         r("GET", r"/v1/agent/host", self.agent_host)
         r("GET", r"/v1/agent/metrics", self.agent_metrics)
         r("GET", r"/v1/agent/self", self.agent_self)
@@ -467,6 +469,12 @@ class HTTPApi:
             if data is None:
                 return HTTPResponse(404, None, headers=_meta_headers(meta))
         return HTTPResponse(200, data, headers=_meta_headers(meta))
+
+    async def agent_force_leave(self, req, m) -> HTTPResponse:
+        ok = await self.agent.force_leave(m.group("node"))
+        if not ok:
+            return HTTPResponse(404, {"error": "member not failed"})
+        return HTTPResponse(200, True)
 
     async def agent_host(self, req, m) -> HTTPResponse:
         """/v1/agent/host (agent/debug/host.go:20-40): platform info

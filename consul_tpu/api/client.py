@@ -280,6 +280,13 @@ class AgentAPI(_NS):
     async def join(self, addr: str):
         return await self.c.write("PUT", f"/v1/agent/join/{addr}")
 
+    async def force_leave(self, node: str):
+        import urllib.parse as _up
+
+        return await self.c.write(
+            "PUT", f"/v1/agent/force-leave/{_up.quote(node, safe='')}"
+        )
+
     async def leave(self):
         return await self.c.write("PUT", "/v1/agent/leave")
 

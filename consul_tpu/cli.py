@@ -85,6 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = cmd("join", cmd_join, "join an agent to a cluster")
     sp.add_argument("addresses", nargs="+")
     cmd("leave", cmd_leave, "gracefully leave the cluster")
+    sp = cmd("force-leave", cmd_force_leave,
+             "force a failed member into the left state")
+    sp.add_argument("node")
     cmd("info", cmd_info, "agent runtime info")
 
     # kv -------------------------------------------------------------------
@@ -331,6 +334,12 @@ async def cmd_join(args) -> int:
 async def cmd_leave(args) -> int:
     await _client(args).agent.leave()
     print("Graceful leave complete")
+    return 0
+
+
+async def cmd_force_leave(args) -> int:
+    await _client(args).agent.force_leave(args.node)
+    print(f"Force-left {args.node}")
     return 0
 
 

@@ -351,6 +351,23 @@ class Cluster:
             )
         return n
 
+    async def remove_failed_node(self, name: str) -> bool:
+        """serf.go RemoveFailedNode: broadcast a leave intent on BEHALF
+        of a failed member, converting it to graceful LEFT everywhere so
+        it reaps on the (shorter) tombstone schedule instead of waiting
+        out the reconnect window."""
+        # No local-status precondition: the reference broadcasts
+        # unconditionally so the call works regardless of which agent
+        # is asked or how far its failure detection has progressed;
+        # only a completely unknown name is refused.
+        if name not in self.members:
+            return False
+        msg = {"ltime": self.clock.increment(), "node": name,
+               "prune": False}
+        self._handle_leave_intent(msg)
+        self._broadcast_intent(SerfMessageType.LEAVE, msg)
+        return True
+
     async def leave(self) -> None:
         """serf.go:690-740 Leave: broadcast the leave intent, then leave
         the memberlist."""

@@ -404,3 +404,49 @@ def test_alias_check_mirrors_service_health():
             )
 
     run(main())
+
+
+def test_force_leave_converts_failed_to_left():
+    """serf.go RemoveFailedNode via /v1/agent/force-leave: a failed
+    member is converted to graceful LEFT cluster-wide."""
+
+    async def main():
+        from consul_tpu.eventing.cluster import (
+            Cluster,
+            ClusterConfig,
+            MemberStatus,
+        )
+        from consul_tpu.net.transport import InMemoryNetwork
+
+        net = InMemoryNetwork()
+        nodes = []
+        for i in range(3):
+            c = Cluster(ClusterConfig(name=f"f{i}", interval_scale=0.02),
+                        net.new_transport(f"mem://f{i}"))
+            await c.start()
+            nodes.append(c)
+        for c in nodes[1:]:
+            await c.join(["mem://f0"])
+        await wait_until(
+            lambda: all(len(c.alive_members()) == 3 for c in nodes),
+            msg="trio forms",
+        )
+        await nodes[2].shutdown()
+        await wait_until(
+            lambda: nodes[0].members["f2"].status == MemberStatus.FAILED,
+            timeout=30, msg="f2 failed",
+        )
+        assert await nodes[0].remove_failed_node("f2") is True
+        await wait_until(
+            lambda: nodes[0].members["f2"].status == MemberStatus.LEFT
+            and nodes[1].members["f2"].status == MemberStatus.LEFT,
+            timeout=15, msg="force-leave propagates",
+        )
+        # Re-issuing is allowed (the reference broadcasts without a
+        # local-status precondition); only unknown names are refused.
+        assert await nodes[0].remove_failed_node("f2") is True
+        assert await nodes[0].remove_failed_node("ghost") is False
+        for c in nodes[:2]:
+            await c.shutdown()
+
+    run(main())
