@@ -152,6 +152,19 @@ class Authorizer:
     def key_write(self, key: str) -> bool:
         return self.allowed("key", key, WRITE)
 
+    def key_write_prefix(self, prefix: str) -> bool:
+        """Write over an entire subtree (acl.go KeyWritePrefix): the
+        prefix itself must resolve to write AND no configured key rule
+        underneath it may grant less than write — otherwise a delete-tree
+        on a parent could wipe an explicitly protected child."""
+        if not self.allowed("key", prefix, WRITE):
+            return False
+        return all(
+            _LEVEL[rule.policy] >= _LEVEL[WRITE]
+            for rule in self._rules["key"]
+            if rule.prefix.startswith(prefix)
+        )
+
     def service_read(self, name: str) -> bool:
         return self.allowed("service", name, READ)
 

@@ -252,7 +252,10 @@ class Agent:
         backoff = 0.2
         while True:
             try:
-                out = await self.rpc(
+                # As the AGENT identity: Sign requires node:write on our
+                # own name under ACL enforcement (auto_encrypt uses the
+                # configured tokens.agent, like anti-entropy writes).
+                out = await self._agent_rpc(
                     "AutoEncrypt.Sign", {"node": self.config.node_name}
                 )
                 self.tls_identity = out

@@ -19,7 +19,12 @@ from typing import TYPE_CHECKING, Any, Callable, Optional
 
 from consul_tpu.acl.engine import READ, WRITE
 from consul_tpu.agent.fsm import MessageType
-from consul_tpu.agent.rpc import QueryOptions, blocking_query
+from consul_tpu.agent.rpc import (
+    ERR_PERMISSION_DENIED,
+    QueryOptions,
+    RPCError,
+    blocking_query,
+)
 from consul_tpu.store.state import HEALTH_CRITICAL, HEALTH_PASSING
 
 if TYPE_CHECKING:
@@ -223,9 +228,15 @@ class KVS(_Endpoint):
     async def apply(self, body: dict):
         # kvs_endpoint.go:35-60 kvsPreApply: key write (+ the reference
         # also checks session perms for lock ops via the session's node).
-        self.server.acl_check(
-            body, "key", (body.get("entry") or {}).get("key", ""), WRITE
-        )
+        # delete-tree needs write over the ENTIRE subtree
+        # (acl.KeyWritePrefix) — a plain longest-prefix check on the
+        # prefix would let a parent-level token wipe a denied child.
+        key = (body.get("entry") or {}).get("key", "")
+        if body.get("op") == "delete-tree":
+            self.server.acl_check(body, "key", key, WRITE,
+                                  whole_subtree=True)
+        else:
+            self.server.acl_check(body, "key", key, WRITE)
         fwd = await self.server.forward("KVS.Apply", body)
         if fwd is not None:
             return fwd
@@ -586,6 +597,26 @@ class PreparedQuery(_Endpoint):
 class Internal(_Endpoint):
     """internal_endpoint.go — composite reads used by the UI/agent."""
 
+    async def acl_authorize(self, body: dict):
+        """Token → one permission verdict, for CLIENT agents that hold
+        no resolver of their own (consul/acl.go ResolveToken resolves
+        through servers from clients; collapsed to a single yes/no RPC
+        instead of shipping policy documents).  Answered by ANY server —
+        ACL tables are replicated state, so no leader forward (losing
+        the leader must not take client-side permission checks down)."""
+        from consul_tpu.acl.engine import PREFIX_RESOURCES, SCALAR_RESOURCES
+
+        kind = body.get("kind", "")
+        want = body.get("want", "")
+        if (kind not in PREFIX_RESOURCES + SCALAR_RESOURCES
+                or want not in (READ, WRITE)):
+            return {"allowed": False}
+        try:
+            self.server.acl_check(body, kind, body.get("name", ""), want)
+        except RPCError:
+            return {"allowed": False}
+        return {"allowed": True}
+
     async def node_info(self, body: dict):
         self.server.acl_check(body, "node", body.get("node", ""), READ)
 
@@ -783,6 +814,10 @@ class AutoEncrypt(_Endpoint):
     RPC at startup, before it can do anything else."""
 
     async def sign(self, body: dict):
+        # auto_encrypt_endpoint.go Sign: an anonymous caller must not be
+        # able to mint an agent identity for an arbitrary node — require
+        # node:write on the claimed node name (the intro/agent token).
+        self.server.acl_check(body, "node", body.get("node", ""), WRITE)
         fwd = await self.server.forward("AutoEncrypt.Sign", body)
         if fwd is not None:
             return fwd
@@ -956,10 +991,25 @@ class Subscribe(_Endpoint):
 
         topic = body["topic"]
         key = body.get("key", "")
+        # subscribe.go filterByAuth: resolve the subscriber's token up
+        # front and drop events its authorizer cannot read.  Re-resolve
+        # per event so token invalidation takes effect mid-stream.
+        def readable(ev) -> bool:
+            if not self.server.acl.enabled:
+                return True
+            authz = self.server.acl_resolve(body)
+            if ev.end_of_snapshot:
+                return True
+            if ev.topic == "kv":
+                return authz.key_read(ev.key)
+            return authz.service_read(ev.key)
+
         sub = self.server.publisher.subscribe(topic, key)
         try:
             while True:
                 ev = await sub.next()
+                if not readable(ev):
+                    continue
                 yield {
                     "topic": ev.topic,
                     "key": ev.key,

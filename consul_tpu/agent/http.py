@@ -455,6 +455,25 @@ class HTTPApi:
 
     # -- helpers --------------------------------------------------------
 
+    async def _acl_check(self, req: HTTPRequest, kind: str, name: str,
+                         want: str) -> None:
+        """Enforce one permission for agent-local HTTP operations.
+        Server agents hold the resolver and check in-process; CLIENT
+        agents resolve through their servers (consul/acl.go
+        ResolveToken) via Internal.ACLAuthorize — without that hop the
+        check would silently no-op exactly where keyring keys and
+        force-leave live."""
+        delegate = self.agent.delegate
+        if hasattr(delegate, "acl_check"):
+            delegate.acl_check({"token": req.token()}, kind, name, want)
+        elif self.agent.config.acl_enabled:
+            out = await self.agent.rpc("Internal.ACLAuthorize", {
+                "kind": kind, "name": name, "want": want,
+                "token": req.token(),
+            })
+            if not out.get("allowed"):
+                raise RPCError(ERR_PERMISSION_DENIED)
+
     async def _rpc_read(self, req: HTTPRequest, method: str, body: dict,
                         key: str, unwrap_single: bool = False,
                         row: Optional[Callable] = None) -> HTTPResponse:
@@ -471,6 +490,9 @@ class HTTPApi:
         return HTTPResponse(200, data, headers=_meta_headers(meta))
 
     async def agent_force_leave(self, req, m) -> HTTPResponse:
+        # agent_endpoint.go:499 AgentForceLeave requires operator:write —
+        # otherwise any caller can evict members.
+        await self._acl_check(req, "operator", "", "write")
         ok = await self.agent.force_leave(m.group("node"))
         if not ok:
             return HTTPResponse(404, {"error": "member not failed"})
@@ -837,15 +859,8 @@ class HTTPApi:
     # -- events -----------------------------------------------------------
 
     async def event_fire(self, req, m) -> HTTPResponse:
-        # event_endpoint.go Fire: event write on the name.  Enforced on
-        # server agents (which hold the resolver); client agents defer
-        # to the serf plane (deviation: the reference resolves through
-        # its servers from clients too).
-        delegate = self.agent.delegate
-        if hasattr(delegate, "acl_check"):
-            delegate.acl_check(
-                {"token": req.token()}, "event", m.group("name"), "write"
-            )
+        # event_endpoint.go Fire: event write on the name.
+        await self._acl_check(req, "event", m.group("name"), "write")
         eid = await self.agent.fire_event(m.group("name"), req.body)
         return HTTPResponse(200, {"id": eid, "name": m.group("name")})
 
@@ -1069,6 +1084,11 @@ class HTTPApi:
     # -- keyring -------------------------------------------------------------
 
     async def _keyring_op(self, req, op: str, need_key: bool) -> HTTPResponse:
+        # internal_endpoint.go:414-422: list needs keyring:read, the
+        # mutating ops keyring:write — without this an anonymous client
+        # could read the live gossip keys.
+        want = "read" if op == "list_keys" else "write"
+        await self._acl_check(req, "keyring", "", want)
         key = ""
         if need_key:
             body = _decamelize(req.json())

@@ -426,17 +426,26 @@ class Server:
         except ACLError as e:
             raise RPCError(str(e)) from e
 
-    def acl_check(self, body: dict, kind: str, name: str, want: str) -> None:
+    def acl_check(self, body: dict, kind: str, name: str, want: str,
+                  whole_subtree: bool = False) -> None:
         """Enforce one resource permission; raises the reference's
         'Permission denied' (acl.ErrPermissionDenied) on failure.
         Requests bound for another DC are enforced THERE — token tables
-        are per-datacenter (the reference replicates them; we don't)."""
+        are per-datacenter (the reference replicates them; we don't).
+        ``whole_subtree`` (key resource only) requires write over every
+        configured rule under the prefix (acl.go KeyWritePrefix) — the
+        delete-tree guard."""
         if not self.acl.enabled:
             return
         dc = body.get("dc")
         if dc and dc != self.config.datacenter:
             return
-        if not self.acl_resolve(body).allowed(kind, name, want):
+        authz = self.acl_resolve(body)
+        if whole_subtree:
+            ok = authz.key_write_prefix(name)
+        else:
+            ok = authz.allowed(kind, name, want)
+        if not ok:
             raise RPCError(ERR_PERMISSION_DENIED)
 
     def leader_rpc_addr(self) -> Optional[str]:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import ssl
 import tempfile
 from typing import Awaitable, Callable, Optional
@@ -162,17 +163,46 @@ class Service:
         h, p = server.sockets[0].getsockname()[:2]
         return server, f"{h}:{p}"
 
+    def _expect_uri(self, destination: str) -> str:
+        """Expected SPIFFE URI for a destination service, built from our
+        own leaf's trust domain + dc (connect/tls.go
+        verifyServerCertMatchesURI compares against the intended
+        CertURI, not just chain validity)."""
+        from consul_tpu.connect.ca import spiffe_service
+
+        m = re.match(r"spiffe://([^/]+)/ns/[^/]+/dc/([^/]+)/svc/", self.uri)
+        if not m:
+            raise ConnectError(f"cannot derive trust domain from {self.uri!r}")
+        return spiffe_service(m.group(1), m.group(2), destination)
+
     async def dial(
-        self, addr: str, timeout: float = 10.0
+        self, addr: str, destination: str = "", timeout: float = 10.0
     ) -> tuple[asyncio.StreamReader, asyncio.StreamWriter]:
-        """Connect to another service's mTLS listener."""
+        """Connect to another service's mTLS listener.
+
+        When ``destination`` is given, the server's URI SAN must be the
+        SPIFFE identity of that service — chain validity alone would let
+        any leaf-holding service impersonate any destination
+        (connect/tls.go verifyServerCertMatchesURI)."""
         host, port = addr.rsplit(":", 1)
-        return await asyncio.wait_for(
+        # Resolve the expected identity BEFORE connecting: an unset or
+        # malformed local leaf must not cost a handshake (or leak the
+        # opened connection through the raise below).
+        expect = self._expect_uri(destination) if destination else ""
+        reader, writer = await asyncio.wait_for(
             asyncio.open_connection(
                 host, int(port), ssl=self.client_context()
             ),
             timeout,
         )
+        if destination:
+            peer = self._peer_uri(writer)
+            if peer != expect:
+                writer.close()
+                raise ConnectError(
+                    f"server identity {peer!r} is not {destination!r}"
+                )
+        return reader, writer
 
     def close(self) -> None:
         import os

@@ -245,3 +245,153 @@ class TestBootstrap:
             st, _, err = await http_call(addr, "PUT", "/v1/acl/bootstrap")
             assert st == 400
             assert "no longer allowed" in str(err)
+
+
+class TestHardenedSurfaces:
+    """Round-3 ACL hardening: keyring, force-leave, AutoEncrypt.Sign,
+    Subscribe streaming, and delete-tree subtree checks (reference:
+    internal_endpoint.go:414-422, agent_endpoint.go:499,
+    subscribe.go filterByAuth, acl.go KeyWritePrefix)."""
+
+    async def test_keyring_requires_acl(self):
+        async with acl_stack() as (_agent, addr):
+            st, _, _b = await http_call(addr, "GET", "/v1/operator/keyring")
+            assert st == 403
+            st, _, _b = await http_call(
+                addr, "POST", "/v1/operator/keyring",
+                json.dumps({"Key": "x"}).encode())
+            assert st == 403
+            # Master passes the ACL gate (the op itself may 400 when
+            # gossip encryption is off — that is not a 403).
+            st, _, _b = await http_call(
+                addr, "GET", "/v1/operator/keyring",
+                headers={"X-Consul-Token": MASTER})
+            assert st != 403
+
+    async def test_client_agent_enforces_via_servers(self):
+        """CLIENT agents have no resolver — the check must resolve
+        through the servers (Internal.ACLAuthorize), not silently
+        no-op (consul/acl.go ResolveToken from non-servers)."""
+        async with acl_stack() as (server_agent, _addr):
+            net = server_agent.serf.memberlist.transport._net
+            client = Agent(
+                AgentConfig(node_name="c1", server=False,
+                            gossip_interval_scale=0.05, acl_enabled=True),
+                gossip_transport=net.new_transport("c1:gossip"),
+                rpc_transport=net.new_transport("c1:rpc"),
+            )
+            await client.start()
+            try:
+                await client.join(["dev:gossip"])
+                await wait_until(lambda: client.delegate.routers.servers(),
+                                 msg="client found server")
+                capi = HTTPApi(client)
+                caddr = await capi.start()
+                try:
+                    st, _, _b = await http_call(
+                        caddr, "GET", "/v1/operator/keyring")
+                    assert st == 403
+                    st, _, _b = await http_call(
+                        caddr, "PUT", "/v1/agent/force-leave/ghost")
+                    assert st == 403
+                    st, _, _b = await http_call(
+                        caddr, "GET", "/v1/operator/keyring",
+                        headers={"X-Consul-Token": MASTER})
+                    assert st != 403
+                finally:
+                    await capi.stop()
+            finally:
+                await client.shutdown()
+
+    async def test_force_leave_requires_operator_write(self):
+        async with acl_stack() as (_agent, addr):
+            st, _, _b = await http_call(
+                addr, "PUT", "/v1/agent/force-leave/ghost")
+            assert st == 403
+            st, _, _b = await http_call(
+                addr, "PUT", "/v1/agent/force-leave/ghost",
+                headers={"X-Consul-Token": MASTER})
+            assert st == 404  # gate passed; no such failed member
+
+    async def test_auto_encrypt_sign_requires_node_write(self):
+        from consul_tpu.agent.rpc import RPCError
+
+        async with acl_stack() as (agent, _addr):
+            with pytest.raises(RPCError, match="Permission denied"):
+                await agent.rpc("AutoEncrypt.Sign", {"node": "mallory"})
+            out = await agent.rpc(
+                "AutoEncrypt.Sign", {"node": "n1", "token": MASTER})
+            assert out["leaf"]["cert_pem"] and out["roots"]
+
+    async def test_subscribe_filters_unreadable_events(self):
+        async with acl_stack() as (agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            rules = json.dumps({"key_prefix": {"pub/": {"policy": "read"}}})
+            st, _, pol = await http_call(
+                addr, "PUT", "/v1/acl/policy",
+                json.dumps({"Name": "pubread", "Rules": rules}).encode(),
+                headers=mk)
+            assert st == 200
+            st, _, tok = await http_call(
+                addr, "PUT", "/v1/acl/token",
+                json.dumps({"Policies": [pol["ID"]]}).encode(), headers=mk)
+            assert st == 200
+            for k in ("pub/a", "priv/b"):
+                st, _, _x = await http_call(
+                    addr, "PUT", f"/v1/kv/{k}?token={MASTER}", b"v")
+                assert st == 200
+
+            server = agent.delegate
+            gen = server.rpc_server._endpoints["Subscribe"].subscribe(
+                {"topic": "kv", "token": tok["SecretID"]})
+            seen = []
+            async for ev in gen:
+                if ev.get("end_of_snapshot"):
+                    break
+                seen.append(ev["key"])
+            assert seen == ["pub/a"]  # priv/b filtered, not denied
+
+            # Live phase: the unreadable write never surfaces.
+            for k in ("priv/d", "pub/c"):
+                st, _, _x = await http_call(
+                    addr, "PUT", f"/v1/kv/{k}?token={MASTER}", b"v")
+                assert st == 200
+            ev = await asyncio.wait_for(gen.__anext__(), timeout=5)
+            assert ev["key"] == "pub/c"
+            await gen.aclose()
+
+    async def test_delete_tree_needs_write_on_whole_subtree(self):
+        async with acl_stack() as (_agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            rules = json.dumps({
+                "key_prefix": {"": {"policy": "write"},
+                               "app/secret/": {"policy": "deny"}},
+            })
+            st, _, pol = await http_call(
+                addr, "PUT", "/v1/acl/policy",
+                json.dumps({"Name": "almost-all", "Rules": rules}).encode(),
+                headers=mk)
+            assert st == 200
+            st, _, tok = await http_call(
+                addr, "PUT", "/v1/acl/token",
+                json.dumps({"Policies": [pol["ID"]]}).encode(), headers=mk)
+            assert st == 200
+            hdr = {"X-Consul-Token": tok["SecretID"]}
+            for k in ("app/a", "app/secret/s"):
+                st, _, _x = await http_call(
+                    addr, "PUT", f"/v1/kv/{k}?token={MASTER}", b"v")
+                assert st == 200
+
+            # Longest-prefix on "app/" alone would say write — but the
+            # subtree holds a denied child, so the recursive delete is
+            # refused outright (acl.KeyWritePrefix).
+            st, _, _x = await http_call(
+                addr, "DELETE", "/v1/kv/app/?recurse", headers=hdr)
+            assert st == 403
+            st, _, rows = await http_call(
+                addr, "GET", "/v1/kv/app/secret/s", headers=mk)
+            assert st == 200 and rows
+            # A subtree with no deny rules inside deletes fine.
+            st, _, ok = await http_call(
+                addr, "DELETE", "/v1/kv/other/?recurse", headers=hdr)
+            assert st == 200

@@ -212,6 +212,18 @@ def test_mtls_service_to_service():
             assert len(served) == handled_before
             assert served == [b"api"]  # only the authorized dial ran
 
+            # Destination pinning (connect/tls.go
+            # verifyServerCertMatchesURI): the TLS-level identity check
+            # is client-side, independent of intentions.  Expecting
+            # "web" matches web's leaf; expecting "db" must fail even
+            # though the leaf chains to the same roots.
+            r, w = await api.dial(srv_addr, destination="web")
+            w.close()
+            from consul_tpu.connect.service import ConnectError
+
+            with pytest.raises(ConnectError):
+                await api.dial(srv_addr, destination="db")
+
             server.close()
             web.close()
             api.close()
