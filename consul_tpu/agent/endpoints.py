@@ -417,7 +417,8 @@ class Txn(_Endpoint):
             want = READ if (not write or verb in ("get", "get-tree",
                                                   "check-index",
                                                   "check-session")) else WRITE
-            self.server.acl_check(body, "key", key, want)
+            self.server.acl_check(body, "key", key, want,
+                                  whole_subtree=(verb == "delete-tree"))
 
     async def apply(self, body: dict):
         self._check_txn_acls(body, write=True)
@@ -808,6 +809,33 @@ class Intention(_Endpoint):
         return {"allowed": default_allow, "reason": "default policy"}
 
 
+class DiscoveryChain(_Endpoint):
+    """discovery_chain_endpoint.go Get: compile one service's chain
+    from the current config entries, blocking on entry changes."""
+
+    async def get(self, body: dict):
+        from consul_tpu.connect.discoverychain import (
+            compile_chain,
+            entries_for_chain,
+        )
+
+        name = body.get("name", "")
+        self.server.acl_check(body, "service", name, READ)
+
+        def run(ws):
+            idx, entries = entries_for_chain(self.server.store, name, ws=ws)
+            chain = compile_chain(
+                name, self.server.config.datacenter, entries,
+                use_in_datacenter=body.get("use_in_datacenter", ""),
+                override_protocol=body.get("override_protocol", ""),
+                override_connect_timeout_s=float(
+                    body.get("override_connect_timeout_s", 0) or 0),
+            )
+            return max(idx, 1), {"chain": chain}
+
+        return await self._read("DiscoveryChain.Get", body, run)
+
+
 class AutoEncrypt(_Endpoint):
     """consul/auto_encrypt_endpoint.go: a CLIENT agent bootstraps its
     TLS identity — an agent-kind SPIFFE leaf + the CA roots — in one
@@ -1047,4 +1075,5 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "Intention": Intention(server),
         "Snapshot": Snapshot(server),
         "Subscribe": Subscribe(server),
+        "DiscoveryChain": DiscoveryChain(server),
     }

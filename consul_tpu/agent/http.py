@@ -414,6 +414,11 @@ class HTTPApi:
         r("PUT", r"/v1/txn", self.txn)
         # config entries
         r("PUT", r"/v1/config", self.config_apply)
+        # discovery chain (discovery_chain_endpoint.go /v1/discovery-chain/)
+        r("GET", r"/v1/discovery-chain/(?P<svc>[^/?]+)",
+          self.discovery_chain_get)
+        r("POST", r"/v1/discovery-chain/(?P<svc>[^/?]+)",
+          self.discovery_chain_get)
         r("GET", r"/v1/config/(?P<kind>[^/]+)/(?P<name>.+)", self.config_get)
         r("GET", r"/v1/config/(?P<kind>[^/]+)", self.config_list)
         r("DELETE", r"/v1/config/(?P<kind>[^/]+)/(?P<name>.+)",
@@ -1003,6 +1008,31 @@ class HTTPApi:
             **req.dc_option(),
         })
         return HTTPResponse(200, out.get("result", True))
+
+    async def discovery_chain_get(self, req, m) -> HTTPResponse:
+        """GET/POST /v1/discovery-chain/:service
+        (agent/discovery_chain_endpoint.go); POST bodies carry compile
+        overrides."""
+        body = {"name": m.group("svc"), **req.query_options()}
+        if req.method == "POST" and req.body:
+            overrides = _decamelize(req.json())
+            for k in ("override_protocol", "use_in_datacenter"):
+                if overrides.get(k):
+                    body[k] = overrides[k]
+            if overrides.get("override_connect_timeout_s"):
+                # Validate at the boundary: a malformed override is the
+                # caller's 400, not a server-side 500.
+                body["override_connect_timeout_s"] = float(
+                    overrides["override_connect_timeout_s"])
+        out = await self.agent.rpc("DiscoveryChain.Get", body)
+        chain = out.get("chain") or {}
+        # Node keys / target ids are DATA keys — shield them from
+        # camelization (their values still camelize normally).
+        chain = {**chain,
+                 "nodes": KeyedMap(chain.get("nodes") or {}),
+                 "targets": KeyedMap(chain.get("targets") or {})}
+        return HTTPResponse(200, {"chain": chain},
+                            headers=_meta_headers(out.get("meta")))
 
     # -- connect -------------------------------------------------------------
 

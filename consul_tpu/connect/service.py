@@ -163,32 +163,36 @@ class Service:
         h, p = server.sockets[0].getsockname()[:2]
         return server, f"{h}:{p}"
 
-    def _expect_uri(self, destination: str) -> str:
+    def _expect_uri(self, destination: str, dc: str = "") -> str:
         """Expected SPIFFE URI for a destination service, built from our
-        own leaf's trust domain + dc (connect/tls.go
+        own leaf's trust domain (connect/tls.go
         verifyServerCertMatchesURI compares against the intended
-        CertURI, not just chain validity)."""
+        CertURI, not just chain validity).  ``dc`` defaults to our own
+        datacenter; cross-DC targets (failover/redirect chains) pass
+        the target's datacenter."""
         from consul_tpu.connect.ca import spiffe_service
 
         m = re.match(r"spiffe://([^/]+)/ns/[^/]+/dc/([^/]+)/svc/", self.uri)
         if not m:
             raise ConnectError(f"cannot derive trust domain from {self.uri!r}")
-        return spiffe_service(m.group(1), m.group(2), destination)
+        return spiffe_service(m.group(1), dc or m.group(2), destination)
 
     async def dial(
-        self, addr: str, destination: str = "", timeout: float = 10.0
+        self, addr: str, destination: str = "", dc: str = "",
+        timeout: float = 10.0,
     ) -> tuple[asyncio.StreamReader, asyncio.StreamWriter]:
         """Connect to another service's mTLS listener.
 
         When ``destination`` is given, the server's URI SAN must be the
         SPIFFE identity of that service — chain validity alone would let
         any leaf-holding service impersonate any destination
-        (connect/tls.go verifyServerCertMatchesURI)."""
+        (connect/tls.go verifyServerCertMatchesURI).  ``dc`` pins a
+        cross-datacenter target's identity."""
         host, port = addr.rsplit(":", 1)
         # Resolve the expected identity BEFORE connecting: an unset or
         # malformed local leaf must not cost a handshake (or leak the
         # opened connection through the raise below).
-        expect = self._expect_uri(destination) if destination else ""
+        expect = self._expect_uri(destination, dc) if destination else ""
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(
                 host, int(port), ssl=self.client_context()

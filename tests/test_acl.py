@@ -395,3 +395,15 @@ class TestHardenedSurfaces:
             st, _, ok = await http_call(
                 addr, "DELETE", "/v1/kv/other/?recurse", headers=hdr)
             assert st == 200
+
+            # The same guard holds through /v1/txn (txn_endpoint.go
+            # vets each op like the single-op path).
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/txn",
+                json.dumps([{"KV": {"Verb": "delete-tree",
+                                    "Key": "app/"}}]).encode(),
+                headers=hdr)
+            assert st == 403
+            st, _, rows = await http_call(
+                addr, "GET", "/v1/kv/app/secret/s", headers=mk)
+            assert st == 200 and rows

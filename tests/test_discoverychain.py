@@ -1,0 +1,371 @@
+"""Discovery-chain compiler golden cases.
+
+Parity model: agent/consul/discoverychain/compile_test.go —
+TestCompile's table (trivial leaf, redirects, circular redirect,
+default subsets, failover expansion, splitter flattening, router
+catch-all, protocol gating/mismatch, external-SNI validation).
+"""
+
+import pytest
+
+from consul_tpu.connect.discoverychain import (
+    ChainCompileError,
+    compile_chain,
+    entries_for_chain,
+)
+
+
+def chain(service="web", entries=None, **kw):
+    return compile_chain(service, "dc1", entries or {}, **kw)
+
+
+def resolver_entry(name, **kw):
+    return {"kind": "service-resolver", "name": name, **kw}
+
+
+# ---------------------------------------------------------------------------
+# trivial / default
+# ---------------------------------------------------------------------------
+
+
+def test_default_chain_is_a_single_default_resolver():
+    c = chain()
+    assert c["protocol"] == "tcp"
+    assert c["start_node"] == "resolver:web@dc1"
+    node = c["nodes"]["resolver:web@dc1"]
+    assert node["type"] == "resolver"
+    assert node["resolver"]["default"] is True
+    assert node["resolver"]["connect_timeout_s"] == 5.0
+    assert set(c["targets"]) == {"web@dc1"}
+    assert c["targets"]["web@dc1"]["datacenter"] == "dc1"
+
+
+def test_connect_timeout_from_resolver_and_override():
+    e = {"resolvers": {"web": resolver_entry("web", connect_timeout_s=33.0)}}
+    assert chain(entries=e)["nodes"]["resolver:web@dc1"]["resolver"][
+        "connect_timeout_s"] == 33.0
+    c = chain(entries=e, override_connect_timeout_s=1.5)
+    assert c["nodes"]["resolver:web@dc1"]["resolver"][
+        "connect_timeout_s"] == 1.5
+
+
+# ---------------------------------------------------------------------------
+# redirects (compile.go:806-830)
+# ---------------------------------------------------------------------------
+
+
+def test_redirect_to_other_service_and_dc():
+    e = {"resolvers": {"web": resolver_entry(
+        "web", redirect={"service": "alt", "datacenter": "dc9"})}}
+    c = chain(entries=e)
+    assert c["start_node"] == "resolver:alt@dc9"
+    assert set(c["targets"]) == {"alt@dc9"}
+
+
+def test_redirect_chains_follow_through():
+    e = {"resolvers": {
+        "web": resolver_entry("web", redirect={"service": "mid"}),
+        "mid": resolver_entry("mid", redirect={"service": "leaf"}),
+    }}
+    c = chain(entries=e)
+    assert c["start_node"] == "resolver:leaf@dc1"
+
+
+def test_circular_redirect_is_an_error():
+    e = {"resolvers": {
+        "web": resolver_entry("web", redirect={"service": "alt"}),
+        "alt": resolver_entry("alt", redirect={"service": "web"}),
+    }}
+    with pytest.raises(ChainCompileError, match="circular resolver redirect"):
+        chain(entries=e)
+
+
+# ---------------------------------------------------------------------------
+# subsets (compile.go:833-846)
+# ---------------------------------------------------------------------------
+
+
+def test_default_subset_rewrites_target():
+    e = {"resolvers": {"web": resolver_entry(
+        "web", default_subset="v2",
+        subsets={"v2": {"filter": "Service.Meta.version == `2`"}})}}
+    c = chain(entries=e)
+    assert c["start_node"] == "resolver:web:v2@dc1"
+    t = c["targets"]["web:v2@dc1"]
+    assert t["filter"] == "Service.Meta.version == `2`"
+
+
+def test_unknown_subset_is_an_error():
+    e = {"resolvers": {"web": resolver_entry(
+        "web", default_subset="v9", subsets={"v2": {}})}}
+    with pytest.raises(ChainCompileError, match="does not have a subset"):
+        chain(entries=e)
+
+
+# ---------------------------------------------------------------------------
+# failover (compile.go:946-1010)
+# ---------------------------------------------------------------------------
+
+
+def test_failover_datacenters_expand_to_targets():
+    e = {"resolvers": {"web": resolver_entry(
+        "web", failover={"*": {"datacenters": ["dc2", "dc3"]}})}}
+    c = chain(entries=e)
+    fo = c["nodes"]["resolver:web@dc1"]["resolver"]["failover"]
+    assert fo["targets"] == ["web@dc2", "web@dc3"]
+    # Failover targets are retained in the target set.
+    assert set(c["targets"]) == {"web@dc1", "web@dc2", "web@dc3"}
+
+
+def test_failover_to_other_service_skips_self():
+    e = {"resolvers": {"web": resolver_entry(
+        "web", failover={"*": {"service": "backup"}})}}
+    c = chain(entries=e)
+    fo = c["nodes"]["resolver:web@dc1"]["resolver"]["failover"]
+    assert fo["targets"] == ["backup@dc1"]
+    # Failover to yourself is dropped entirely (compile.go:983).
+    e2 = {"resolvers": {"web": resolver_entry(
+        "web", failover={"*": {"datacenters": ["dc1"]}})}}
+    c2 = chain(entries=e2)
+    assert c2["nodes"]["resolver:web@dc1"]["resolver"]["failover"] is None
+
+
+def test_subset_specific_failover_beats_wildcard():
+    e = {"resolvers": {"web": resolver_entry(
+        "web", default_subset="v1",
+        subsets={"v1": {}, "v2": {}},
+        failover={"v1": {"datacenters": ["dc2"]},
+                  "*": {"datacenters": ["dc9"]}})}}
+    c = chain(entries=e)
+    fo = c["nodes"]["resolver:web:v1@dc1"]["resolver"]["failover"]
+    assert fo["targets"] == ["web:v1@dc2"]
+
+
+# ---------------------------------------------------------------------------
+# splitters (compile.go:682-760) — need an L7 protocol
+# ---------------------------------------------------------------------------
+
+HTTP_DEFAULTS = {"kind": "service-defaults", "name": "web",
+                 "protocol": "http"}
+
+
+def test_splitter_splits_to_subset_resolvers():
+    e = {
+        "services": {"web": HTTP_DEFAULTS},
+        "splitters": {"web": {
+            "kind": "service-splitter", "name": "web",
+            "splits": [
+                {"weight": 90, "service_subset": "v1"},
+                {"weight": 10, "service_subset": "v2"},
+            ]}},
+        "resolvers": {"web": resolver_entry(
+            "web", subsets={"v1": {}, "v2": {}})},
+    }
+    c = chain(entries=e)
+    assert c["protocol"] == "http"
+    assert c["start_node"] == "splitter:web"
+    splits = c["nodes"]["splitter:web"]["splits"]
+    assert [(s["weight"], s["next_node"]) for s in splits] == [
+        (90, "resolver:web:v1@dc1"), (10, "resolver:web:v2@dc1")]
+
+
+def test_adjacent_splitters_flatten_with_scaled_weights():
+    e = {
+        "services": {"web": HTTP_DEFAULTS,
+                     "alt": {"kind": "service-defaults", "name": "alt",
+                             "protocol": "http"}},
+        "splitters": {
+            "web": {"kind": "service-splitter", "name": "web",
+                    "splits": [{"weight": 50, "service": "alt"},
+                               {"weight": 50}]},
+            "alt": {"kind": "service-splitter", "name": "alt",
+                    "splits": [{"weight": 60, "service_subset": "a"},
+                               {"weight": 40, "service_subset": "b"}]},
+        },
+        "resolvers": {"alt": resolver_entry(
+            "alt", subsets={"a": {}, "b": {}})},
+    }
+    c = chain(entries=e)
+    splits = c["nodes"]["splitter:web"]["splits"]
+    assert [(s["weight"], s["next_node"]) for s in splits] == [
+        (30.0, "resolver:alt:a@dc1"),
+        (20.0, "resolver:alt:b@dc1"),
+        (50, "resolver:web@dc1"),
+    ]
+    # The flattened-away splitter node is pruned.
+    assert "splitter:alt" not in c["nodes"]
+
+
+def test_mutually_referencing_splitters_error_not_hang():
+    """compile.go:333 detectCircularReferences — a splitter cycle must
+    fail the compile; the flatten pass would otherwise loop forever on
+    the server event loop."""
+    e = {
+        "global_proxy": {"kind": "proxy-defaults", "name": "global",
+                         "config": {"protocol": "http"}},
+        "splitters": {
+            "a": {"kind": "service-splitter", "name": "a",
+                  "splits": [{"weight": 100, "service": "b"}]},
+            "b": {"kind": "service-splitter", "name": "b",
+                  "splits": [{"weight": 100, "service": "a"}]},
+        },
+    }
+    with pytest.raises(ChainCompileError, match="circular reference"):
+        chain("a", entries=e)
+
+
+def test_splitter_on_tcp_protocol_is_an_error():
+    e = {"splitters": {"web": {
+        "kind": "service-splitter", "name": "web",
+        "splits": [{"weight": 100}]}}}
+    with pytest.raises(ChainCompileError, match="does not permit advanced"):
+        chain(entries=e)
+
+
+def test_l4_override_drops_router_and_splitter():
+    e = {
+        "services": {"web": HTTP_DEFAULTS},
+        "splitters": {"web": {
+            "kind": "service-splitter", "name": "web",
+            "splits": [{"weight": 100}]}},
+    }
+    c = chain(entries=e, override_protocol="tcp")
+    assert c["start_node"] == "resolver:web@dc1"
+    assert c["protocol"] == "tcp"
+
+
+# ---------------------------------------------------------------------------
+# routers (compile.go:499-597)
+# ---------------------------------------------------------------------------
+
+
+def test_router_routes_plus_catch_all():
+    e = {
+        "services": {"web": HTTP_DEFAULTS,
+                     "admin": {"kind": "service-defaults", "name": "admin",
+                               "protocol": "http"}},
+        "routers": {"web": {
+            "kind": "service-router", "name": "web",
+            "routes": [{
+                "match": {"http": {"path_prefix": "/admin"}},
+                "destination": {"service": "admin"},
+            }]}},
+    }
+    c = chain(entries=e)
+    assert c["start_node"] == "router:web"
+    routes = c["nodes"]["router:web"]["routes"]
+    assert len(routes) == 2  # configured + catch-all
+    assert routes[0]["next_node"] == "resolver:admin@dc1"
+    assert routes[1]["definition"]["match"]["http"]["path_prefix"] == "/"
+    assert routes[1]["next_node"] == "resolver:web@dc1"
+    assert set(c["targets"]) == {"admin@dc1", "web@dc1"}
+
+
+def test_protocol_mismatch_across_chain_is_an_error():
+    e = {
+        "services": {"web": HTTP_DEFAULTS,
+                     "admin": {"kind": "service-defaults", "name": "admin",
+                               "protocol": "grpc"}},
+        "routers": {"web": {
+            "kind": "service-router", "name": "web",
+            "routes": [{"match": {"http": {"path_prefix": "/a"}},
+                        "destination": {"service": "admin"}}]}},
+    }
+    with pytest.raises(ChainCompileError, match="different protocols"):
+        chain(entries=e)
+
+
+def test_proxy_defaults_global_protocol_applies():
+    e = {
+        "global_proxy": {"kind": "proxy-defaults", "name": "global",
+                         "config": {"protocol": "http"}},
+        "splitters": {"web": {
+            "kind": "service-splitter", "name": "web",
+            "splits": [{"weight": 100}]}},
+    }
+    c = chain(entries=e)
+    assert c["protocol"] == "http"
+    assert c["start_node"] == "splitter:web"
+
+
+# ---------------------------------------------------------------------------
+# external SNI (compile.go:860-903)
+# ---------------------------------------------------------------------------
+
+
+def test_external_sni_sets_target_and_rejects_failover():
+    e = {"services": {"web": {"kind": "service-defaults", "name": "web",
+                              "external_sni": "web.example.com"}}}
+    c = chain(entries=e)
+    t = c["targets"]["web@dc1"]
+    assert t["external"] and t["sni"] == "web.example.com"
+
+    e["resolvers"] = {"web": resolver_entry(
+        "web", failover={"*": {"datacenters": ["dc2"]}})}
+    with pytest.raises(ChainCompileError, match="external SNI"):
+        chain(entries=e)
+
+
+# ---------------------------------------------------------------------------
+# store plumbing
+# ---------------------------------------------------------------------------
+
+
+async def test_discovery_chain_http_end_to_end():
+    """PUT /v1/config entries, then GET /v1/discovery-chain/:service
+    returns the compiled graph (agent/discovery_chain_endpoint.go)."""
+    import json
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_http_dns import dev_stack, http_call
+
+    async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+        for entry in (
+            {"Kind": "service-defaults", "Name": "web", "Protocol": "http"},
+            {"Kind": "service-resolver", "Name": "web",
+             "Subsets": {"v1": {}, "v2": {}},
+             "Failover": {"*": {"Datacenters": ["dc2"]}}},
+            {"Kind": "service-splitter", "Name": "web",
+             "Splits": [{"Weight": 90, "ServiceSubset": "v1"},
+                        {"Weight": 10, "ServiceSubset": "v2"}]},
+        ):
+            st, _, ok = await http_call(
+                addr, "PUT", "/v1/config", json.dumps(entry).encode())
+            assert st == 200, ok
+
+        st, _, out = await http_call(addr, "GET", "/v1/discovery-chain/web")
+        assert st == 200
+        chain = out["Chain"]
+        assert chain["Protocol"] == "http"
+        assert chain["StartNode"] == "splitter:web"
+        # Failover rides along on each subset resolver.
+        nodes = chain["Nodes"]
+        v1 = nodes["resolver:web:v1@dc1"]
+        assert v1["Resolver"]["Failover"]["Targets"] == ["web:v1@dc2"]
+
+        # L4 override via POST compiles a plain resolver chain.
+        st, _, out = await http_call(
+            addr, "POST", "/v1/discovery-chain/web",
+            json.dumps({"OverrideProtocol": "tcp"}).encode())
+        assert st == 200
+        assert out["Chain"]["StartNode"] == "resolver:web@dc1"
+
+
+def test_entries_for_chain_indexes_store_entries():
+    from consul_tpu.store.state import StateStore
+
+    s = StateStore()
+    s.config_entry_set(1, {"kind": "service-resolver", "name": "web",
+                           "redirect": {"service": "alt"}})
+    s.config_entry_set(2, {"kind": "proxy-defaults", "name": "global",
+                           "config": {"protocol": "http"}})
+    s.config_entry_set(3, {"kind": "service-defaults", "name": "alt",
+                           "protocol": "http"})
+    idx, e = entries_for_chain(s, "web")
+    assert idx == 3
+    assert "web" in e["resolvers"]
+    assert e["global_proxy"]["name"] == "global"
+    c = compile_chain("web", "dc1", e)
+    assert c["start_node"] == "resolver:alt@dc1"
+    assert c["protocol"] == "http"
