@@ -159,6 +159,13 @@ class Agent:
         # works under ACL enforcement (the reference's DNS RPCs carry
         # the agent token too).
         self.cache = AgentCache(rpc=self._agent_rpc)
+        # Proxy config snapshots for registered connect-proxy services
+        # (agent/proxycfg/manager.go; wired in add/remove_service).
+        from consul_tpu.connect.proxycfg import ProxyConfigManager
+
+        self.proxycfg = ProxyConfigManager(
+            self.cache, self._agent_rpc, datacenter=config.datacenter
+        )
         self.checks: dict[str, CheckRunner] = {}
         # DNS behavior knobs (dns_config block); DNSServer reads these
         # live, so reload changes DNS behavior without a restart.
@@ -289,6 +296,7 @@ class Agent:
 
     async def shutdown(self) -> None:
         self.syncer.stop()
+        self.proxycfg.stop()
         self.cache.stop()
         task = getattr(self, "_auto_encrypt_task", None)
         if task is not None:
@@ -361,6 +369,7 @@ class Agent:
     def add_service(self, service: dict, checks: Optional[list[dict]] = None) -> None:
         sid = service.get("id") or service["service"]
         self.local.add_service(service)
+        self.proxycfg.register(dict(service, id=sid))
         for i, defn in enumerate(checks or []):
             defn = dict(defn)
             defn.setdefault("check_id", f"service:{sid}" + (f":{i+1}" if i else ""))
@@ -369,6 +378,7 @@ class Agent:
             self.add_check(defn)
 
     def remove_service(self, service_id: str) -> bool:
+        self.proxycfg.deregister(service_id)
         for cid, runner in list(self.checks.items()):
             entry = self.local.checks.get(cid)
             if entry and entry.check.get("service_id") == service_id:

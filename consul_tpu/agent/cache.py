@@ -43,6 +43,9 @@ CATALOG_NODE_SERVICES = "catalog-node-services"
 KV_GET = "kv-get"
 NODE_INFO = "internal-node-info"
 PREPARED_QUERY = "prepared-query"
+CONNECT_CA_ROOTS = "connect-ca-roots"
+INTENTION_MATCH = "intention-match"
+DISCOVERY_CHAIN = "discovery-chain"
 
 REFRESH_BACKOFF_MIN = 0.5   # cache.go RefreshBackoffMin (scaled-friendly)
 REFRESH_TIMEOUT = 600.0     # cache-types' 10-minute blocking wait
@@ -64,7 +67,15 @@ TYPES: dict[str, CacheType] = {
     t.name: t
     for t in (
         CacheType(HEALTH_SERVICES, "Health.ServiceNodes",
-                  key_fields=("service", "tag", "passing_only", "dc")),
+                  key_fields=("service", "tag", "passing_only", "connect",
+                              "dc")),
+        # proxycfg data sources (cache-types/connect_ca_root.go,
+        # intention_match.go, discovery_chain.go).
+        CacheType(CONNECT_CA_ROOTS, "ConnectCA.Roots", key_fields=("dc",)),
+        CacheType(INTENTION_MATCH, "Intention.Match",
+                  key_fields=("destination", "dc")),
+        CacheType(DISCOVERY_CHAIN, "DiscoveryChain.Get",
+                  key_fields=("name", "dc")),
         CacheType(CATALOG_SERVICES, "Catalog.ServiceNodes",
                   key_fields=("service", "tag", "dc")),
         CacheType(CATALOG_LIST_NODES, "Catalog.ListNodes",

@@ -215,7 +215,8 @@ class Health(_Endpoint):
             lambda ws: _wrap(
                 self.server.store.check_service_nodes(
                     body["service"], tag=body.get("tag"),
-                    passing_only=passing, ws=ws,
+                    passing_only=passing,
+                    connect=bool(body.get("connect")), ws=ws,
                 ),
                 "nodes",
             ),
@@ -733,6 +734,22 @@ class ConnectCA(_Endpoint):
         leaf = ca.sign_leaf(body["service"])
         return {"leaf": leaf}
 
+    async def rotate(self, body: dict):
+        """Mint + activate a new signing root (leader_connect.go CA
+        config update path, minus cross-signing: old roots stay stored
+        so outstanding leaves verify until expiry, and proxies roll
+        their certs when they observe the new active root)."""
+        self.server.acl_check(body, "operator", "", WRITE)
+        fwd = await self.server.forward("ConnectCA.Rotate", body)
+        if fwd is not None:
+            return fwd
+        ca = await self.server.connect_ca()
+        root = ca.rotate()
+        await self.server.raft_apply(
+            MessageType.CONNECT_CA, {"op": "set-root", "root": root}
+        )
+        return {"root_id": root["id"]}
+
 
 class Intention(_Endpoint):
     """intention_endpoint.go: CRUD + match + connect authorize."""
@@ -749,6 +766,19 @@ class Intention(_Endpoint):
             intention.setdefault("id", str(uuid.uuid4()))
             intention.setdefault("action", "allow")
             body = {**body, "intention": intention}
+        if body.get("op") == "create":
+            # One intention per (source, destination) pair — a second
+            # create must not shadow the first in the precedence walk
+            # (intention_endpoint.go Apply duplicate check).
+            _, rows = self.server.store.intention_match(
+                intention["destination"]
+            )
+            if any(r["source"] == intention["source"]
+                   and r["destination"] == intention["destination"]
+                   for r in rows):
+                raise ValueError(
+                    f"duplicate intention {intention['source']!r} -> "
+                    f"{intention['destination']!r}")
         out = await self._write(
             "Intention.Apply", MessageType.INTENTION, body
         )
@@ -773,15 +803,21 @@ class Intention(_Endpoint):
         self.server.acl_check(
             body, "service", body.get("destination", ""), READ
         )
-        return await self._read(
-            "Intention.Match", body,
-            lambda ws: _wrap(
-                self.server.store.intention_match(
-                    body.get("destination", ""), ws=ws
-                ),
-                "intentions",
-            ),
+        # default_allow rides along so enforcement points (proxies)
+        # apply the same fallback as Intention.Check without a second
+        # RPC (intention_endpoint.go Match + DefaultDecision).
+        default_allow = (
+            not self.server.acl.enabled
+            or self.server.acl.default_policy == "allow"
         )
+
+        def run(ws):
+            idx, rows = self.server.store.intention_match(
+                body.get("destination", ""), ws=ws
+            )
+            return idx, {"intentions": rows, "default_allow": default_allow}
+
+        return await self._read("Intention.Match", body, run)
 
     async def check(self, body: dict):
         """Connect authorize core (intention_endpoint.go Check +

@@ -169,7 +169,17 @@ class HTTPApi:
     # -- lifecycle ------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
-        self._server = await asyncio.start_server(self._handle_conn, host, port)
+        self._conn_tasks: set[asyncio.Task] = set()
+
+        async def tracked(reader, writer):
+            task = asyncio.current_task()
+            self._conn_tasks.add(task)
+            try:
+                await self._handle_conn(reader, writer)
+            finally:
+                self._conn_tasks.discard(task)
+
+        self._server = await asyncio.start_server(tracked, host, port)
         h, p = self._server.sockets[0].getsockname()[:2]
         self.addr = f"{h}:{p}"
         return self.addr
@@ -177,6 +187,11 @@ class HTTPApi:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
+            # Cancel in-flight handlers: a longpolling client (blocking
+            # query, proxy config feed) would otherwise pin
+            # wait_closed() for its full wait window.
+            for task in list(getattr(self, "_conn_tasks", ())):
+                task.cancel()
             try:
                 await self._server.wait_closed()
             except Exception:  # noqa: BLE001
@@ -385,6 +400,10 @@ class HTTPApi:
         r("GET", r"/v1/health/node/(?P<node>.+)", self.health_node)
         r("GET", r"/v1/health/checks/(?P<svc>.+)", self.health_checks)
         r("GET", r"/v1/health/service/(?P<svc>.+)", self.health_service)
+        # /v1/health/connect/:service (health_endpoint.go
+        # HealthConnectServiceNodes): proxies/native instances FOR the
+        # service.
+        r("GET", r"/v1/health/connect/(?P<svc>.+)", self.health_connect)
         r("GET", r"/v1/health/state/(?P<state>.+)", self.health_state)
         # kv
         r("GET", r"/v1/kv/(?P<key>.*)", self.kv_get)
@@ -414,6 +433,10 @@ class HTTPApi:
         r("PUT", r"/v1/txn", self.txn)
         # config entries
         r("PUT", r"/v1/config", self.config_apply)
+        # CA rotation (the reference rotates via PUT /v1/connect/ca/
+        # configuration provider/key changes; collapsed to an explicit
+        # operator verb here).
+        r("PUT", r"/v1/connect/ca/rotate", self.connect_ca_rotate)
         # discovery chain (discovery_chain_endpoint.go /v1/discovery-chain/)
         r("GET", r"/v1/discovery-chain/(?P<svc>[^/?]+)",
           self.discovery_chain_get)
@@ -439,6 +462,12 @@ class HTTPApi:
         r("DELETE", r"/v1/connect/intentions/(?P<iid>.+)",
           self.intention_delete)
         r("POST", r"/v1/agent/connect/authorize", self.connect_authorize)
+        # Built-in proxy config feed (the xDS stand-in): blocking
+        # snapshot reads per registered connect-proxy
+        # (proxycfg/manager.go via agent_endpoint.go, re-designed as a
+        # longpoll JSON endpoint instead of an Envoy gRPC stream).
+        r("GET", r"/v1/agent/connect/proxy/(?P<pid>[^/?]+)",
+          self.connect_proxy_config)
         # keyring (operator_endpoint.go /v1/operator/keyring)
         r("GET", r"/v1/operator/keyring", self.keyring_list)
         r("POST", r"/v1/operator/keyring", self.keyring_install)
@@ -612,9 +641,17 @@ class HTTPApi:
         )
         svc = {k: v for k, v in defn.items()
                if k in ("id", "service", "name", "tags", "port", "address",
-                        "meta")}
+                        "meta", "kind", "proxy", "connect_native")}
         if "name" in svc:
             svc["service"] = svc.pop("name")
+        # Proxy block field spellings (structs.ConnectProxyConfig JSON):
+        # DestinationServiceName is accepted as destination_service too.
+        proxy = svc.get("proxy")
+        if isinstance(proxy, dict) and "destination_service_name" in proxy:
+            proxy = dict(proxy)
+            proxy["destination_service"] = proxy.pop(
+                "destination_service_name")
+            svc["proxy"] = proxy
         self.agent.add_service(svc, checks)
         return HTTPResponse(200, {})
 
@@ -758,6 +795,12 @@ class HTTPApi:
                 "passing_only": req.flag("passing")}
         if "tag" in req.query:
             body["tag"] = req.query["tag"]
+        return await self._rpc_read(req, "Health.ServiceNodes", body, "nodes",
+                                    row=self._check_service_node_row)
+
+    async def health_connect(self, req, m) -> HTTPResponse:
+        body = {"service": m.group("svc"), "connect": True,
+                "passing_only": req.flag("passing")}
         return await self._rpc_read(req, "Health.ServiceNodes", body, "nodes",
                                     row=self._check_service_node_row)
 
@@ -1009,6 +1052,11 @@ class HTTPApi:
         })
         return HTTPResponse(200, out.get("result", True))
 
+    async def connect_ca_rotate(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ConnectCA.Rotate",
+                                   {"token": req.token()})
+        return HTTPResponse(200, {"root_id": out.get("root_id", "")})
+
     async def discovery_chain_get(self, req, m) -> HTTPResponse:
         """GET/POST /v1/discovery-chain/:service
         (agent/discovery_chain_endpoint.go); POST bodies carry compile
@@ -1110,6 +1158,32 @@ class HTTPApi:
             "authorized": out.get("allowed", False),
             "reason": out.get("reason", ""),
         })
+
+    async def connect_proxy_config(self, req, m) -> HTTPResponse:
+        """GET /v1/agent/connect/proxy/:proxy_id?index=N&wait=30s —
+        the proxy's config snapshot, longpolling on its version."""
+        pid = m.group("pid")
+        min_version = int(req.query.get("index", 0) or 0)
+        wait = _parse_ttl(req.query.get("wait", "")) or 300.0
+        if min_version > 0:
+            out = await self.agent.proxycfg.wait(
+                pid, min_version=min_version, timeout=wait)
+        else:
+            out = self.agent.proxycfg.snapshot(pid)
+            if out is None and pid in self.agent.proxycfg.proxy_ids():
+                # Registered but not yet assembled: wait for the first.
+                out = await self.agent.proxycfg.wait(pid, 0, timeout=wait)
+        if out is None:
+            return HTTPResponse(404, {"error": f"unknown proxy {pid!r}"})
+        version, snap = out
+        # Upstream maps are keyed by service names / target ids: data.
+        shaped = {**snap,
+                  "upstreams": KeyedMap({
+                      name: {**up, "instances": KeyedMap(up["instances"])}
+                      for name, up in snap["upstreams"].items()
+                  })}
+        return HTTPResponse(200, shaped,
+                            headers={"X-Consul-Index": str(version)})
 
     # -- keyring -------------------------------------------------------------
 

@@ -158,6 +158,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("verb", choices=["register", "deregister"])
     sp.add_argument("arg", help="JSON definition file (or '-'), or id")
     sp = cmd("monitor", cmd_monitor, "stream user events")
+
+    # connect --------------------------------------------------------------
+    sp = cmd("connect", cmd_connect, "service mesh tools")
+    sp.add_argument("verb", choices=["proxy", "ca-rotate", "chain"])
+    sp.add_argument("-sidecar-for", default="", dest="sidecar_for",
+                    help="proxy id to run the built-in proxy for")
+    sp.add_argument("-listen-port", type=int, default=0,
+                    help="public mTLS port (defaults to the registered "
+                         "service port)")
+    sp.add_argument("service", nargs="?", default="",
+                    help="service name (chain verb)")
+    sp = cmd("intention", cmd_intention, "manage connect intentions")
+    sp.add_argument("verb", choices=["create", "delete", "list", "check"])
+    sp.add_argument("src", nargs="?", default="")
+    sp.add_argument("dst", nargs="?", default="")
+    sp.add_argument("-deny", action="store_true")
+
     sub.add_parser("version").set_defaults(fn=cmd_version)
     return p
 
@@ -672,6 +689,90 @@ async def cmd_monitor(args) -> int:
                 print(json.dumps(e, default=_json_bytes))
             sys.stdout.flush()
             index = meta.index
+
+
+async def cmd_connect(args) -> int:
+    """connect subcommands (command/connect): run the built-in sidecar
+    proxy, rotate the CA, or print a compiled discovery chain."""
+    c = _client(args)
+    if args.verb == "ca-rotate":
+        out = await c.write("PUT", "/v1/connect/ca/rotate")
+        print(f"New active root: {out.get('RootID', '')}")
+        return 0
+    if args.verb == "chain":
+        if not args.service:
+            print("Error: chain requires a service name", file=sys.stderr)
+            return 1
+        out, _ = await c.read(f"/v1/discovery-chain/{args.service}")
+        print(json.dumps(out, indent=2, default=_json_bytes))
+        return 0
+    # proxy: run until interrupted (connect/proxy/proxy.go main loop).
+    if not args.sidecar_for:
+        print("Error: -sidecar-for is required", file=sys.stderr)
+        return 1
+    from consul_tpu.connect.proxy import ConnectProxy
+
+    port = args.listen_port
+    if not port:
+        services = await c.agent.services()
+        svc = services.get(args.sidecar_for)
+        if svc is None:
+            print(f"Error: no registered service {args.sidecar_for!r}",
+                  file=sys.stderr)
+            return 1
+        port = int(svc.get("Port", 0))
+    proxy = await ConnectProxy(args.sidecar_for, args.http_addr,
+                               public_port=port).start()
+    print(f"==> proxy for {args.sidecar_for} listening "
+          f"(public mTLS {proxy.public_addr})")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+    await stop.wait()
+    await proxy.stop()
+    return 0
+
+
+async def cmd_intention(args) -> int:
+    """intention subcommands (command/intention)."""
+    c = _client(args)
+    if args.verb == "list":
+        out, _ = await c.read("/v1/connect/intentions")
+        rows = [("ID", "Source", "Destination", "Action")]
+        for i in out or []:
+            rows.append((i.get("ID", "")[:8], i.get("Source", ""),
+                         i.get("Destination", ""), i.get("Action", "")))
+        _print_table(rows)
+        return 0
+    if not args.src or not args.dst:
+        print("Error: need SRC and DST", file=sys.stderr)
+        return 1
+    if args.verb == "create":
+        out = await c.write("POST", "/v1/connect/intentions", body={
+            "Source": args.src, "Destination": args.dst,
+            "Action": "deny" if args.deny else "allow",
+        })
+        print(f"Created: {out.get('ID', '')}")
+        return 0
+    if args.verb == "check":
+        out, _ = await c.read(
+            "/v1/connect/intentions/check",
+            params={"source": args.src, "target": args.dst})
+        print("Allowed" if out.get("Authorized") else "Denied")
+        return 0 if out.get("Authorized") else 2
+    # delete: find by pair.
+    out, _ = await c.read("/v1/connect/intentions")
+    for i in out or []:
+        if i.get("Source") == args.src and i.get("Destination") == args.dst:
+            await c.write("DELETE", f"/v1/connect/intentions/{i['ID']}")
+            print(f"Deleted: {i['ID']}")
+            return 0
+    print("Error: no such intention", file=sys.stderr)
+    return 1
 
 
 async def cmd_version(args) -> int:

@@ -153,6 +153,7 @@ class BuiltinCA:
                 serialization.NoEncryption(),
             ).decode(),
             "root_id": self.root_id,
+            "valid_after": cert.not_valid_before_utc.isoformat(),
             "valid_before": cert.not_valid_after_utc.isoformat(),
         }
 

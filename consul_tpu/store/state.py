@@ -233,12 +233,18 @@ class StateStore:
             "port": int(svc.get("port", 0)),
             "meta": svc.get("meta", {}),
             "weights": svc.get("weights", {"passing": 1, "warning": 1}),
+            # Mesh registration fields (structs.NodeService Kind/Proxy/
+            # Connect): connect_service_nodes keys off these.
+            "kind": svc.get("kind", ""),
+            "proxy": svc.get("proxy") or {},
+            "connect_native": bool(svc.get("connect_native", False)),
             "create_index": existing["create_index"] if existing else idx,
             "modify_index": idx,
         }
         if existing and all(
             existing[k] == rec[k]
-            for k in ("service", "tags", "address", "port", "meta", "weights")
+            for k in ("service", "tags", "address", "port", "meta", "weights",
+                      "kind", "proxy", "connect_native")
         ):
             return
         tx.insert("services", rec)
@@ -400,18 +406,50 @@ class StateStore:
             tx.records("checks", _b(status) + SEP, index="status", ws=ws),
         )
 
+    def connect_service_nodes(
+        self, service: str, ws: Optional[WatchSet] = None
+    ) -> tuple[int, list[dict]]:
+        """Instances that can serve Connect traffic FOR ``service``:
+        its registered sidecar proxies (kind=connect-proxy whose
+        proxy.destination_service matches) plus connect-native
+        instances (state/catalog.go ConnectServiceNodes via the
+        ConnectName index; a table scan here — proxy counts are
+        node-bounded)."""
+        tx = self.db.txn()
+        out = []
+        for rec in tx.records("services", b"", index="service", ws=ws):
+            proxy = rec.get("proxy") or {}
+            is_proxy_for = (
+                rec.get("kind") == "connect-proxy"
+                and proxy.get("destination_service") == service
+            )
+            native = rec.get("connect_native") and rec["service"] == service
+            if not (is_proxy_for or native):
+                continue
+            node = tx.get("nodes", _b(rec["node"]), ws=ws)
+            merged = dict(rec)
+            merged["node_address"] = node["address"] if node else ""
+            out.append(merged)
+        return self.max_index("services", "nodes", tx=tx), out
+
     def check_service_nodes(
         self,
         service: str,
         tag: Optional[str] = None,
         passing_only: bool = False,
+        connect: bool = False,
         ws: Optional[WatchSet] = None,
     ) -> tuple[int, list[dict]]:
         """Health endpoint's joined view: service instance + node +
         its checks (node-level + service-level)
-        (``Health.ServiceNodes``, ``state/catalog.go`` CheckServiceNodes)."""
+        (``Health.ServiceNodes``, ``state/catalog.go`` CheckServiceNodes).
+        ``connect=True`` swaps the instance source for the proxies /
+        connect-native instances serving the named service."""
         tx = self.db.txn()
-        idx, instances = self.service_nodes(service, tag, ws)
+        if connect:
+            idx, instances = self.connect_service_nodes(service, ws)
+        else:
+            idx, instances = self.service_nodes(service, tag, ws)
         out = []
         for inst in instances:
             checks = [

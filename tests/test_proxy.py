@@ -1,0 +1,285 @@
+"""Mesh data plane end-to-end: proxycfg snapshots + built-in L4 proxy.
+
+Parity model: ``agent/proxycfg/manager_test.go`` (snapshot assembly +
+change propagation) and ``connect/proxy/proxy_test.go`` (listener data
+path, intention enforcement, cert rotation) — re-designed: snapshots
+are JSON over the agent's blocking HTTP feed instead of Envoy xDS.
+"""
+
+import asyncio
+import json
+import socket
+import sys
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from helpers import wait_for as wait_until  # noqa: E402
+
+from consul_tpu.connect.proxy import (  # noqa: E402
+    ConnectProxy,
+    chain_candidates,
+    evaluate_intentions,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=120))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# pure pieces
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_intentions_precedence_and_default():
+    intentions = [
+        {"source": "api", "action": "deny"},
+        {"source": "*", "action": "allow"},
+    ]
+    assert not evaluate_intentions(intentions, "api", default_allow=True)
+    assert evaluate_intentions(intentions, "other", default_allow=False)
+    assert evaluate_intentions([], "anyone", default_allow=True)
+    assert not evaluate_intentions([], "anyone", default_allow=False)
+
+
+def test_chain_candidates_resolver_failover_order():
+    upstream = {"chain": {
+        "start_node": "resolver:web@dc1",
+        "nodes": {"resolver:web@dc1": {
+            "type": "resolver",
+            "resolver": {"target": "web@dc1",
+                         "failover": {"targets": ["web@dc2", "web@dc3"]}},
+        }},
+    }}
+    assert chain_candidates(upstream) == ["web@dc1", "web@dc2", "web@dc3"]
+
+
+def test_chain_candidates_router_takes_catch_all():
+    upstream = {"chain": {
+        "start_node": "router:web",
+        "nodes": {
+            "router:web": {"type": "router", "routes": [
+                {"next_node": "resolver:admin@dc1"},
+                {"next_node": "resolver:web@dc1"},
+            ]},
+            "resolver:web@dc1": {
+                "type": "resolver",
+                "resolver": {"target": "web@dc1", "failover": None}},
+        },
+    }}
+    assert chain_candidates(upstream) == ["web@dc1"]
+
+
+def test_chain_candidates_without_chain_falls_back_to_instances():
+    assert chain_candidates({"instances": {"web@dc1": []}}) == ["web@dc1"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_end_to_end():
+    """VERDICT r2 'done' criteria: A reaches B through two spawned
+    proxies; an intention flip to deny severs new connections; a CA
+    root rotation rolls certs without downtime."""
+
+    async def main():
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (agent, addr, _dns, _dns_addr):
+            # The web application: a local echo server.
+            served = []
+
+            async def echo(reader, writer):
+                data = await reader.read(64)
+                served.append(data)
+                writer.write(b"web:" + data)
+                await writer.drain()
+                writer.close()
+
+            app = await asyncio.start_server(echo, "127.0.0.1", 0)
+            app_port = app.sockets[0].getsockname()[1]
+
+            web_proxy_port = free_port()
+            upstream_port = free_port()
+
+            # Register service + sidecar pairs (structs.NodeService
+            # Kind=connect-proxy with a Proxy block).
+            agent.add_service({"service": "web", "port": app_port})
+            agent.add_service({
+                "service": "web-proxy", "kind": "connect-proxy",
+                "address": "127.0.0.1", "port": web_proxy_port,
+                "proxy": {"destination_service": "web",
+                          "local_service_port": app_port},
+            })
+            agent.add_service({"service": "api", "port": 0})
+            agent.add_service({
+                "service": "api-proxy", "kind": "connect-proxy",
+                "address": "127.0.0.1", "port": free_port(),
+                "proxy": {
+                    "destination_service": "api",
+                    "local_service_port": 1,
+                    "upstreams": [{"destination_name": "web",
+                                   "local_bind_port": upstream_port}],
+                },
+            })
+            store = agent.delegate.store
+            await wait_until(
+                lambda: store.connect_service_nodes("web")[1],
+                msg="web proxy in catalog",
+            )
+
+            web_proxy = await ConnectProxy(
+                "web-proxy", addr, public_port=web_proxy_port).start()
+            api_proxy = await ConnectProxy("api-proxy", addr).start()
+            # The api proxy needs web instances in its snapshot before
+            # its upstream dial can succeed.
+            await wait_until(
+                lambda: (api_proxy.snapshot or {}).get("upstreams", {})
+                .get("web", {}).get("instances", {}).get("web@dc1"),
+                msg="api proxy sees web instances",
+            )
+
+            async def call(payload: bytes) -> bytes:
+                r, w = await asyncio.open_connection(
+                    "127.0.0.1", upstream_port)
+                w.write(payload)
+                await w.drain()
+                out = await asyncio.wait_for(r.read(64), 10)
+                w.close()
+                return out
+
+            # 1. A → B through both proxies.
+            assert await call(b"ping") == b"web:ping"
+
+            # 2. Intention flip to deny severs NEW connections.
+            st, _, created = await http_call(
+                addr, "POST", "/v1/connect/intentions",
+                json.dumps({"Source": "api", "Destination": "web",
+                            "Action": "deny"}).encode())
+            assert st == 200
+            intention_id = created["ID"]
+
+            def intent_action():
+                return next(
+                    (i.get("action")
+                     for i in (web_proxy.snapshot or {}).get(
+                         "intentions", [])
+                     if i.get("source") == "api"), None)
+
+            await wait_until(lambda: intent_action() == "deny",
+                             msg="deny in web proxy snapshot")
+            assert await call(b"denied?") == b""
+
+            # Other sources unaffected (default allow): a raw Service
+            # identity still passes.
+            from consul_tpu.connect import Service
+
+            other = await Service("batch", addr).ready()
+            r, w = await other.dial(web_proxy.public_addr,
+                                    destination="web")
+            w.write(b"direct")
+            await w.drain()
+            assert await asyncio.wait_for(r.read(64), 10) == b"web:direct"
+            w.close()
+
+            # Flip the SAME intention back to allow (a second create
+            # for the pair is rejected as a duplicate).
+            st, _, _x = await http_call(
+                addr, "POST", "/v1/connect/intentions",
+                json.dumps({"Source": "api", "Destination": "web",
+                            "Action": "allow"}).encode())
+            assert st == 400
+            st, _, _x = await http_call(
+                addr, "PUT", f"/v1/connect/intentions/{intention_id}",
+                json.dumps({"Source": "api", "Destination": "web",
+                            "Action": "allow"}).encode())
+            assert st == 200
+            await wait_until(lambda: intent_action() == "allow",
+                             msg="allow in web proxy snapshot")
+            assert await call(b"back") == b"web:back"
+
+            # 3. CA rotation rolls certs without downtime.
+            old_root = (web_proxy.snapshot or {}).get("active_root_id")
+            out = await agent.rpc("ConnectCA.Rotate", {})
+            assert out["root_id"] and out["root_id"] != old_root
+            await wait_until(
+                lambda: (web_proxy.snapshot or {}).get("active_root_id")
+                == out["root_id"]
+                and (web_proxy.snapshot or {}).get("leaf", {}).get(
+                    "root_id") == out["root_id"],
+                msg="web proxy rolled to the new root",
+            )
+            await wait_until(
+                lambda: (api_proxy.snapshot or {}).get("leaf", {}).get(
+                    "root_id") == out["root_id"],
+                msg="api proxy rolled to the new root",
+            )
+            # New connections handshake under the new root.
+            assert await call(b"rotated") == b"web:rotated"
+
+            await api_proxy.stop()
+            await web_proxy.stop()
+            app.close()
+            other.close()
+
+    run(main())
+
+
+def test_proxy_config_http_feed_blocks_and_versions():
+    """The blocking snapshot feed itself (xDS stream stand-in)."""
+
+    async def main():
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (agent, addr, _dns, _dns_addr):
+            agent.add_service({"service": "web", "port": 1234})
+            agent.add_service({
+                "service": "web-proxy", "kind": "connect-proxy",
+                "port": free_port(),
+                "proxy": {"destination_service": "web",
+                          "local_service_port": 1234},
+            })
+            st, hdrs, snap = await http_call(
+                addr, "GET", "/v1/agent/connect/proxy/web-proxy")
+            assert st == 200
+            assert snap["DestinationService"] == "web"
+            assert snap["Leaf"]["CertPEM"]
+            assert snap["Roots"]
+            version = int(hdrs["x-consul-index"])
+            assert version >= 1
+
+            # A blocking read wakes on intention change.
+            async def flip():
+                await asyncio.sleep(0.2)
+                await http_call(
+                    addr, "POST", "/v1/connect/intentions",
+                    json.dumps({"Source": "x", "Destination": "web",
+                                "Action": "deny"}).encode())
+
+            flip_task = asyncio.create_task(flip())
+            st, hdrs, snap = await http_call(
+                addr, "GET",
+                f"/v1/agent/connect/proxy/web-proxy?index={version}&wait=10s")
+            await flip_task
+            assert st == 200
+            assert int(hdrs["x-consul-index"]) > version
+            assert any(i["Source"] == "x" for i in snap["Intentions"])
+
+            # Unknown proxy → 404.
+            st, _, _x = await http_call(
+                addr, "GET", "/v1/agent/connect/proxy/nope")
+            assert st == 404
+
+    run(main())
