@@ -10,7 +10,10 @@ from consul_tpu.acl.engine import (
     DENY_ALL,
     MANAGE_ALL,
     Policy,
+    node_identity_policy,
     parse_policy,
+    service_identity_policy,
+    token_is_expired,
 )
 
 __all__ = [
@@ -19,5 +22,8 @@ __all__ = [
     "DENY_ALL",
     "MANAGE_ALL",
     "Policy",
+    "node_identity_policy",
     "parse_policy",
+    "service_identity_policy",
+    "token_is_expired",
 ]

@@ -231,6 +231,37 @@ DENY_ALL = _DenyAll()
 MANAGE_ALL = _Manage()
 
 
+def service_identity_policy(name: str) -> Policy:
+    """Synthetic policy for a service identity
+    (``agent/structs/acl.go`` ACLServiceIdentity.SyntheticPolicy):
+    write on the service and its sidecar, read on everything needed
+    for discovery."""
+    return parse_policy({
+        "service": {name: {"policy": WRITE},
+                    f"{name}-sidecar-proxy": {"policy": WRITE}},
+        "service_prefix": {"": {"policy": READ}},
+        "node_prefix": {"": {"policy": READ}},
+    })
+
+
+def node_identity_policy(name: str) -> Policy:
+    """Synthetic policy for a node identity
+    (``agent/structs/acl.go`` ACLNodeIdentity.SyntheticPolicy)."""
+    return parse_policy({
+        "node": {name: {"policy": WRITE}},
+        "service_prefix": {"": {"policy": READ}},
+    })
+
+
+def token_is_expired(token: dict, now: Optional[float] = None) -> bool:
+    """``agent/structs/acl.go`` ACLToken.IsExpired — wall-clock
+    ``expiration_time`` (unix seconds) already passed."""
+    exp = token.get("expiration_time")
+    if not exp:
+        return False
+    return (time.time() if now is None else now) >= float(exp)
+
+
 class ACLResolver:
     """Token secret → Authorizer, with TTL caching
     (agent/consul/acl.go ACLResolver)."""
@@ -243,14 +274,55 @@ class ACLResolver:
         default_policy: str = "allow",
         master_token: str = "",
         ttl_s: float = 30.0,
+        role_lookup: Optional[Callable[[str], Optional[dict]]] = None,
     ):
         self.token_lookup = token_lookup
         self.policy_lookup = policy_lookup
+        self.role_lookup = role_lookup
         self.enabled = enabled
         self.default_policy = default_policy
         self.master_token = master_token
         self.ttl_s = ttl_s
         self._cache: dict[str, tuple[float, Authorizer]] = {}
+
+    def _token_policies(self, token: dict) -> list[Policy]:
+        """Expand policies + role→policy links + service/node identities
+        (consul/acl.go resolveTokenToIdentityAndPolicies: tokens link
+        policies directly, through roles, and through identities)."""
+        policy_ids = list(token.get("policies", []))
+        identities = [
+            ("service", s) for s in token.get("service_identities", [])
+        ] + [("node", n) for n in token.get("node_identities", [])]
+        if self.role_lookup is not None:
+            for rid in token.get("roles", []):
+                role = self.role_lookup(rid)
+                if role is None:
+                    continue
+                policy_ids.extend(role.get("policies", []))
+                identities.extend(
+                    ("service", s)
+                    for s in role.get("service_identities", [])
+                )
+                identities.extend(
+                    ("node", n) for n in role.get("node_identities", [])
+                )
+        policies = []
+        for pid in policy_ids:
+            rec = self.policy_lookup(pid)
+            if rec is not None:
+                policies.append(parse_policy(rec.get("rules", "{}")))
+        for kind, ident in identities:
+            name = (
+                ident.get("service_name" if kind == "service"
+                          else "node_name", "")
+                if isinstance(ident, dict) else str(ident)
+            )
+            if name:
+                policies.append(
+                    service_identity_policy(name) if kind == "service"
+                    else node_identity_policy(name)
+                )
+        return policies
 
     def resolve(self, secret: str) -> Authorizer:
         """consul/acl.go ResolveToken."""
@@ -267,17 +339,21 @@ class ACLResolver:
         token = self.token_lookup(secret)
         if token is None:
             raise ACLError("ACL not found")
+        if token_is_expired(token):
+            # acl_token_exp.go: expired tokens behave exactly like
+            # deleted ones even before the reaper collects them.
+            raise ACLError("ACL not found")
         if token.get("type") == "management":
             authz: Authorizer = MANAGE_ALL
         else:
-            policies = []
-            for pid in token.get("policies", []):
-                rec = self.policy_lookup(pid)
-                if rec is not None:
-                    policies.append(parse_policy(rec.get("rules", "{}")))
             default = WRITE if self.default_policy == "allow" else DENY
-            authz = Authorizer(policies, default=default)
-        self._cache[secret] = (now + self.ttl_s, authz)
+            authz = Authorizer(self._token_policies(token), default=default)
+        ttl = self.ttl_s
+        exp = token.get("expiration_time")
+        if exp:
+            # Never cache past the token's own expiry.
+            ttl = min(ttl, max(0.0, float(exp) - time.time()))
+        self._cache[secret] = (now + ttl, authz)
         return authz
 
     def invalidate(self, secret: str = "") -> None:

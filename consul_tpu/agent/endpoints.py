@@ -14,6 +14,7 @@ the reference finds the same RPC surface.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import TYPE_CHECKING, Any, Callable, Optional
 
@@ -891,6 +892,22 @@ class AutoEncrypt(_Endpoint):
         return {"leaf": leaf, "roots": roots}
 
 
+def _interpolate_bind_name(template: str, vars_: dict[str, str]) -> str:
+    """``${var}`` interpolation over projected identity vars
+    (agent/consul/acl_endpoint.go computeBindingRuleBindName →
+    lib.InterpolateHIL).  Unknown vars raise KeyError so a login can
+    never silently bind to a half-substituted name."""
+    import re as _re
+
+    def sub(m):
+        name = m.group(1)
+        if name not in vars_:
+            raise KeyError(name)
+        return vars_[name]
+
+    return _re.sub(r"\$\{([A-Za-z0-9_.]+)\}", sub, template)
+
+
 class ACL(_Endpoint):
     """acl_endpoint.go — token/policy CRUD + one-shot bootstrap.
 
@@ -937,6 +954,21 @@ class ACL(_Endpoint):
             return fwd
         token = dict(body.get("acl_token") or body.get("new_token") or {})
         token.setdefault("secret_id", str(uuid.uuid4()))
+        # acl_endpoint.go:456-481: a relative TTL is converted into an
+        # absolute expiration at create time and never stored itself.
+        ttl = float(token.pop("expiration_ttl_s", 0) or 0)
+        if ttl < 0:
+            raise ValueError("Token Expiration TTL should be > 0")
+        if ttl:
+            if token.get("expiration_time"):
+                raise ValueError(
+                    "Token cannot have both an ExpirationTTL "
+                    "and an ExpirationTime"
+                )
+            token["expiration_time"] = time.time() + ttl
+        for rid in token.get("roles", []):
+            if self.server.store.acl_role_get(rid) is None:
+                raise ValueError(f"no such ACL role {rid!r}")
         result = await self.server.raft_apply(
             MessageType.ACL_TOKEN_SET, {"token": token}
         )
@@ -1003,6 +1035,288 @@ class ACL(_Endpoint):
         self.server.acl_check(body, "acl", "", READ)
         rec = self.server.store.acl_policy_get(body["id"])
         return {"policy": rec}
+
+    # -- roles (acl_endpoint.go RoleSet/RoleDelete/RoleList/RoleRead) ------
+
+    async def role_set(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.RoleSet", body)
+        if fwd is not None:
+            return fwd
+        role = dict(body.get("role") or {})
+        if not role.get("name"):
+            raise ValueError("ACL role must have a name")
+        role.setdefault("id", str(uuid.uuid4()))
+        existing = self.server.store.acl_role_get_by_name(role["name"])
+        if existing is not None and existing["id"] != role["id"]:
+            raise ValueError(
+                f"role name {role['name']!r} is already in use"
+            )
+        for pid in role.get("policies", []):
+            if self.server.store.acl_policy_get(pid) is None:
+                raise ValueError(f"no such ACL policy {pid!r}")
+        result = await self.server.raft_apply(
+            MessageType.ACL_ROLE_SET, {"role": role}
+        )
+        self.server.acl.invalidate()
+        return {"result": result, "role": role}
+
+    async def role_delete(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.RoleDelete", body)
+        if fwd is not None:
+            return fwd
+        result = await self.server.raft_apply(
+            MessageType.ACL_ROLE_DELETE, {"id": body["id"]}
+        )
+        self.server.acl.invalidate()
+        return {"result": result}
+
+    async def role_list(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        idx, roles = self.server.store.acl_role_list()
+        return {"roles": roles, "meta": {"index": idx}}
+
+    async def role_read(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        if body.get("name"):
+            rec = self.server.store.acl_role_get_by_name(body["name"])
+        else:
+            rec = self.server.store.acl_role_get(body["id"])
+        return {"role": rec}
+
+    # -- auth methods (acl_endpoint.go AuthMethodSet/...) ------------------
+
+    async def auth_method_set(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.AuthMethodSet", body)
+        if fwd is not None:
+            return fwd
+        method = dict(body.get("auth_method") or {})
+        if not method.get("name"):
+            raise ValueError("auth method must have a name")
+        if method.get("type") not in ("jwt",):
+            raise ValueError(
+                f"invalid auth method type {method.get('type')!r} "
+                "(supported: jwt)"
+            )
+        ttl = float(method.get("max_token_ttl_s", 0) or 0)
+        if ttl < 0:
+            raise ValueError("max_token_ttl_s should be >= 0")
+        result = await self.server.raft_apply(
+            MessageType.ACL_AUTH_METHOD_SET, {"method": method}
+        )
+        return {"result": result, "auth_method": method}
+
+    async def auth_method_delete(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.AuthMethodDelete", body)
+        if fwd is not None:
+            return fwd
+        result = await self.server.raft_apply(
+            MessageType.ACL_AUTH_METHOD_DELETE, {"name": body["name"]}
+        )
+        # The cascade may have deleted tokens — drop all cached authz.
+        self.server.acl.invalidate()
+        return {"result": result}
+
+    async def auth_method_list(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        idx, methods = self.server.store.acl_auth_method_list()
+        return {"auth_methods": methods, "meta": {"index": idx}}
+
+    async def auth_method_read(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        rec = self.server.store.acl_auth_method_get(body["name"])
+        return {"auth_method": rec}
+
+    # -- binding rules (acl_endpoint.go BindingRuleSet/...) ----------------
+
+    async def binding_rule_set(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.BindingRuleSet", body)
+        if fwd is not None:
+            return fwd
+        rule = dict(body.get("binding_rule") or {})
+        if not rule.get("auth_method"):
+            raise ValueError("binding rule must name an auth method")
+        if self.server.store.acl_auth_method_get(rule["auth_method"]) is None:
+            raise ValueError(
+                f"no such auth method {rule['auth_method']!r}"
+            )
+        if rule.get("bind_type") not in ("role", "service", "node"):
+            raise ValueError(
+                f"invalid bind_type {rule.get('bind_type')!r} "
+                "(role|service|node)"
+            )
+        if not rule.get("bind_name"):
+            raise ValueError("binding rule must have a bind_name")
+        # Vet the template against the method's projected vars NOW
+        # (acl_endpoint.go BindingRuleSet → validateBindingRuleBindName
+        # with validator.ProjectedVarNames) — a typo'd ${var} must fail
+        # the write, not every later login.
+        method = self.server.store.acl_auth_method_get(rule["auth_method"])
+        cfg = (method or {}).get("config") or {}
+        known = {str(v) for v in (cfg.get("claim_mappings") or {}).values()}
+        try:
+            _interpolate_bind_name(
+                rule["bind_name"], dict.fromkeys(known, "x")
+            )
+        except KeyError as e:
+            raise ValueError(
+                f"bind_name references unknown variable {e} "
+                f"(auth method maps: {sorted(known) or 'none'})"
+            ) from e
+        if rule.get("selector"):
+            from consul_tpu.agent.bexpr import create_filter
+            create_filter(rule["selector"])  # syntax check up front
+        rule.setdefault("id", str(uuid.uuid4()))
+        result = await self.server.raft_apply(
+            MessageType.ACL_BINDING_RULE_SET, {"rule": rule}
+        )
+        return {"result": result, "binding_rule": rule}
+
+    async def binding_rule_delete(self, body: dict):
+        self.server.acl_check(body, "acl", "", WRITE)
+        fwd = await self.server.forward("ACL.BindingRuleDelete", body)
+        if fwd is not None:
+            return fwd
+        result = await self.server.raft_apply(
+            MessageType.ACL_BINDING_RULE_DELETE, {"id": body["id"]}
+        )
+        return {"result": result}
+
+    async def binding_rule_list(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        idx, rules = self.server.store.acl_binding_rule_list(
+            body.get("auth_method", "")
+        )
+        return {"binding_rules": rules, "meta": {"index": idx}}
+
+    async def binding_rule_read(self, body: dict):
+        self.server.acl_check(body, "acl", "", READ)
+        rec = self.server.store.acl_binding_rule_get(body["id"])
+        return {"binding_rule": rec}
+
+    # -- login / logout (acl_endpoint.go Login/Logout) ---------------------
+
+    async def login(self, body: dict):
+        """Exchange a bearer JWT for a Consul token
+        (acl_endpoint.go:~Login → acl_authmethod.go
+        evaluateRoleBindings).  Requires NO pre-existing token."""
+        fwd = await self.server.forward("ACL.Login", body)
+        if fwd is not None:
+            return fwd
+        auth = body.get("auth") or {}
+        method_name = auth.get("auth_method", "")
+        bearer = auth.get("bearer_token", "")
+        method = self.server.store.acl_auth_method_get(method_name)
+        if method is None:
+            raise ValueError(f"no such auth method {method_name!r}")
+        from consul_tpu.acl import jwt as jwt_mod
+
+        cfg = method.get("config") or {}
+        try:
+            claims = jwt_mod.validate(
+                bearer,
+                secret=cfg.get("jwt_secret", ""),
+                pub_keys=cfg.get("jwt_validation_pub_keys") or [],
+                bound_issuer=cfg.get("bound_issuer", ""),
+                bound_audiences=cfg.get("bound_audiences") or [],
+                clock_skew_s=float(cfg.get("clock_skew_s", 30.0)),
+            )
+        except jwt_mod.JWTError as e:
+            # Surfaced as the canonical 403 string; the detail stays in
+            # the server log only (acl_endpoint.go wraps in
+            # ErrPermissionDenied the same way).
+            raise RPCError(ERR_PERMISSION_DENIED) from e
+        selectable, projected = jwt_mod.identity_from_claims(
+            claims,
+            cfg.get("claim_mappings") or {},
+            cfg.get("list_claim_mappings") or {},
+        )
+        bindings = self._evaluate_role_bindings(
+            method_name, selectable, projected
+        )
+        if not any(bindings.values()):
+            # acl_endpoint.go Login: no rule matched → no token.
+            raise RPCError(ERR_PERMISSION_DENIED)
+        ttl = float(method.get("max_token_ttl_s", 0) or 0)
+        token = {
+            "secret_id": str(uuid.uuid4()),
+            "accessor_id": str(uuid.uuid4()),
+            "description": (
+                f"token created via login: {auth.get('meta') or {}}"
+            ),
+            "auth_method": method_name,
+            "local": True,
+            "roles": bindings["roles"],
+            "service_identities": bindings["service_identities"],
+            "node_identities": bindings["node_identities"],
+        }
+        if ttl:
+            token["expiration_time"] = time.time() + ttl
+        await self.server.raft_apply(
+            MessageType.ACL_TOKEN_SET, {"token": token}
+        )
+        return {"token": token}
+
+    def _evaluate_role_bindings(
+        self, method_name: str, selectable: dict, projected: dict
+    ) -> dict:
+        """acl_authmethod.go evaluateRoleBindings: match selectors
+        against the verified identity, then interpolate bind names."""
+        from consul_tpu.agent.bexpr import FilterError, create_filter
+
+        _, rules = self.server.store.acl_binding_rule_list(method_name)
+        out = {"roles": [], "service_identities": [], "node_identities": []}
+        for rule in rules:
+            selector = rule.get("selector", "")
+            if selector:
+                try:
+                    if not create_filter(selector).match(selectable):
+                        continue
+                except FilterError:
+                    continue  # invalid selector fails closed
+            try:
+                bind_name = _interpolate_bind_name(
+                    rule["bind_name"], projected
+                )
+            except KeyError:
+                # The JWT simply lacks a mapped claim this rule needs —
+                # skip the rule (no privileges granted) rather than
+                # failing the whole login alongside rules that matched.
+                continue
+            if rule["bind_type"] == "service":
+                out["service_identities"].append(
+                    {"service_name": bind_name}
+                )
+            elif rule["bind_type"] == "node":
+                out["node_identities"].append({
+                    "node_name": bind_name,
+                    "datacenter": self.server.config.datacenter,
+                })
+            elif rule["bind_type"] == "role":
+                role = self.server.store.acl_role_get_by_name(bind_name)
+                if role is not None:
+                    out["roles"].append(role["id"])
+        return out
+
+    async def logout(self, body: dict):
+        """Destroy the requesting token itself; only tokens minted by an
+        auth method may log out (acl_endpoint.go Logout)."""
+        fwd = await self.server.forward("ACL.Logout", body)
+        if fwd is not None:
+            return fwd
+        secret = body.get("token", "")
+        rec = self.server.store.acl_token_get(secret)
+        if rec is None or not rec.get("auth_method"):
+            raise RPCError(ERR_PERMISSION_DENIED)
+        result = await self.server.raft_apply(
+            MessageType.ACL_TOKEN_DELETE, {"secret_id": secret}
+        )
+        self.server.acl.invalidate(secret)
+        return {"result": result}
 
 
 class Snapshot(_Endpoint):

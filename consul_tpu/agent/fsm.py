@@ -60,7 +60,13 @@ class MessageType(enum.IntEnum):
     ACL_POLICY_SET = 19
     ACL_POLICY_DELETE = 20
     CONFIG_ENTRY = 22
-    FEDERATION_STATE = 27
+    ACL_ROLE_SET = 23
+    ACL_ROLE_DELETE = 24
+    ACL_BINDING_RULE_SET = 25
+    ACL_BINDING_RULE_DELETE = 26
+    ACL_AUTH_METHOD_SET = 27
+    ACL_AUTH_METHOD_DELETE = 28
+    FEDERATION_STATE = 30
     # Not a reference command type: the reference installs user-snapshot
     # restores through raft.Restore/InstallSnapshot; here the unpacked
     # state rides one replicated log entry instead (agent/snapshot.py).
@@ -111,6 +117,16 @@ class ConsulFSM(FSM):
             MessageType.ACL_TOKEN_DELETE: self._apply_acl_token_delete,
             MessageType.ACL_POLICY_SET: self._apply_acl_policy_set,
             MessageType.ACL_POLICY_DELETE: self._apply_acl_policy_delete,
+            MessageType.ACL_ROLE_SET: self._apply_acl_role_set,
+            MessageType.ACL_ROLE_DELETE: self._apply_acl_role_delete,
+            MessageType.ACL_BINDING_RULE_SET:
+                self._apply_acl_binding_rule_set,
+            MessageType.ACL_BINDING_RULE_DELETE:
+                self._apply_acl_binding_rule_delete,
+            MessageType.ACL_AUTH_METHOD_SET:
+                self._apply_acl_auth_method_set,
+            MessageType.ACL_AUTH_METHOD_DELETE:
+                self._apply_acl_auth_method_delete,
             MessageType.CONFIG_ENTRY: self._apply_config_entry,
         }
 
@@ -382,6 +398,27 @@ class ConsulFSM(FSM):
 
     def _apply_acl_policy_delete(self, idx: int, body: dict) -> Any:
         return self.store.acl_policy_delete(idx, body["id"])
+
+    def _apply_acl_role_set(self, idx: int, body: dict) -> Any:
+        self.store.acl_role_set(idx, body["role"])
+        return True
+
+    def _apply_acl_role_delete(self, idx: int, body: dict) -> Any:
+        return self.store.acl_role_delete(idx, body["id"])
+
+    def _apply_acl_binding_rule_set(self, idx: int, body: dict) -> Any:
+        self.store.acl_binding_rule_set(idx, body["rule"])
+        return True
+
+    def _apply_acl_binding_rule_delete(self, idx: int, body: dict) -> Any:
+        return self.store.acl_binding_rule_delete(idx, body["id"])
+
+    def _apply_acl_auth_method_set(self, idx: int, body: dict) -> Any:
+        self.store.acl_auth_method_set(idx, body["method"])
+        return True
+
+    def _apply_acl_auth_method_delete(self, idx: int, body: dict) -> Any:
+        return self.store.acl_auth_method_delete(idx, body["name"])
 
     def _apply_config_entry(self, idx: int, body: dict) -> Any:
         op = body["op"]

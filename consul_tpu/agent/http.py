@@ -486,6 +486,28 @@ class HTTPApi:
         r("GET", r"/v1/acl/policies", self.acl_policy_list)
         r("GET", r"/v1/acl/policy/(?P<pid>.+)", self.acl_policy_read)
         r("DELETE", r"/v1/acl/policy/(?P<pid>.+)", self.acl_policy_delete)
+        # acl roles / auth methods / binding rules / login
+        # (http_register.go /v1/acl/role*, /v1/acl/auth-method*,
+        #  /v1/acl/binding-rule*, /v1/acl/login, /v1/acl/logout)
+        r("PUT", r"/v1/acl/role", self.acl_role_set)
+        r("GET", r"/v1/acl/roles", self.acl_role_list)
+        r("GET", r"/v1/acl/role/name/(?P<name>.+)", self.acl_role_read_name)
+        r("GET", r"/v1/acl/role/(?P<rid>.+)", self.acl_role_read)
+        r("DELETE", r"/v1/acl/role/(?P<rid>.+)", self.acl_role_delete)
+        r("PUT", r"/v1/acl/auth-method", self.acl_auth_method_set)
+        r("GET", r"/v1/acl/auth-methods", self.acl_auth_method_list)
+        r("GET", r"/v1/acl/auth-method/(?P<name>.+)",
+          self.acl_auth_method_read)
+        r("DELETE", r"/v1/acl/auth-method/(?P<name>.+)",
+          self.acl_auth_method_delete)
+        r("PUT", r"/v1/acl/binding-rule", self.acl_binding_rule_set)
+        r("GET", r"/v1/acl/binding-rules", self.acl_binding_rule_list)
+        r("GET", r"/v1/acl/binding-rule/(?P<rid>.+)",
+          self.acl_binding_rule_read)
+        r("DELETE", r"/v1/acl/binding-rule/(?P<rid>.+)",
+          self.acl_binding_rule_delete)
+        r("POST", r"/v1/acl/login", self.acl_login)
+        r("POST", r"/v1/acl/logout", self.acl_logout)
 
     # -- helpers --------------------------------------------------------
 
@@ -1295,6 +1317,124 @@ class HTTPApi:
     async def acl_policy_delete(self, req, m) -> HTTPResponse:
         out = await self.agent.rpc("ACL.PolicyDelete", {
             "id": m.group("pid"), **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result", True)))
+
+    async def acl_role_set(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.RoleSet", {
+            "role": _decamelize(req.json()), **req.dc_option(),
+        })
+        return HTTPResponse(200, out.get("role"))
+
+    async def acl_role_list(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.RoleList", dict(req.query_options()))
+        return HTTPResponse(200, out.get("roles", []),
+                            headers=_meta_headers(out.get("meta")))
+
+    async def acl_role_read(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.RoleRead", {
+            "id": m.group("rid"), **req.query_options(),
+        })
+        if out.get("role") is None:
+            return HTTPResponse(404, {"error": "role not found"})
+        return HTTPResponse(200, out["role"])
+
+    async def acl_role_read_name(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.RoleRead", {
+            "name": m.group("name"), **req.query_options(),
+        })
+        if out.get("role") is None:
+            return HTTPResponse(404, {"error": "role not found"})
+        return HTTPResponse(200, out["role"])
+
+    async def acl_role_delete(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.RoleDelete", {
+            "id": m.group("rid"), **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result", True)))
+
+    async def acl_auth_method_set(self, req, m) -> HTTPResponse:
+        raw = req.json()
+        method = _decamelize(raw)
+        # The Config subtree's claim-mapping keys are DATA (claim names
+        # like "preferred_username"), not struct fields — rebuild them
+        # from the raw JSON so case survives the snake/camel round-trip,
+        # and mark them KeyedMap so responses leave them alone.
+        cfg_raw = raw.get("Config") or raw.get("config") or {}
+        if isinstance(cfg_raw, dict):
+            cfg = {}
+            for k, v in cfg_raw.items():
+                sk = _snake_key(k)
+                if sk in ("claim_mappings", "list_claim_mappings") \
+                        and isinstance(v, dict):
+                    v = KeyedMap(v)
+                cfg[sk] = v
+            method["config"] = cfg
+        out = await self.agent.rpc("ACL.AuthMethodSet", {
+            "auth_method": method, **req.dc_option(),
+        })
+        return HTTPResponse(200, out.get("auth_method"))
+
+    async def acl_auth_method_list(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc(
+            "ACL.AuthMethodList", dict(req.query_options())
+        )
+        return HTTPResponse(200, out.get("auth_methods", []),
+                            headers=_meta_headers(out.get("meta")))
+
+    async def acl_auth_method_read(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.AuthMethodRead", {
+            "name": m.group("name"), **req.query_options(),
+        })
+        if out.get("auth_method") is None:
+            return HTTPResponse(404, {"error": "auth method not found"})
+        return HTTPResponse(200, out["auth_method"])
+
+    async def acl_auth_method_delete(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.AuthMethodDelete", {
+            "name": m.group("name"), **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result", True)))
+
+    async def acl_binding_rule_set(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.BindingRuleSet", {
+            "binding_rule": _decamelize(req.json()), **req.dc_option(),
+        })
+        return HTTPResponse(200, out.get("binding_rule"))
+
+    async def acl_binding_rule_list(self, req, m) -> HTTPResponse:
+        body = dict(req.query_options())
+        if "authmethod" in req.query:
+            body["auth_method"] = req.query["authmethod"]
+        out = await self.agent.rpc("ACL.BindingRuleList", body)
+        return HTTPResponse(200, out.get("binding_rules", []),
+                            headers=_meta_headers(out.get("meta")))
+
+    async def acl_binding_rule_read(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.BindingRuleRead", {
+            "id": m.group("rid"), **req.query_options(),
+        })
+        if out.get("binding_rule") is None:
+            return HTTPResponse(404, {"error": "binding rule not found"})
+        return HTTPResponse(200, out["binding_rule"])
+
+    async def acl_binding_rule_delete(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.BindingRuleDelete", {
+            "id": m.group("rid"), **req.dc_option(),
+        })
+        return HTTPResponse(200, bool(out.get("result", True)))
+
+    async def acl_login(self, req, m) -> HTTPResponse:
+        # agent_endpoint.go ACLLogin: body carries AuthMethod +
+        # BearerToken; no pre-existing token is required.
+        out = await self.agent.rpc("ACL.Login", {
+            "auth": _decamelize(req.json()), **req.dc_option(),
+        })
+        return HTTPResponse(200, out.get("token"))
+
+    async def acl_logout(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("ACL.Logout", {
+            **req.query_options(), **req.dc_option(),
         })
         return HTTPResponse(200, bool(out.get("result", True)))
 

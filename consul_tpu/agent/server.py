@@ -112,6 +112,8 @@ class ServerConfig:
     acl_default_policy: str = "allow"   # "allow" | "deny"
     acl_master_token: str = ""
     acl_token_ttl_s: float = 30.0
+    # acl_token_exp.go: leader sweep cadence for expired-token GC.
+    acl_token_reap_interval_s: float = 5.0
 
 
 class Server:
@@ -140,6 +142,7 @@ class Server:
         self.acl = ACLResolver(
             token_lookup=self.store.acl_token_get,
             policy_lookup=self.store.acl_policy_get,
+            role_lookup=self.store.acl_role_get,
             enabled=config.acl_enabled,
             default_policy=config.acl_default_policy,
             master_token=config.acl_master_token,
@@ -578,6 +581,7 @@ class Server:
                 asyncio.create_task(self._coordinate_flush_loop()),
                 asyncio.create_task(self._autopilot_loop()),
                 asyncio.create_task(self._replication_loop()),
+                asyncio.create_task(self._acl_token_reap_loop()),
             ]
             self._reconcile_wake.set()
         else:
@@ -867,6 +871,26 @@ class Server:
                 except Exception as e:  # noqa: BLE001 — retry next tick
                     log.warning("%s: tombstone reap failed: %s", self.node_id, e)
                     self._tombstone_marks.append((0.0, cutoff_idx))
+
+    async def _acl_token_reap_loop(self) -> None:
+        """Delete expired ACL tokens through raft (acl_token_exp.go
+        startACLTokenReaping: periodic sweep on the leader; expired
+        tokens already fail resolution, this is garbage collection)."""
+        while True:
+            await asyncio.sleep(self.config.acl_token_reap_interval_s)
+            for rec in self.store.acl_tokens_expired(time.time()):
+                try:
+                    await self.raft_apply(
+                        MessageType.ACL_TOKEN_DELETE,
+                        {"secret_id": rec["secret_id"]},
+                    )
+                    self.acl.invalidate(rec["secret_id"])
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — retry next sweep
+                    log.warning(
+                        "%s: expired token reap failed: %s", self.node_id, e
+                    )
 
     async def _session_ttl_loop(self) -> None:
         """Invalidate sessions whose TTL lapsed without renewal

@@ -461,6 +461,10 @@ class ACLAPI(_NS):
         data, _ = await self.c.read("/v1/acl/tokens")
         return data or []
 
+    async def token_read(self, secret_id: str) -> dict:
+        data, _ = await self.c.read(f"/v1/acl/token/{secret_id}")
+        return data
+
     async def token_delete(self, secret_id: str):
         return await self.c.write("DELETE", f"/v1/acl/token/{secret_id}")
 
@@ -471,5 +475,69 @@ class ACLAPI(_NS):
         data, _ = await self.c.read("/v1/acl/policies")
         return data or []
 
+    async def policy_read(self, pid: str) -> dict:
+        data, _ = await self.c.read(f"/v1/acl/policy/{pid}")
+        return data
+
     async def policy_delete(self, pid: str):
         return await self.c.write("DELETE", f"/v1/acl/policy/{pid}")
+
+    # api/acl.go: RoleCreate/RoleList/..., AuthMethod*, BindingRule*,
+    # Login/Logout.
+
+    async def role_create(self, role: dict) -> dict:
+        return await self.c.write("PUT", "/v1/acl/role", body=role)
+
+    async def role_list(self) -> list:
+        data, _ = await self.c.read("/v1/acl/roles")
+        return data or []
+
+    async def role_read(self, rid: str = "", name: str = "") -> dict:
+        path = f"/v1/acl/role/name/{name}" if name else f"/v1/acl/role/{rid}"
+        data, _ = await self.c.read(path)
+        return data
+
+    async def role_delete(self, rid: str):
+        return await self.c.write("DELETE", f"/v1/acl/role/{rid}")
+
+    async def auth_method_create(self, method: dict) -> dict:
+        return await self.c.write("PUT", "/v1/acl/auth-method", body=method)
+
+    async def auth_method_list(self) -> list:
+        data, _ = await self.c.read("/v1/acl/auth-methods")
+        return data or []
+
+    async def auth_method_read(self, name: str) -> dict:
+        data, _ = await self.c.read(f"/v1/acl/auth-method/{name}")
+        return data
+
+    async def auth_method_delete(self, name: str):
+        return await self.c.write("DELETE", f"/v1/acl/auth-method/{name}")
+
+    async def binding_rule_create(self, rule: dict) -> dict:
+        return await self.c.write("PUT", "/v1/acl/binding-rule", body=rule)
+
+    async def binding_rule_list(self, auth_method: str = "") -> list:
+        path = "/v1/acl/binding-rules"
+        if auth_method:
+            path += f"?authmethod={auth_method}"
+        data, _ = await self.c.read(path)
+        return data or []
+
+    async def binding_rule_read(self, rid: str) -> dict:
+        data, _ = await self.c.read(f"/v1/acl/binding-rule/{rid}")
+        return data
+
+    async def binding_rule_delete(self, rid: str):
+        return await self.c.write("DELETE", f"/v1/acl/binding-rule/{rid}")
+
+    async def login(self, auth_method: str, bearer_token: str,
+                    meta: Optional[dict] = None) -> dict:
+        return await self.c.write("POST", "/v1/acl/login", body={
+            "AuthMethod": auth_method,
+            "BearerToken": bearer_token,
+            "Meta": meta or {},
+        })
+
+    async def logout(self) -> bool:
+        return await self.c.write("POST", "/v1/acl/logout")

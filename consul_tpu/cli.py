@@ -130,11 +130,20 @@ def build_parser() -> argparse.ArgumentParser:
 
     # ops ------------------------------------------------------------------
     sp = cmd("acl", cmd_acl, "ACL token and policy management")
-    sp.add_argument("subsystem", choices=["bootstrap", "token", "policy"])
+    sp.add_argument("subsystem",
+                    choices=["bootstrap", "token", "policy", "role",
+                             "auth-method", "binding-rule"])
     sp.add_argument("verb", nargs="?", default="list",
-                    choices=["list", "create", "delete"])
+                    choices=["list", "create", "delete", "read"])
     sp.add_argument("arg", nargs="?", default="",
-                    help="JSON definition, id, or secret")
+                    help="JSON definition, id, name, or secret")
+
+    sp = cmd("login", cmd_login,
+             "exchange a bearer token for a Consul token")
+    sp.add_argument("-method", required=True, dest="method")
+    sp.add_argument("-bearer-token", required=True, dest="bearer_token")
+    sp.add_argument("-token-sink-file", default="", dest="token_sink_file")
+    sp = cmd("logout", cmd_logout, "destroy the current login token")
 
     sp = cmd("debug", cmd_debug, "capture a debug bundle")
     sp.add_argument("-output", default="consul-debug.tar.gz")
@@ -530,8 +539,54 @@ async def cmd_acl(args) -> int:
                 _json.loads(args.arg) if args.arg else {}
             )
             print(f"SecretID: {tok['SecretID']}")
+        elif args.verb == "read":
+            tok = await c.acl.token_read(args.arg)
+            print(_json.dumps(tok, indent=2))
         else:
             await c.acl.token_delete(args.arg)
+            print("deleted")
+        return 0
+    if args.subsystem == "role":
+        if args.verb == "list":
+            for r in await c.acl.role_list():
+                print(f"{r.get('ID', '')}\t{r.get('Name', '')}")
+        elif args.verb == "create":
+            r = await c.acl.role_create(_json.loads(args.arg))
+            print(f"ID: {r['ID']}")
+        elif args.verb == "read":
+            r = await c.acl.role_read(name=args.arg)
+            print(_json.dumps(r, indent=2))
+        else:
+            await c.acl.role_delete(args.arg)
+            print("deleted")
+        return 0
+    if args.subsystem == "auth-method":
+        if args.verb == "list":
+            for mth in await c.acl.auth_method_list():
+                print(f"{mth.get('Name', '')}\t{mth.get('Type', '')}")
+        elif args.verb == "create":
+            mth = await c.acl.auth_method_create(_json.loads(args.arg))
+            print(f"Name: {mth['Name']}")
+        elif args.verb == "read":
+            mth = await c.acl.auth_method_read(args.arg)
+            print(_json.dumps(mth, indent=2))
+        else:
+            await c.acl.auth_method_delete(args.arg)
+            print("deleted")
+        return 0
+    if args.subsystem == "binding-rule":
+        if args.verb == "list":
+            for br in await c.acl.binding_rule_list():
+                print(f"{br.get('ID', '')}\t{br.get('AuthMethod', '')}\t"
+                      f"{br.get('BindType', '')}:{br.get('BindName', '')}")
+        elif args.verb == "create":
+            br = await c.acl.binding_rule_create(_json.loads(args.arg))
+            print(f"ID: {br['ID']}")
+        elif args.verb == "read":
+            br = await c.acl.binding_rule_read(args.arg)
+            print(_json.dumps(br, indent=2))
+        else:
+            await c.acl.binding_rule_delete(args.arg)
             print("deleted")
         return 0
     if args.verb == "list":
@@ -540,9 +595,38 @@ async def cmd_acl(args) -> int:
     elif args.verb == "create":
         pl = await c.acl.policy_create(_json.loads(args.arg))
         print(f"ID: {pl['ID']}")
+    elif args.verb == "read":
+        pl = await c.acl.policy_read(args.arg)
+        print(_json.dumps(pl, indent=2))
     else:
         await c.acl.policy_delete(args.arg)
         print("deleted")
+    return 0
+
+
+async def cmd_login(args) -> int:
+    """command/login: exchange an auth-method bearer token for a
+    Consul token (command/acl/authmethod login.go)."""
+    c = _client(args)
+    tok = await c.acl.login(args.method, args.bearer_token)
+    secret = tok.get("SecretID", "")
+    if args.token_sink_file:
+        import os as _os
+        fd = _os.open(args.token_sink_file,
+                      _os.O_WRONLY | _os.O_CREAT | _os.O_TRUNC, 0o600)
+        with _os.fdopen(fd, "w") as f:
+            f.write(secret)
+        print(f"token written to {args.token_sink_file}")
+    else:
+        print(f"SecretID: {secret}")
+    return 0
+
+
+async def cmd_logout(args) -> int:
+    """command/logout: destroy the login token in use."""
+    c = _client(args)
+    await c.acl.logout()
+    print("logged out")
     return 0
 
 

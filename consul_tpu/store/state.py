@@ -97,8 +97,37 @@ def _schemas() -> list[TableSchema]:
             primary=lambda r: _b(r["kind"]) + SEP + _b(r["name"]),
         ),
         TableSchema("prepared_queries", primary=lambda r: _b(r["id"])),
-        TableSchema("acl_tokens", primary=lambda r: _b(r["secret_id"])),
+        TableSchema(
+            "acl_tokens",
+            primary=lambda r: _b(r["secret_id"]),
+            indexes=(
+                IndexSchema(
+                    "auth_method",
+                    key=lambda r: (
+                        _b(r["auth_method"]) if r.get("auth_method")
+                        else None
+                    ),
+                ),
+            ),
+        ),
         TableSchema("acl_policies", primary=lambda r: _b(r["id"])),
+        # ACL roles / auth methods / binding rules
+        # (state/acl.go ACLRole*, ACLAuthMethod*, ACLBindingRule* txns).
+        TableSchema(
+            "acl_roles",
+            primary=lambda r: _b(r["id"]),
+            indexes=(IndexSchema("name", key=lambda r: _b(r["name"])),),
+        ),
+        TableSchema("acl_auth_methods", primary=lambda r: _b(r["name"])),
+        TableSchema(
+            "acl_binding_rules",
+            primary=lambda r: _b(r["id"]),
+            indexes=(
+                IndexSchema(
+                    "auth_method", key=lambda r: _b(r["auth_method"])
+                ),
+            ),
+        ),
         # Connect: service-to-service intentions + CA roots
         # (state/intention.go, state/connect_ca.go).
         TableSchema(
@@ -949,6 +978,136 @@ class StateStore:
         self._bump(tx, idx, "acl_policies")
         tx.commit()
         return True
+
+    # -- ACL roles / auth methods / binding rules (state/acl.go) ------------
+
+    @_writer
+    def acl_role_set(self, idx: int, role: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("acl_roles", _b(role["id"]))
+        rec = dict(role)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("acl_roles", rec)
+        self._bump(tx, idx, "acl_roles")
+        tx.commit()
+
+    def acl_role_get(self, rid: str) -> Optional[dict]:
+        return self.db.txn().get("acl_roles", _b(rid))
+
+    def acl_role_get_by_name(self, name: str) -> Optional[dict]:
+        return self.db.txn().first(
+            "acl_roles", _b(name) + SEP, index="name"
+        )
+
+    def acl_role_list(self) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return self.max_index("acl_roles", tx=tx), tx.records("acl_roles")
+
+    @_writer
+    def acl_role_delete(self, idx: int, rid: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.delete("acl_roles", _b(rid)) is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "acl_roles")
+        tx.commit()
+        return True
+
+    @_writer
+    def acl_auth_method_set(self, idx: int, method: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("acl_auth_methods", _b(method["name"]))
+        rec = dict(method)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("acl_auth_methods", rec)
+        self._bump(tx, idx, "acl_auth_methods")
+        tx.commit()
+
+    def acl_auth_method_get(self, name: str) -> Optional[dict]:
+        return self.db.txn().get("acl_auth_methods", _b(name))
+
+    def acl_auth_method_list(self) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("acl_auth_methods", tx=tx),
+            tx.records("acl_auth_methods"),
+        )
+
+    @_writer
+    def acl_auth_method_delete(self, idx: int, name: str) -> bool:
+        """Deleting an auth method cascades to its binding rules and to
+        every token it minted (state/acl.go ACLAuthMethodDeleteTxn →
+        aclBindingRuleDeleteAllForAuthMethodTxn +
+        aclTokenDeleteAllForAuthMethodTxn)."""
+        tx = self.db.txn(write=True)
+        if tx.delete("acl_auth_methods", _b(name)) is None:
+            tx.abort()
+            return False
+        for rec in tx.records(
+            "acl_binding_rules", _b(name) + SEP, index="auth_method"
+        ):
+            tx.delete("acl_binding_rules", _b(rec["id"]))
+        for rec in tx.records(
+            "acl_tokens", _b(name) + SEP, index="auth_method"
+        ):
+            tx.delete("acl_tokens", _b(rec["secret_id"]))
+        self._bump(tx, idx, "acl_auth_methods")
+        self._bump(tx, idx, "acl_binding_rules")
+        self._bump(tx, idx, "acl_tokens")
+        tx.commit()
+        return True
+
+    @_writer
+    def acl_binding_rule_set(self, idx: int, rule: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("acl_binding_rules", _b(rule["id"]))
+        rec = dict(rule)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("acl_binding_rules", rec)
+        self._bump(tx, idx, "acl_binding_rules")
+        tx.commit()
+
+    def acl_binding_rule_get(self, rid: str) -> Optional[dict]:
+        return self.db.txn().get("acl_binding_rules", _b(rid))
+
+    def acl_binding_rule_list(
+        self, auth_method: str = ""
+    ) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        if auth_method:
+            rules = tx.records(
+                "acl_binding_rules",
+                _b(auth_method) + SEP,
+                index="auth_method",
+            )
+        else:
+            rules = tx.records("acl_binding_rules")
+        return self.max_index("acl_binding_rules", tx=tx), rules
+
+    @_writer
+    def acl_binding_rule_delete(self, idx: int, rid: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.delete("acl_binding_rules", _b(rid)) is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "acl_binding_rules")
+        tx.commit()
+        return True
+
+    def acl_tokens_expired(self, now: float, limit: int = 256) -> list[dict]:
+        """Tokens whose expiration_time has passed (acl_token_exp.go
+        ListExpiredLocalTokens equivalent, capped per sweep)."""
+        out = []
+        for rec in self.db.txn().records("acl_tokens"):
+            exp = rec.get("expiration_time")
+            if exp and now >= float(exp):
+                out.append(rec)
+                if len(out) >= limit:
+                    break
+        return out
 
     # -- connect: intentions + CA roots (state/intention.go) ----------------
 

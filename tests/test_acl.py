@@ -407,3 +407,252 @@ class TestHardenedSurfaces:
             st, _, rows = await http_call(
                 addr, "GET", "/v1/kv/app/secret/s", headers=mk)
             assert st == 200 and rows
+
+
+# ---------------------------------------------------------------------------
+# roles / auth methods / binding rules / login (acl_endpoint.go,
+# acl_authmethod.go, authmethod/authmethods.go)
+# ---------------------------------------------------------------------------
+
+
+def test_role_expansion_and_identities_in_resolver():
+    roles = {"r1": {"id": "r1", "name": "ops", "policies": ["p1"],
+                    "service_identities": [{"service_name": "web"}]}}
+    policies = {"p1": {"id": "p1", "rules": json.dumps(
+        {"key_prefix": {"cfg/": {"policy": "write"}}})}}
+    tokens = {"s1": {"secret_id": "s1", "roles": ["r1"]}}
+    r = ACLResolver(tokens.get, policies.get, enabled=True,
+                    default_policy="deny", role_lookup=roles.get)
+    a = r.resolve("s1")
+    assert a.key_write("cfg/x")                 # via role -> policy
+    assert a.service_write("web")               # via role -> identity
+    assert a.service_write("web-sidecar-proxy")
+    assert a.service_read("other")              # discovery read
+    assert not a.service_write("other")
+    assert not a.key_read("elsewhere")
+
+
+def test_expired_token_resolves_as_not_found():
+    import time as _time
+    tokens = {"s1": {"secret_id": "s1", "policies": [],
+                     "expiration_time": _time.time() - 1}}
+    r = ACLResolver(tokens.get, lambda _p: None, enabled=True,
+                    default_policy="deny")
+    with pytest.raises(ACLError):
+        r.resolve("s1")
+
+
+def test_jwt_hs256_roundtrip_and_bindings():
+    from consul_tpu.acl import jwt as jwt_mod
+
+    tok = jwt_mod.encode_hs256(
+        {"iss": "idp", "aud": "consul", "sub": "alice",
+         "ns": "team-a", "groups": ["dev", "ops"]}, "sekrit")
+    claims = jwt_mod.validate(tok, secret="sekrit", bound_issuer="idp",
+                              bound_audiences=["consul"])
+    assert claims["sub"] == "alice"
+    with pytest.raises(jwt_mod.JWTError):
+        jwt_mod.validate(tok, secret="wrong")
+    with pytest.raises(jwt_mod.JWTError):
+        jwt_mod.validate(tok, secret="sekrit", bound_issuer="other")
+    import time as _time
+    expired = jwt_mod.encode_hs256(
+        {"iss": "idp", "exp": _time.time() - 3600}, "sekrit")
+    with pytest.raises(jwt_mod.JWTError):
+        jwt_mod.validate(expired, secret="sekrit")
+    sel, proj = jwt_mod.identity_from_claims(
+        claims, {"sub": "user", "ns": "namespace"}, {"groups": "groups"})
+    assert sel["value"] == {"user": "alice", "namespace": "team-a"}
+    assert sel["list"]["groups"] == ["dev", "ops"]
+    assert proj["user"] == "alice"
+
+
+class TestRolesAndLogin:
+    async def test_role_crud_and_token_with_role(self):
+        async with acl_stack() as (_agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            rules = json.dumps(
+                {"key_prefix": {"cfg/": {"policy": "write"}}})
+            st, _, pol = await http_call(
+                addr, "PUT", "/v1/acl/policy",
+                json.dumps({"Name": "cfg", "Rules": rules}).encode(),
+                headers=mk)
+            assert st == 200
+            st, _, role = await http_call(
+                addr, "PUT", "/v1/acl/role",
+                json.dumps({"Name": "ops",
+                            "Policies": [pol["ID"]]}).encode(),
+                headers=mk)
+            assert st == 200, role
+            # read by name
+            st, _, got = await http_call(
+                addr, "GET", "/v1/acl/role/name/ops", headers=mk)
+            assert st == 200 and got["ID"] == role["ID"]
+            # duplicate name refused
+            st, _, err = await http_call(
+                addr, "PUT", "/v1/acl/role",
+                json.dumps({"Name": "ops"}).encode(), headers=mk)
+            assert st == 400, err
+            # token linked to the role gets the role's policies
+            st, _, tok = await http_call(
+                addr, "PUT", "/v1/acl/token",
+                json.dumps({"Roles": [role["ID"]]}).encode(), headers=mk)
+            assert st == 200
+            hdr = {"X-Consul-Token": tok["SecretID"]}
+            st, _, ok = await http_call(
+                addr, "PUT", "/v1/kv/cfg/a", b"v", headers=hdr)
+            assert st == 200 and ok is True
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/kv/other", b"v", headers=hdr)
+            assert st == 403
+
+    async def test_login_flow_end_to_end(self):
+        from consul_tpu.acl import jwt as jwt_mod
+
+        async with acl_stack() as (_agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            # policy + role the binding rule will bind to
+            rules = json.dumps(
+                {"key_prefix": {"team-a/": {"policy": "write"}}})
+            st, _, pol = await http_call(
+                addr, "PUT", "/v1/acl/policy",
+                json.dumps({"Name": "team-a-kv",
+                            "Rules": rules}).encode(), headers=mk)
+            assert st == 200
+            st, _, _role = await http_call(
+                addr, "PUT", "/v1/acl/role",
+                json.dumps({"Name": "team-a",
+                            "Policies": [pol["ID"]]}).encode(),
+                headers=mk)
+            assert st == 200
+            # jwt auth method + binding rule with selector and
+            # interpolated bind name
+            st, _, meth = await http_call(
+                addr, "PUT", "/v1/acl/auth-method",
+                json.dumps({
+                    "Name": "idp", "Type": "jwt",
+                    "MaxTokenTTLS": 60,
+                    "Config": {
+                        "JwtSecret": "sekrit",
+                        "BoundIssuer": "https://idp",
+                        "ClaimMappings": {"team": "team"},
+                    },
+                }).encode(), headers=mk)
+            assert st == 200, meth
+            st, _, br = await http_call(
+                addr, "PUT", "/v1/acl/binding-rule",
+                json.dumps({
+                    "AuthMethod": "idp",
+                    "Selector": 'value.team == "team-a"',
+                    "BindType": "role",
+                    "BindName": "${team}",
+                }).encode(), headers=mk)
+            assert st == 200, br
+
+            # login with a matching JWT
+            bearer = jwt_mod.encode_hs256(
+                {"iss": "https://idp", "team": "team-a"}, "sekrit")
+            st, _, tok = await http_call(
+                addr, "POST", "/v1/acl/login",
+                json.dumps({"AuthMethod": "idp",
+                            "BearerToken": bearer}).encode())
+            assert st == 200, tok
+            assert tok["AuthMethod"] == "idp"
+            assert tok["ExpirationTime"] > 0
+            hdr = {"X-Consul-Token": tok["SecretID"]}
+            st, _, ok = await http_call(
+                addr, "PUT", "/v1/kv/team-a/x", b"v", headers=hdr)
+            assert st == 200 and ok is True
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/kv/other", b"v", headers=hdr)
+            assert st == 403
+
+            # wrong team -> selector mismatch -> 403, no token minted
+            bad = jwt_mod.encode_hs256(
+                {"iss": "https://idp", "team": "team-b"}, "sekrit")
+            st, _, err = await http_call(
+                addr, "POST", "/v1/acl/login",
+                json.dumps({"AuthMethod": "idp",
+                            "BearerToken": bad}).encode())
+            assert st == 403, err
+            # bad signature -> 403
+            forged = jwt_mod.encode_hs256(
+                {"iss": "https://idp", "team": "team-a"}, "wrong")
+            st, _, err = await http_call(
+                addr, "POST", "/v1/acl/login",
+                json.dumps({"AuthMethod": "idp",
+                            "BearerToken": forged}).encode())
+            assert st == 403, err
+
+            # logout destroys the login token
+            st, _, _x = await http_call(
+                addr, "POST", "/v1/acl/logout", headers=hdr)
+            assert st == 200
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/kv/team-a/y", b"v", headers=hdr)
+            assert st == 403
+            # a non-login token (master) cannot log out
+            st, _, _x = await http_call(
+                addr, "POST", "/v1/acl/logout", headers=mk)
+            assert st == 403
+
+    async def test_token_ttl_expires_and_reaps(self):
+        async with acl_stack() as (agent, addr):
+            agent.delegate.config.acl_token_reap_interval_s = 0.2
+            mk = {"X-Consul-Token": MASTER}
+            st, _, tok = await http_call(
+                addr, "PUT", "/v1/acl/token",
+                json.dumps({"Policies": [],
+                            "ExpirationTTLS": 0.5}).encode(), headers=mk)
+            assert st == 200 and tok["ExpirationTime"] > 0
+            secret = tok["SecretID"]
+            # valid now (resolves; default-deny means 403 on kv, but
+            # NOT "ACL not found")
+            st, _, _x = await http_call(
+                addr, "GET", "/v1/kv/x",
+                headers={"X-Consul-Token": secret})
+            assert st == 403
+            await asyncio.sleep(0.7)
+            # expired: resolution now fails as not-found (still 403 at
+            # HTTP), and the leader reaper deletes the row
+            st, _, _x = await http_call(
+                addr, "GET", "/v1/kv/x",
+                headers={"X-Consul-Token": secret})
+            assert st == 403
+            await wait_until(
+                lambda: agent.delegate.store.acl_token_get(secret) is None,
+                msg="expired token reaped")
+
+    async def test_auth_method_delete_cascades(self):
+        from consul_tpu.acl import jwt as jwt_mod
+
+        async with acl_stack() as (agent, addr):
+            mk = {"X-Consul-Token": MASTER}
+            st, _, _m = await http_call(
+                addr, "PUT", "/v1/acl/auth-method",
+                json.dumps({"Name": "idp", "Type": "jwt",
+                            "Config": {"JwtSecret": "s"}}).encode(),
+                headers=mk)
+            assert st == 200
+            st, _, br = await http_call(
+                addr, "PUT", "/v1/acl/binding-rule",
+                json.dumps({"AuthMethod": "idp", "BindType": "service",
+                            "BindName": "api"}).encode(), headers=mk)
+            assert st == 200
+            bearer = jwt_mod.encode_hs256({"sub": "x"}, "s")
+            st, _, tok = await http_call(
+                addr, "POST", "/v1/acl/login",
+                json.dumps({"AuthMethod": "idp",
+                            "BearerToken": bearer}).encode())
+            assert st == 200
+            # the login token carries a service identity -> can
+            # register/write the bound service
+            authz = agent.delegate.acl.resolve(tok["SecretID"])
+            assert authz.service_write("api")
+            st, _, _x = await http_call(
+                addr, "DELETE", "/v1/acl/auth-method/idp", headers=mk)
+            assert st == 200
+            # cascade: binding rule + minted token both gone
+            store = agent.delegate.store
+            assert store.acl_binding_rule_get(br["ID"]) is None
+            assert store.acl_token_get(tok["SecretID"]) is None
