@@ -128,12 +128,20 @@ def validate(
         pub_keys or [],
     )
     t = time.time() if now is None else now
-    exp = claims.get("exp")
-    if exp is not None and t > float(exp) + clock_skew_s:
-        raise JWTError("token is expired")
-    nbf = claims.get("nbf")
-    if nbf is not None and t < float(nbf) - clock_skew_s:
-        raise JWTError("token not yet valid")
+    try:
+        exp = claims.get("exp")
+        if exp is not None and t > float(exp) + clock_skew_s:
+            raise JWTError("token is expired")
+        nbf = claims.get("nbf")
+        if nbf is not None and t < float(nbf) - clock_skew_s:
+            raise JWTError("token not yet valid")
+    except (TypeError, ValueError) as e:
+        # Non-numeric exp/nbf in an otherwise valid token must still
+        # surface as a JWT failure (the canonical 403), not leak out as
+        # a bare conversion error.
+        if isinstance(e, JWTError):
+            raise
+        raise JWTError(f"bad exp/nbf claim: {e}") from e
     if bound_issuer and claims.get("iss") != bound_issuer:
         raise JWTError("issuer mismatch")
     if bound_audiences:

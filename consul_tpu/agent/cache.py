@@ -46,6 +46,7 @@ PREPARED_QUERY = "prepared-query"
 CONNECT_CA_ROOTS = "connect-ca-roots"
 INTENTION_MATCH = "intention-match"
 DISCOVERY_CHAIN = "discovery-chain"
+FEDERATION_MESH_GATEWAYS = "federation-state-list-mesh-gateways"
 
 REFRESH_BACKOFF_MIN = 0.5   # cache.go RefreshBackoffMin (scaled-friendly)
 REFRESH_TIMEOUT = 600.0     # cache-types' 10-minute blocking wait
@@ -76,6 +77,10 @@ TYPES: dict[str, CacheType] = {
                   key_fields=("destination", "dc")),
         CacheType(DISCOVERY_CHAIN, "DiscoveryChain.Get",
                   key_fields=("name", "dc")),
+        # cache-types/federation_state_list_mesh_gateways.go: the data
+        # plane's cross-DC gateway map, blocking on federation states.
+        CacheType(FEDERATION_MESH_GATEWAYS,
+                  "FederationState.ListMeshGateways", key_fields=("dc",)),
         CacheType(CATALOG_SERVICES, "Catalog.ServiceNodes",
                   key_fields=("service", "tag", "dc")),
         CacheType(CATALOG_LIST_NODES, "Catalog.ListNodes",

@@ -1140,7 +1140,8 @@ class ACL(_Endpoint):
         rule = dict(body.get("binding_rule") or {})
         if not rule.get("auth_method"):
             raise ValueError("binding rule must name an auth method")
-        if self.server.store.acl_auth_method_get(rule["auth_method"]) is None:
+        method = self.server.store.acl_auth_method_get(rule["auth_method"])
+        if method is None:
             raise ValueError(
                 f"no such auth method {rule['auth_method']!r}"
             )
@@ -1155,8 +1156,7 @@ class ACL(_Endpoint):
         # (acl_endpoint.go BindingRuleSet → validateBindingRuleBindName
         # with validator.ProjectedVarNames) — a typo'd ${var} must fail
         # the write, not every later login.
-        method = self.server.store.acl_auth_method_get(rule["auth_method"])
-        cfg = (method or {}).get("config") or {}
+        cfg = method.get("config") or {}
         known = {str(v) for v in (cfg.get("claim_mappings") or {}).values()}
         try:
             _interpolate_bind_name(
@@ -1319,6 +1319,92 @@ class ACL(_Endpoint):
         return {"result": result}
 
 
+class FederationState(_Endpoint):
+    """federation_state_endpoint.go — CRUD over the per-DC mesh-gateway
+    map.  Writes ALWAYS land in the primary datacenter and replicate
+    outward (federation_state_endpoint.go:25-28)."""
+
+    async def apply(self, body: dict):
+        # Rewrite the target DC to the primary BEFORE forwarding — every
+        # federation-state write goes to the primary's raft.
+        body = {**body, "dc": self.server.config.primary_datacenter
+                or self.server.config.datacenter}
+        fwd = await self.server.forward("FederationState.Apply", body)
+        if fwd is not None:
+            return fwd
+        self.server.acl_check(body, "operator", "", WRITE)
+        state = body.get("state") or {}
+        if not state.get("datacenter"):
+            raise ValueError(
+                "invalid request: missing federation state datacenter"
+            )
+        op = body.get("op", "upsert")
+        if op not in ("upsert", "delete"):
+            raise ValueError(f"Invalid federation state operation: {op}")
+        result = await self.server.raft_apply(
+            MessageType.FEDERATION_STATE, {"op": op, "state": state}
+        )
+        return {"result": result}
+
+    async def get(self, body: dict):
+        fwd = await self.server.forward(
+            "FederationState.Get", body, read=True
+        )
+        if fwd is not None:
+            return fwd
+        self.server.acl_check(body, "operator", "", READ)
+
+        def run(ws):
+            idx, state = self.server.store.federation_state_get(
+                body["target_dc"], ws=ws
+            )
+            return max(idx, 1), {"state": state}
+
+        return await self._read("FederationState.Get", body, run)
+
+    async def list(self, body: dict):
+        fwd = await self.server.forward(
+            "FederationState.List", body, read=True
+        )
+        if fwd is not None:
+            return fwd
+        self.server.acl_check(body, "operator", "", READ)
+
+        def run(ws):
+            idx, states = self.server.store.federation_state_list(ws=ws)
+            return max(idx, 1), {"states": states}
+
+        return await self._read("FederationState.List", body, run)
+
+    async def list_mesh_gateways(self, body: dict):
+        """DC → healthy-ish mesh gateway instances, the data plane's
+        cross-DC routing table (federation_state_endpoint.go
+        ListMeshGateways).  Gateways are services — service:read
+        filtering applies like any catalog read."""
+        fwd = await self.server.forward(
+            "FederationState.ListMeshGateways", body, read=True
+        )
+        if fwd is not None:
+            return fwd
+
+        def run(ws):
+            idx, states = self.server.store.federation_state_list(ws=ws)
+            authz = self._authz(body)
+            out = {}
+            for st in states:
+                gws = st.get("mesh_gateways", [])
+                if authz is not None:
+                    gws = [g for g in gws
+                           if authz.service_read(g.get("service", ""))]
+                if gws:
+                    out[st["datacenter"]] = gws
+            return max(idx, 1), {"gateways": out}
+
+        return await self._read(
+            "FederationState.ListMeshGateways", body, run
+        )
+
+
 class Snapshot(_Endpoint):
     """snapshot_endpoint.go: atomic save/restore of the full state.
     The reference gates both on management tokens; approximated here as
@@ -1426,4 +1512,5 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "Snapshot": Snapshot(server),
         "Subscribe": Subscribe(server),
         "DiscoveryChain": DiscoveryChain(server),
+        "FederationState": FederationState(server),
     }

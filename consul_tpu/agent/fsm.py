@@ -128,6 +128,7 @@ class ConsulFSM(FSM):
             MessageType.ACL_AUTH_METHOD_DELETE:
                 self._apply_acl_auth_method_delete,
             MessageType.CONFIG_ENTRY: self._apply_config_entry,
+            MessageType.FEDERATION_STATE: self._apply_federation_state,
         }
 
     # -- raft.FSM interface -------------------------------------------------
@@ -419,6 +420,21 @@ class ConsulFSM(FSM):
 
     def _apply_acl_auth_method_delete(self, idx: int, body: dict) -> Any:
         return self.store.acl_auth_method_delete(idx, body["name"])
+
+    def _apply_federation_state(self, idx: int, body: dict) -> Any:
+        """fsm/commands_oss.go applyFederationStateOperation."""
+        op = body["op"]
+        state = body.get("state") or {}
+        if not state.get("datacenter"):
+            raise ValueError("federation state must name a datacenter")
+        if op == "upsert":
+            self.store.federation_state_set(idx, state)
+            return True
+        if op == "delete":
+            return self.store.federation_state_delete(
+                idx, state["datacenter"]
+            )
+        raise ValueError(f"invalid federation state operation {op!r}")
 
     def _apply_config_entry(self, idx: int, body: dict) -> Any:
         op = body["op"]

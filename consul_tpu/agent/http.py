@@ -437,6 +437,13 @@ class HTTPApi:
         # configuration provider/key changes; collapsed to an explicit
         # operator verb here).
         r("PUT", r"/v1/connect/ca/rotate", self.connect_ca_rotate)
+        # federation states (http_register.go /v1/internal/federation-state*)
+        r("GET", r"/v1/internal/federation-states/mesh-gateways",
+          self.federation_state_mesh_gateways)
+        r("GET", r"/v1/internal/federation-states",
+          self.federation_state_list)
+        r("GET", r"/v1/internal/federation-state/(?P<dc>[^/?]+)",
+          self.federation_state_get)
         # discovery chain (discovery_chain_endpoint.go /v1/discovery-chain/)
         r("GET", r"/v1/discovery-chain/(?P<svc>[^/?]+)",
           self.discovery_chain_get)
@@ -1104,6 +1111,32 @@ class HTTPApi:
         return HTTPResponse(200, {"chain": chain},
                             headers=_meta_headers(out.get("meta")))
 
+    # -- federation states ---------------------------------------------------
+
+    async def federation_state_list(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc(
+            "FederationState.List", dict(req.query_options())
+        )
+        return HTTPResponse(200, out.get("states", []),
+                            headers=_meta_headers(out.get("meta")))
+
+    async def federation_state_get(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc("FederationState.Get", {
+            "target_dc": m.group("dc"), **req.query_options(),
+        })
+        if out.get("state") is None:
+            return HTTPResponse(404, {"error": "federation state not found"})
+        return HTTPResponse(200, {"state": out["state"]},
+                            headers=_meta_headers(out.get("meta")))
+
+    async def federation_state_mesh_gateways(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc(
+            "FederationState.ListMeshGateways", dict(req.query_options())
+        )
+        # DC names are data keys — keep them out of camelization.
+        return HTTPResponse(200, KeyedMap(out.get("gateways", {})),
+                            headers=_meta_headers(out.get("meta")))
+
     # -- connect -------------------------------------------------------------
 
     async def connect_ca_roots(self, req, m) -> HTTPResponse:
@@ -1379,7 +1412,9 @@ class HTTPApi:
         out = await self.agent.rpc(
             "ACL.AuthMethodList", dict(req.query_options())
         )
-        return HTTPResponse(200, out.get("auth_methods", []),
+        methods = [_shield_claim_keys(mth)
+                   for mth in out.get("auth_methods", [])]
+        return HTTPResponse(200, methods,
                             headers=_meta_headers(out.get("meta")))
 
     async def acl_auth_method_read(self, req, m) -> HTTPResponse:
@@ -1388,7 +1423,7 @@ class HTTPApi:
         })
         if out.get("auth_method") is None:
             return HTTPResponse(404, {"error": "auth method not found"})
-        return HTTPResponse(200, out["auth_method"])
+        return HTTPResponse(200, _shield_claim_keys(out["auth_method"]))
 
     async def acl_auth_method_delete(self, req, m) -> HTTPResponse:
         out = await self.agent.rpc("ACL.AuthMethodDelete", {
@@ -1450,6 +1485,21 @@ class HTTPApi:
 
 
 _CAMEL_SPLIT = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def _shield_claim_keys(method: dict) -> dict:
+    """Re-mark an auth method's claim-mapping keys as data before the
+    response camelizes.  The KeyedMap wrapper applied at write time does
+    not survive raft replication or a snapshot round-trip (it serializes
+    as a plain dict), so reads re-apply it."""
+    cfg = method.get("config")
+    if not isinstance(cfg, dict):
+        return method
+    cfg = dict(cfg)
+    for k in ("claim_mappings", "list_claim_mappings"):
+        if isinstance(cfg.get(k), dict):
+            cfg[k] = KeyedMap(cfg[k])
+    return {**method, "config": cfg}
 
 
 def _decamelize(obj: Any) -> Any:

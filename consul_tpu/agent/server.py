@@ -114,6 +114,9 @@ class ServerConfig:
     acl_token_ttl_s: float = 30.0
     # acl_token_exp.go: leader sweep cadence for expired-token GC.
     acl_token_reap_interval_s: float = 5.0
+    # leader_federation_state_ae.go: cadence for publishing this DC's
+    # mesh-gateway set to the primary.
+    federation_state_ae_interval_s: float = 30.0
 
 
 class Server:
@@ -206,6 +209,14 @@ class Server:
                 wan_transport,
             )
         self.router = Router(config.datacenter, self.serf_wan)
+
+        # Mesh-gateway locator for wan federation (gateway_locator.go).
+        from consul_tpu.connect.gateways import GatewayLocator
+
+        self.gateway_locator = GatewayLocator(
+            self.store, config.datacenter,
+            config.primary_datacenter or config.datacenter,
+        )
 
         self.raft: Optional[RaftNode] = None
         # Built-in Connect CA, created lazily on the leader (the private
@@ -582,6 +593,7 @@ class Server:
                 asyncio.create_task(self._autopilot_loop()),
                 asyncio.create_task(self._replication_loop()),
                 asyncio.create_task(self._acl_token_reap_loop()),
+                asyncio.create_task(self._federation_state_ae_loop()),
             ]
             self._reconcile_wake.set()
         else:
@@ -739,9 +751,9 @@ class Server:
 
     async def _replication_loop(self) -> None:
         """Primary→secondary replication (config_replication.go +
-        acl_replication.go): rate-limited pull loops on the secondary's
-        leader; remote state is diffed against local and converged
-        through the local raft."""
+        acl_replication.go + federation_state_replication.go):
+        rate-limited pull loops on the secondary's leader; remote state
+        is diffed against local and converged through the local raft."""
         if not self._is_secondary():
             return
         while not self._shutdown:
@@ -751,8 +763,76 @@ class Server:
                     continue
                 await self._replicate_config_entries()
                 await self._replicate_acl()
+                await self._replicate_federation_states()
             except Exception:
                 log.exception("replication round failed")
+
+    async def _federation_state_ae_loop(self) -> None:
+        """Every DC's leader publishes its own mesh-gateway set to the
+        PRIMARY's raft (leader_federation_state_ae.go
+        FederationStateAntiEntropy); secondaries then pull the full map
+        back via _replicate_federation_states, so each DC learns every
+        other DC's gateways."""
+        while True:
+            await asyncio.sleep(self.config.federation_state_ae_interval_s)
+            try:
+                own = self.gateway_locator.build_own_state()
+                # Skip the write when the published state already
+                # matches (the reference diffs content before writing
+                # for the same reason: no raft churn).  An EMPTY set
+                # still publishes over a non-empty record — losing the
+                # last gateway must prune the stale addresses everywhere
+                # (leader_federation_state_ae.go replicates deletions
+                # the same way).
+                _, current = self.store.federation_state_get(
+                    self.config.datacenter
+                )
+                if current is None:
+                    if not own["mesh_gateways"]:
+                        continue  # nothing to advertise yet
+                elif self._strip_indexes(current) == own:
+                    continue
+                await self.rpc_server.dispatch_local(
+                    "FederationState.Apply",
+                    {"op": "upsert", "state": own,
+                     "token": self.config.acl_replication_token
+                     or self.config.acl_master_token},
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — retry next tick
+                log.warning(
+                    "%s: federation state anti-entropy failed: %s",
+                    self.node_id, e,
+                )
+
+    async def _replicate_federation_states(self) -> None:
+        """Pull every DC's federation state from the primary
+        (federation_state_replication.go).  Own-DC state is replicated
+        too — the AE loop is the writer of record and re-pushes if the
+        catalog moved on."""
+        primary = self.config.primary_datacenter
+        out = await self._forward_dc(
+            "FederationState.List",
+            {"dc": primary, "token": self.config.acl_replication_token},
+            primary,
+        )
+        remote = {s["datacenter"]: self._strip_indexes(s)
+                  for s in out.get("states", [])}
+        _, local_list = self.store.federation_state_list()
+        local = {s["datacenter"]: self._strip_indexes(s)
+                 for s in local_list}
+        for dc, state in remote.items():
+            if local.get(dc) != state:
+                await self.raft_apply(
+                    MessageType.FEDERATION_STATE,
+                    {"op": "upsert", "state": state},
+                )
+        for dc in set(local) - set(remote):
+            await self.raft_apply(
+                MessageType.FEDERATION_STATE,
+                {"op": "delete", "state": {"datacenter": dc}},
+            )
 
     @staticmethod
     def _strip_indexes(rec: dict) -> dict:

@@ -36,6 +36,7 @@ from typing import AsyncIterator, Optional
 from consul_tpu.agent.cache import (
     CONNECT_CA_ROOTS,
     DISCOVERY_CHAIN,
+    FEDERATION_MESH_GATEWAYS,
     HEALTH_SERVICES,
     INTENTION_MATCH,
 )
@@ -152,9 +153,22 @@ class _ProxyState:
             chain = chain_out.get("chain") or {}
             instances: dict[str, list[dict]] = {}
             for tid, target in (chain.get("targets") or {}).items():
+                remote = target["datacenter"] != self.m.datacenter
+                mode = target.get("mesh_gateway", "default")
+                if remote and mode in ("local", "remote"):
+                    # WAN federation through mesh gateways
+                    # (proxycfg/state.go resetWatchesFromChain →
+                    # mesh-gateway watches; endpoints.go
+                    # makeUpstreamLoadAssignmentForMeshGateway): dial a
+                    # gateway instead of the instances — the LOCAL DC's
+                    # gateways in local mode, the TARGET DC's (WAN
+                    # addresses, via federation state) in remote mode.
+                    instances[tid] = await self._gateway_endpoints(
+                        mode, target["datacenter"])
+                    continue
                 req = {"service": target["service"], "connect": True,
                        "passing_only": True}
-                if target["datacenter"] != self.m.datacenter:
+                if remote:
                     req["dc"] = target["datacenter"]
                 hkey = f"{target['service']}@{target['datacenter']}"
                 if hkey not in self._health_watched:
@@ -191,6 +205,53 @@ class _ProxyState:
         }
         self.changed.set()
         self.changed = asyncio.Event()
+
+    async def _gateway_endpoints(self, mode: str,
+                                 target_dc: str) -> list[dict]:
+        """Mesh-gateway endpoints for a gateway-routed upstream, with a
+        live watch so assembly re-runs as gateways come and go.
+
+        local mode   this DC's own gateways, straight from the local
+                     catalog (health-watched — a freshly registered
+                     gateway is visible immediately, and the watch fires
+                     on changes)
+        remote mode  the TARGET DC's gateways (WAN addresses) from the
+                     replicated federation-state map, watched through
+                     the federation-mesh-gateways cache type
+        """
+        from consul_tpu.connect.gateways import (
+            KIND_MESH_GATEWAY,
+            WANFED_META,
+            gateway_endpoint,
+        )
+
+        cache = self.m.cache
+        if mode == "local":
+            req = {"service": KIND_MESH_GATEWAY, "passing_only": True}
+            if "local-gateways" not in self._health_watched:
+                cache.notify(HEALTH_SERVICES, req, self._queue)
+                self._health_watched.add("local-gateways")
+            out = await cache.get(HEALTH_SERVICES, req)
+            svcs = []
+            for row in out.get("nodes") or []:
+                svc = dict(row.get("service") or {})
+                svc.setdefault("node", (row.get("node") or {}).get("node"))
+                svc.setdefault(
+                    "node_address", (row.get("node") or {}).get("address"))
+                svcs.append(svc)
+            wan = False
+        else:
+            if "federation-gateways" not in self._health_watched:
+                cache.notify(FEDERATION_MESH_GATEWAYS, {}, self._queue)
+                self._health_watched.add("federation-gateways")
+            out = await cache.get(FEDERATION_MESH_GATEWAYS, {})
+            svcs = (out.get("gateways") or {}).get(target_dc, [])
+            wan = True
+        return [
+            gateway_endpoint(svc, wan=wan) for svc in svcs
+            if svc.get("kind") == KIND_MESH_GATEWAY
+            and (svc.get("meta") or {}).get(WANFED_META) == "1"
+        ]
 
     @staticmethod
     def _endpoint(row: dict) -> dict:

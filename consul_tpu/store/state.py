@@ -139,6 +139,11 @@ def _schemas() -> list[TableSchema]:
             ),
         ),
         TableSchema("connect_ca_roots", primary=lambda r: _b(r["id"])),
+        # WAN federation: one record per datacenter carrying its mesh
+        # gateways (state/federation_state.go).
+        TableSchema(
+            "federation_states", primary=lambda r: _b(r["datacenter"])
+        ),
         TableSchema("index", primary=lambda r: _b(r["key"])),
     ]
 
@@ -262,6 +267,9 @@ class StateStore:
             "port": int(svc.get("port", 0)),
             "meta": svc.get("meta", {}),
             "weights": svc.get("weights", {"passing": 1, "warning": 1}),
+            # structs.NodeService.TaggedAddresses: per-service lan/wan
+            # addresses — mesh gateways advertise their WAN side here.
+            "tagged_addresses": svc.get("tagged_addresses", {}),
             # Mesh registration fields (structs.NodeService Kind/Proxy/
             # Connect): connect_service_nodes keys off these.
             "kind": svc.get("kind", ""),
@@ -271,9 +279,9 @@ class StateStore:
             "modify_index": idx,
         }
         if existing and all(
-            existing[k] == rec[k]
+            existing.get(k) == rec[k]
             for k in ("service", "tags", "address", "port", "meta", "weights",
-                      "kind", "proxy", "connect_native")
+                      "tagged_addresses", "kind", "proxy", "connect_native")
         ):
             return
         tx.insert("services", rec)
@@ -1096,6 +1104,65 @@ class StateStore:
         self._bump(tx, idx, "acl_binding_rules")
         tx.commit()
         return True
+
+    # -- federation states (state/federation_state.go) ----------------------
+
+    @_writer
+    def federation_state_set(self, idx: int, state: dict) -> None:
+        tx = self.db.txn(write=True)
+        existing = tx.get("federation_states", _b(state["datacenter"]))
+        rec = dict(state)
+        rec["create_index"] = existing["create_index"] if existing else idx
+        rec["modify_index"] = idx
+        tx.insert("federation_states", rec)
+        self._bump(tx, idx, "federation_states")
+        tx.commit()
+
+    def federation_state_get(
+        self, dc: str, ws: Optional[WatchSet] = None
+    ) -> tuple[int, Optional[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("federation_states", tx=tx),
+            tx.get("federation_states", _b(dc), ws=ws),
+        )
+
+    def federation_state_list(
+        self, ws: Optional[WatchSet] = None
+    ) -> tuple[int, list[dict]]:
+        tx = self.db.txn()
+        return (
+            self.max_index("federation_states", tx=tx),
+            tx.records("federation_states", ws=ws),
+        )
+
+    @_writer
+    def federation_state_delete(self, idx: int, dc: str) -> bool:
+        tx = self.db.txn(write=True)
+        if tx.delete("federation_states", _b(dc)) is None:
+            tx.abort()
+            return False
+        self._bump(tx, idx, "federation_states")
+        tx.commit()
+        return True
+
+    def services_by_kind(
+        self, kind: str, ws: Optional[WatchSet] = None
+    ) -> tuple[int, list[dict]]:
+        """Service instances of a given kind (mesh-gateway, ...), joined
+        with node addresses like service_nodes
+        (state/catalog.go ServiceDump w/ kind filter)."""
+        tx = self.db.txn()
+        out = []
+        for rec in tx.records("services", ws=ws):
+            if rec.get("kind") != kind:
+                continue
+            node = tx.get("nodes", _b(rec["node"]), ws=ws)
+            merged = dict(rec)
+            merged["node_address"] = node["address"] if node else ""
+            merged["node_meta"] = (node.get("meta") or {}) if node else {}
+            out.append(merged)
+        return self.max_index("services", "nodes", tx=tx), out
 
     def acl_tokens_expired(self, now: float, limit: int = 256) -> list[dict]:
         """Tokens whose expiration_time has passed (acl_token_exp.go

@@ -1,0 +1,304 @@
+"""WAN federation states + mesh-gateway locator.
+
+Parity model: agent/consul/federation_state_endpoint.go (Apply always
+lands in the primary; Get/List/ListMeshGateways reads),
+leader_federation_state_ae.go (each DC's leader publishes its own
+mesh-gateway set to the primary), federation_state_replication.go
+(secondaries pull the full map back), gateway_locator.go (local LAN
+gateways vs remote WAN gateways).
+"""
+
+import asyncio
+
+import pytest
+
+from helpers import wait_for as wait_until
+from helpers import wait_for_leader
+
+from consul_tpu.agent.server import Server, ServerConfig
+from consul_tpu.net.transport import InMemoryNetwork
+
+
+def make_dc_server(lan_net, wan_net, rpc_net, name, dc, expect):
+    cfg = ServerConfig(
+        node_name=name,
+        datacenter=dc,
+        primary_datacenter="dc1",
+        bootstrap_expect=expect,
+        gossip_interval_scale=0.05,
+        reconcile_interval_s=0.2,
+        coordinate_update_period_s=0.1,
+        session_ttl_sweep_s=0.1,
+        flood_interval_s=0.1,
+        replication_interval_s=0.3,
+        federation_state_ae_interval_s=0.3,
+    )
+    return Server(
+        cfg,
+        gossip_transport=lan_net.new_transport(f"{name}.{dc}:gossip"),
+        rpc_transport=rpc_net.new_transport(f"{name}.{dc}:rpc"),
+        wan_transport=wan_net.new_transport(f"{name}.{dc}:wan"),
+    )
+
+
+async def start_two_dcs():
+    lan1, lan2 = InMemoryNetwork(), InMemoryNetwork()
+    wan, rpc = InMemoryNetwork(), InMemoryNetwork()
+    dc1 = [make_dc_server(lan1, wan, rpc, "a0", "dc1", 1)]
+    dc2 = [make_dc_server(lan2, wan, rpc, "b0", "dc2", 1)]
+    for s in dc1 + dc2:
+        await s.start()
+    await wait_for_leader(dc1)
+    await wait_for_leader(dc2)
+    assert await dc2[0].join_wan(["a0.dc1:wan"]) == 1
+    return dc1, dc2
+
+
+async def register_gateway(server, node, addr, lan_port, wan_addr,
+                           wan_port, svc_id="gw1"):
+    """Register a wan-federation mesh gateway into a DC's catalog."""
+    await server.rpc_server.dispatch_local("Catalog.Register", {
+        "node": node,
+        "address": addr,
+        "service": {
+            "id": svc_id,
+            "service": "mesh-gateway",
+            "kind": "mesh-gateway",
+            "port": lan_port,
+            "tags": [],
+            "meta": {"consul-wan-federation": "1"},
+            "tagged_addresses": {
+                "wan": {"address": wan_addr, "port": wan_port},
+            },
+        },
+    })
+
+
+async def shutdown_all(*servers):
+    for s in servers:
+        await s.shutdown()
+    await asyncio.sleep(0)
+
+
+class TestFederationStates:
+    async def test_apply_routes_to_primary_and_replicates_back(self):
+        dc1, dc2 = await start_two_dcs()
+        p, s = dc1[0], dc2[0]
+        # A write submitted in the SECONDARY must land in the primary's
+        # raft (federation_state_endpoint.go:25-28), then replicate back.
+        out = await s.rpc_server.dispatch_local("FederationState.Apply", {
+            "op": "upsert",
+            "state": {"datacenter": "dc3", "mesh_gateways": []},
+        })
+        assert out["result"] is True
+        _, rec = p.store.federation_state_get("dc3")
+        assert rec is not None
+        await wait_until(
+            lambda: s.store.federation_state_get("dc3")[1] is not None,
+            timeout=10, msg="secondary replicated the federation state",
+        )
+        # Delete flows the same way and the replicator prunes.
+        await s.rpc_server.dispatch_local("FederationState.Apply", {
+            "op": "delete", "state": {"datacenter": "dc3"},
+        })
+        assert p.store.federation_state_get("dc3")[1] is None
+        await wait_until(
+            lambda: s.store.federation_state_get("dc3")[1] is None,
+            timeout=10, msg="secondary pruned the deleted state",
+        )
+        await shutdown_all(p, s)
+
+    async def test_ae_publishes_gateways_and_locator_resolves(self):
+        dc1, dc2 = await start_two_dcs()
+        p, s = dc1[0], dc2[0]
+        await register_gateway(p, "gwnode1", "10.1.0.9", 8443,
+                               "198.51.100.1", 443)
+        await register_gateway(s, "gwnode2", "10.2.0.9", 8443,
+                               "198.51.100.2", 443)
+
+        # Each DC's AE loop pushes its own state to the PRIMARY.
+        await wait_until(
+            lambda: p.store.federation_state_get("dc1")[1] is not None
+            and p.store.federation_state_get("dc2")[1] is not None,
+            timeout=10, msg="primary holds both DCs' federation states",
+        )
+        # The secondary pulls the full map back.
+        await wait_until(
+            lambda: s.store.federation_state_get("dc1")[1] is not None,
+            timeout=10, msg="secondary learned the primary's gateways",
+        )
+
+        # Locator: remote DC resolves to WAN addrs, own DC to LAN addrs.
+        assert s.gateway_locator.gateways_for_dc("dc1") == \
+            ["198.51.100.1:443"]
+        assert s.gateway_locator.local_gateways() == ["10.2.0.9:8443"]
+        assert p.gateway_locator.gateways_for_dc("dc2") == \
+            ["198.51.100.2:443"]
+        assert set(s.gateway_locator.known_datacenters()) == {"dc1", "dc2"}
+
+        # ListMeshGateways aggregates the map (the data plane's view).
+        out = await s.rpc_server.dispatch_local(
+            "FederationState.ListMeshGateways", {})
+        assert set(out["gateways"]) == {"dc1", "dc2"}
+        assert out["gateways"]["dc1"][0]["service"] == "mesh-gateway"
+
+        # Blocking read surface works.
+        got = await s.rpc_server.dispatch_local(
+            "FederationState.Get", {"target_dc": "dc1"})
+        assert got["state"]["datacenter"] == "dc1"
+        assert len(got["state"]["mesh_gateways"]) == 1
+        await shutdown_all(p, s)
+
+    async def test_non_wanfed_gateways_excluded(self):
+        """Only gateways carrying the consul-wan-federation=1 meta are
+        published (gateway_locator.go:44-47)."""
+        dc1, dc2 = await start_two_dcs()
+        p, s = dc1[0], dc2[0]
+        # A mesh gateway WITHOUT the wanfed meta.
+        await p.rpc_server.dispatch_local("Catalog.Register", {
+            "node": "gwnode1", "address": "10.1.0.9",
+            "service": {"id": "gw-plain", "service": "mesh-gateway",
+                        "kind": "mesh-gateway", "port": 8443, "tags": []},
+        })
+        assert p.gateway_locator.local_gateways() == []
+        assert p.gateway_locator.build_own_state()["mesh_gateways"] == []
+        # And it never reaches the secondary through AE.
+        await asyncio.sleep(1.0)
+        _, rec = s.store.federation_state_get("dc1")
+        assert rec is None or rec.get("mesh_gateways") == []
+        await shutdown_all(p, s)
+
+
+class TestFederationHTTP:
+    async def test_http_surface(self):
+        from test_http_dns import http_call
+
+        from consul_tpu.agent.agent import Agent, AgentConfig
+        from consul_tpu.agent.http import HTTPApi
+
+        lan, rpc = InMemoryNetwork(), InMemoryNetwork()
+        agent = Agent(
+            AgentConfig(node_name="dev", bootstrap_expect=1,
+                        gossip_interval_scale=0.05, sync_interval_s=0.3,
+                        sync_retry_interval_s=0.2,
+                        reconcile_interval_s=0.2),
+            gossip_transport=lan.new_transport("dev:gossip"),
+            rpc_transport=rpc.new_transport("dev:rpc"),
+        )
+        await agent.start()
+        await wait_until(lambda: agent.delegate.is_leader(), msg="leader")
+        api = HTTPApi(agent)
+        addr = await api.start()
+        try:
+            await agent.delegate.rpc_server.dispatch_local(
+                "FederationState.Apply", {
+                    "op": "upsert",
+                    "state": {"datacenter": "dc9", "mesh_gateways": [
+                        {"service": "mesh-gateway", "id": "g",
+                         "node": "n", "address": "10.9.0.1", "port": 8443,
+                         "tags": []},
+                    ]},
+                })
+            st, _, rows = await http_call(
+                addr, "GET", "/v1/internal/federation-states")
+            assert st == 200 and rows[0]["Datacenter"] == "dc9"
+            st, _, one = await http_call(
+                addr, "GET", "/v1/internal/federation-state/dc9")
+            assert st == 200 and one["State"]["Datacenter"] == "dc9"
+            st, _, gws = await http_call(
+                addr, "GET", "/v1/internal/federation-states/mesh-gateways")
+            # DC names are data keys — they must NOT be camelized.
+            assert st == 200 and "dc9" in gws
+            assert gws["dc9"][0]["Port"] == 8443
+            st, _, _x = await http_call(
+                addr, "GET", "/v1/internal/federation-state/nope")
+            assert st == 404
+        finally:
+            await api.stop()
+            await agent.shutdown()
+
+
+class TestGatewayRoutedUpstreams:
+    async def test_proxycfg_routes_remote_target_through_gateways(self):
+        from test_http_dns import dev_stack
+
+        async def scenario(mode):
+            async with dev_stack() as (agent, addr, _dns, _dns_addr):
+                srv = agent.delegate
+                # Chain config: db redirects to dc2; mesh-gateway mode
+                # comes from service-defaults (compile.go:905-930).
+                for entry in (
+                    {"kind": "service-defaults", "name": "db",
+                     "mesh_gateway": mode},
+                    {"kind": "service-resolver", "name": "db",
+                     "redirect": {"datacenter": "dc2"}},
+                ):
+                    await srv.rpc_server.dispatch_local(
+                        "ConfigEntry.Apply", {"op": "set", "entry": entry})
+                # A local wanfed mesh gateway in the catalog.
+                await srv.rpc_server.dispatch_local("Catalog.Register", {
+                    "node": "gwnode", "address": "10.0.0.7",
+                    "service": {
+                        "id": "mgw", "service": "mesh-gateway",
+                        "kind": "mesh-gateway", "port": 8443, "tags": [],
+                        "meta": {"consul-wan-federation": "1"},
+                        "tagged_addresses": {
+                            "wan": {"address": "192.0.2.7", "port": 443}},
+                    },
+                })
+                # dc2's federation state (as replication would deliver).
+                await srv.rpc_server.dispatch_local(
+                    "FederationState.Apply", {
+                        "op": "upsert",
+                        "state": {"datacenter": "dc2", "mesh_gateways": [
+                            {"id": "rgw", "service": "mesh-gateway",
+                             "kind": "mesh-gateway", "node": "rnode",
+                             "address": "10.2.0.7", "port": 8443,
+                             "tags": [],
+                             "meta": {"consul-wan-federation": "1"},
+                             "tagged_addresses": {"wan": {
+                                 "address": "198.51.100.7", "port": 443}}},
+                        ]},
+                    })
+                agent.add_service({
+                    "service": "web-proxy", "kind": "connect-proxy",
+                    "port": 0,
+                    "proxy": {"destination_service": "web",
+                              "upstreams": [{"destination_name": "db"}]},
+                })
+                out = await agent.proxycfg.wait("web-proxy", 0, timeout=10)
+                assert out is not None
+                _, snap = out
+                insts = snap["upstreams"]["db"]["instances"]["db@dc2"]
+                assert len(insts) == 1 and insts[0]["mesh_gateway"]
+                return insts[0]
+
+        # local mode: dial this DC's own gateway at its LAN address.
+        ep = await scenario("local")
+        assert (ep["address"], ep["port"]) == ("10.0.0.7", 8443)
+        # remote mode: dial the TARGET DC's gateway at its WAN address.
+        ep = await scenario("remote")
+        assert (ep["address"], ep["port"]) == ("198.51.100.7", 443)
+
+    async def test_ae_prunes_after_last_gateway_leaves(self):
+        dc1, dc2 = await start_two_dcs()
+        p, s = dc1[0], dc2[0]
+        await register_gateway(s, "gwnode2", "10.2.0.9", 8443,
+                               "198.51.100.2", 443)
+        await wait_until(
+            lambda: (p.store.federation_state_get("dc2")[1] or {}
+                     ).get("mesh_gateways"),
+            timeout=10, msg="primary learned dc2's gateway",
+        )
+        # The gateway disappears from dc2's catalog.
+        await s.rpc_server.dispatch_local("Catalog.Deregister", {
+            "node": "gwnode2", "service_id": "gw1",
+        })
+        # AE must publish the EMPTY set — stale addresses are pruned
+        # everywhere, not kept forever.
+        await wait_until(
+            lambda: (p.store.federation_state_get("dc2")[1] or {}
+                     ).get("mesh_gateways") == [],
+            timeout=10, msg="primary pruned dc2's dead gateway",
+        )
+        await shutdown_all(p, s)
