@@ -47,6 +47,7 @@ CONNECT_CA_ROOTS = "connect-ca-roots"
 INTENTION_MATCH = "intention-match"
 DISCOVERY_CHAIN = "discovery-chain"
 FEDERATION_MESH_GATEWAYS = "federation-state-list-mesh-gateways"
+SERVICE_KIND_NODES = "catalog-service-kind-nodes"
 
 REFRESH_BACKOFF_MIN = 0.5   # cache.go RefreshBackoffMin (scaled-friendly)
 REFRESH_TIMEOUT = 600.0     # cache-types' 10-minute blocking wait
@@ -81,6 +82,10 @@ TYPES: dict[str, CacheType] = {
         # plane's cross-DC gateway map, blocking on federation states.
         CacheType(FEDERATION_MESH_GATEWAYS,
                   "FederationState.ListMeshGateways", key_fields=("dc",)),
+        # Kind-indexed catalog watch (the reference's internal
+        # ServiceDump kind filter) — local mesh-gateway discovery.
+        CacheType(SERVICE_KIND_NODES, "Catalog.ServiceKindNodes",
+                  key_fields=("kind", "dc")),
         CacheType(CATALOG_SERVICES, "Catalog.ServiceNodes",
                   key_fields=("service", "tag", "dc")),
         CacheType(CATALOG_LIST_NODES, "Catalog.ListNodes",

@@ -131,6 +131,26 @@ class Catalog(_Endpoint):
             ]
         return out
 
+    async def service_kind_nodes(self, body: dict):
+        """Instances of a service KIND — mesh-gateway discovery for the
+        data plane (catalog_endpoint.go ServiceNodes with ServiceKind /
+        internal ServiceDump kind filter)."""
+        out = await self._read(
+            "Catalog.ServiceKindNodes", body,
+            lambda ws: _wrap(
+                self.server.store.services_by_kind(
+                    body.get("kind", ""), ws=ws),
+                "nodes",
+            ),
+        )
+        authz = self._authz(body)
+        if authz is not None and "nodes" in out:
+            out["nodes"] = [
+                n for n in out["nodes"]
+                if authz.service_read(n.get("service", ""))
+            ]
+        return out
+
     async def list_services(self, body: dict):
         out = await self._read(
             "Catalog.ListServices", body,
@@ -1347,11 +1367,6 @@ class FederationState(_Endpoint):
         return {"result": result}
 
     async def get(self, body: dict):
-        fwd = await self.server.forward(
-            "FederationState.Get", body, read=True
-        )
-        if fwd is not None:
-            return fwd
         self.server.acl_check(body, "operator", "", READ)
 
         def run(ws):
@@ -1363,11 +1378,6 @@ class FederationState(_Endpoint):
         return await self._read("FederationState.Get", body, run)
 
     async def list(self, body: dict):
-        fwd = await self.server.forward(
-            "FederationState.List", body, read=True
-        )
-        if fwd is not None:
-            return fwd
         self.server.acl_check(body, "operator", "", READ)
 
         def run(ws):
@@ -1381,11 +1391,6 @@ class FederationState(_Endpoint):
         cross-DC routing table (federation_state_endpoint.go
         ListMeshGateways).  Gateways are services — service:read
         filtering applies like any catalog read."""
-        fwd = await self.server.forward(
-            "FederationState.ListMeshGateways", body, read=True
-        )
-        if fwd is not None:
-            return fwd
 
         def run(ws):
             idx, states = self.server.store.federation_state_list(ws=ws)

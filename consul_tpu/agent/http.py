@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import functools
+import gzip
 import json
 import logging
 import re
@@ -43,6 +44,11 @@ from consul_tpu.telemetry import metrics
 from consul_tpu.version import __version__
 
 log = logging.getLogger("consul_tpu.http")
+
+_STATUS_TEXT = {200: "OK", 307: "Temporary Redirect",
+                400: "Bad Request", 403: "Forbidden",
+                404: "Not Found", 405: "Method Not Allowed",
+                500: "Internal Server Error"}
 
 _ACRONYMS = {
     "Id": "ID", "Ttl": "TTL", "Dns": "DNS", "Http": "HTTP", "Tcp": "TCP",
@@ -162,6 +168,7 @@ class HTTPApi:
         self.agent = agent
         # (method, regex) -> handler(req, match) routes, first match wins.
         self.routes: list[tuple[str, re.Pattern, Callable]] = []
+        self._route_buckets: dict[str, list] = {}
         self._register_routes()
         self._server: Optional[asyncio.AbstractServer] = None
         self.addr = ""
@@ -249,15 +256,26 @@ class HTTPApi:
         body = b""
         if "content-length" in headers:
             body = await reader.readexactly(int(headers["content-length"]))
-        parsed = urllib.parse.urlsplit(target)
-        query = {
-            k: v[0] for k, v in urllib.parse.parse_qs(
-                parsed.query, keep_blank_values=True
-            ).items()
-        }
+        path, _, qs = target.partition("?")
+        query: dict[str, str] = {}
+        if qs:
+            if "%" not in qs and "+" not in qs:
+                # Fast path: no percent/plus escapes to decode —
+                # first-value-wins like parse_qs below.
+                for part in qs.split("&"):
+                    k, _, v = part.partition("=")
+                    if k and k not in query:
+                        query[k] = v
+            else:
+                query = {
+                    k: v[0] for k, v in urllib.parse.parse_qs(
+                        qs, keep_blank_values=True
+                    ).items()
+                }
         # Go's net/http serves the decoded URL.Path; %2F in a KV key
         # must reach the store as '/'.
-        path = urllib.parse.unquote(parsed.path)
+        if "%" in path:
+            path = urllib.parse.unquote(path)
         return HTTPRequest(method, path, query, headers, body)
 
     async def _write_response(self, writer, req: HTTPRequest,
@@ -267,13 +285,13 @@ class HTTPApi:
             ctype = "application/octet-stream"
         else:
             out = camelize(resp.body)
-            indent = 4 if req.flag("pretty") else None
-            payload = (json.dumps(out, indent=indent) + "\n").encode()
+            if req.query and req.flag("pretty"):
+                payload = (json.dumps(out, indent=4) + "\n").encode()
+            else:
+                payload = (json.dumps(out, separators=(",", ":"))
+                           + "\n").encode()
             ctype = "application/json"
-        status_text = {200: "OK", 307: "Temporary Redirect",
-                       400: "Bad Request", 403: "Forbidden",
-                       404: "Not Found", 405: "Method Not Allowed",
-                       500: "Internal Server Error"}.get(resp.status, "OK")
+        status_text = _STATUS_TEXT.get(resp.status, "OK")
         encoding = ""
         if (
             "gzip" in req.headers.get("accept-encoding", "")
@@ -281,9 +299,7 @@ class HTTPApi:
         ):
             # http.go wraps handlers in gziphandler for the same cutoff
             # class of responses.
-            import gzip as _gzip
-
-            payload = _gzip.compress(payload)
+            payload = gzip.compress(payload)
             encoding = "gzip"
         # A handler-supplied Content-Type overrides the default (single
         # Content-Type per RFC 9110).
@@ -311,7 +327,8 @@ class HTTPApi:
 
     async def _dispatch_inner(self, req: HTTPRequest) -> HTTPResponse:
         path_matched = False
-        for method, pattern, handler in self.routes:
+        bucket, catchall = self._route_candidates(req.path)
+        for method, pattern, handler in (*bucket, *catchall):
             m = pattern.match(req.path)
             if not m:
                 continue
@@ -358,7 +375,36 @@ class HTTPApi:
     # -- route table (http_register.go) --------------------------------
 
     def _route(self, method: str, pattern: str, handler: Callable) -> None:
-        self.routes.append((method, re.compile(pattern + r"$"), handler))
+        compiled = re.compile(pattern + r"$")
+        self.routes.append((method, compiled, handler))
+        # Prefix-bucketed dispatch: the route table is ~100 entries and
+        # a linear regex scan per request dominated the KV hot path
+        # (~33 pattern.match calls/request).  Bucket by the static
+        # "/v1/<segment>" prefix; dispatch looks up the bucket and scans
+        # only its handful of candidates.  Routes whose second segment
+        # is not static land in the catch-all bucket, always scanned.
+        static = pattern
+        for i, ch in enumerate(pattern):
+            if ch in "([?*+.\\^$|{":
+                static = pattern[:i]
+                break
+        parts = static.split("/")
+        if static == pattern and len(parts) >= 3:
+            key = "/".join(parts[:3])       # fully-literal route
+        elif len(parts) >= 4:
+            key = "/".join(parts[:3])       # second segment complete
+        else:
+            key = ""                        # dynamic early — always scan
+        self._route_buckets.setdefault(key, []).append(
+            (method, compiled, handler)
+        )
+
+    def _route_candidates(self, path: str):
+        first = path.find("/", 1)
+        second = path.find("/", first + 1) if first != -1 else -1
+        key = path[:second] if second != -1 else path
+        return self._route_buckets.get(key, ()), \
+            self._route_buckets.get("", ())
 
     def _register_routes(self) -> None:
         r = self._route
@@ -1406,7 +1452,11 @@ class HTTPApi:
         out = await self.agent.rpc("ACL.AuthMethodSet", {
             "auth_method": method, **req.dc_option(),
         })
-        return HTTPResponse(200, out.get("auth_method"))
+        # Re-shield on the way out: the echoed record may have crossed
+        # an RPC forward, which strips the KeyedMap marker.
+        return HTTPResponse(
+            200, _shield_claim_keys(out.get("auth_method") or {})
+        )
 
     async def acl_auth_method_list(self, req, m) -> HTTPResponse:
         out = await self.agent.rpc(

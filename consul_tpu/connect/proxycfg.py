@@ -39,6 +39,7 @@ from consul_tpu.agent.cache import (
     FEDERATION_MESH_GATEWAYS,
     HEALTH_SERVICES,
     INTENTION_MATCH,
+    SERVICE_KIND_NODES,
 )
 
 log = logging.getLogger("consul_tpu.proxycfg")
@@ -221,26 +222,27 @@ class _ProxyState:
         """
         from consul_tpu.connect.gateways import (
             KIND_MESH_GATEWAY,
-            WANFED_META,
             gateway_endpoint,
         )
 
         cache = self.m.cache
         if mode == "local":
-            req = {"service": KIND_MESH_GATEWAY, "passing_only": True}
+            # KIND-indexed catalog watch: any local mesh gateway routes
+            # service traffic regardless of its service name or wanfed
+            # meta (the wanfed:1 gate belongs to the SERVER plane's
+            # gateway_locator.go, not to upstream endpoints —
+            # xds/endpoints.go makeUpstreamLoadAssignmentForMeshGateway
+            # uses the plain kind watch).
+            req = {"kind": KIND_MESH_GATEWAY}
             if "local-gateways" not in self._health_watched:
-                cache.notify(HEALTH_SERVICES, req, self._queue)
+                cache.notify(SERVICE_KIND_NODES, req, self._queue)
                 self._health_watched.add("local-gateways")
-            out = await cache.get(HEALTH_SERVICES, req)
-            svcs = []
-            for row in out.get("nodes") or []:
-                svc = dict(row.get("service") or {})
-                svc.setdefault("node", (row.get("node") or {}).get("node"))
-                svc.setdefault(
-                    "node_address", (row.get("node") or {}).get("address"))
-                svcs.append(svc)
+            out = await cache.get(SERVICE_KIND_NODES, req)
+            svcs = out.get("nodes") or []
             wan = False
         else:
+            # The federation-state map only ever carries wanfed
+            # gateways (the AE publisher filters) — no extra gate here.
             if "federation-gateways" not in self._health_watched:
                 cache.notify(FEDERATION_MESH_GATEWAYS, {}, self._queue)
                 self._health_watched.add("federation-gateways")
@@ -250,7 +252,6 @@ class _ProxyState:
         return [
             gateway_endpoint(svc, wan=wan) for svc in svcs
             if svc.get("kind") == KIND_MESH_GATEWAY
-            and (svc.get("meta") or {}).get(WANFED_META) == "1"
         ]
 
     @staticmethod

@@ -405,6 +405,16 @@ class StateStore:
         recs = tx.records("services", _b(node) + SEP, ws=ws)
         return self.max_index("services", tx=tx), recs
 
+    @staticmethod
+    def _join_node(tx, rec: dict, ws: Optional[WatchSet]) -> dict:
+        """Merge a service record with its node's address/meta (the
+        ServiceNode join, state/catalog.go parseServiceNodes)."""
+        node = tx.get("nodes", _b(rec["node"]), ws=ws)
+        merged = dict(rec)
+        merged["node_address"] = node["address"] if node else ""
+        merged["node_meta"] = (node.get("meta") or {}) if node else {}
+        return merged
+
     def service_nodes(
         self, service: str, tag: Optional[str] = None, ws: Optional[WatchSet] = None
     ) -> tuple[int, list[dict]]:
@@ -415,11 +425,7 @@ class StateStore:
         for rec in tx.records("services", _b(service) + SEP, index="service", ws=ws):
             if tag is not None and tag not in rec["tags"]:
                 continue
-            node = tx.get("nodes", _b(rec["node"]), ws=ws)
-            merged = dict(rec)
-            merged["node_address"] = node["address"] if node else ""
-            merged["node_meta"] = (node.get("meta") or {}) if node else {}
-            out.append(merged)
+            out.append(self._join_node(tx, rec, ws))
         return self.max_index("services", "nodes", tx=tx), out
 
     def node_checks(self, node: str, ws: Optional[WatchSet] = None) -> tuple[int, list[dict]]:
@@ -1153,15 +1159,11 @@ class StateStore:
         with node addresses like service_nodes
         (state/catalog.go ServiceDump w/ kind filter)."""
         tx = self.db.txn()
-        out = []
-        for rec in tx.records("services", ws=ws):
-            if rec.get("kind") != kind:
-                continue
-            node = tx.get("nodes", _b(rec["node"]), ws=ws)
-            merged = dict(rec)
-            merged["node_address"] = node["address"] if node else ""
-            merged["node_meta"] = (node.get("meta") or {}) if node else {}
-            out.append(merged)
+        out = [
+            self._join_node(tx, rec, ws)
+            for rec in tx.records("services", ws=ws)
+            if rec.get("kind") == kind
+        ]
         return self.max_index("services", "nodes", tx=tx), out
 
     def acl_tokens_expired(self, now: float, limit: int = 256) -> list[dict]:

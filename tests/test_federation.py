@@ -235,13 +235,17 @@ class TestGatewayRoutedUpstreams:
                 ):
                     await srv.rpc_server.dispatch_local(
                         "ConfigEntry.Apply", {"op": "set", "entry": entry})
-                # A local wanfed mesh gateway in the catalog.
+                # A local mesh gateway in the catalog.  Deliberately
+                # neither named "mesh-gateway" nor wanfed-tagged:
+                # upstream routing discovers gateways by KIND (the
+                # reference's kind-indexed catalog watch), and the
+                # wanfed:1 meta gates only the server plane's
+                # gateway_locator, not data-plane endpoints.
                 await srv.rpc_server.dispatch_local("Catalog.Register", {
                     "node": "gwnode", "address": "10.0.0.7",
                     "service": {
-                        "id": "mgw", "service": "mesh-gateway",
+                        "id": "mgw", "service": "my-gateway",
                         "kind": "mesh-gateway", "port": 8443, "tags": [],
-                        "meta": {"consul-wan-federation": "1"},
                         "tagged_addresses": {
                             "wan": {"address": "192.0.2.7", "port": 443}},
                     },
