@@ -85,7 +85,7 @@ TYPES: dict[str, CacheType] = {
         # Kind-indexed catalog watch (the reference's internal
         # ServiceDump kind filter) — local mesh-gateway discovery.
         CacheType(SERVICE_KIND_NODES, "Catalog.ServiceKindNodes",
-                  key_fields=("kind", "dc")),
+                  key_fields=("kind", "passing_only", "dc")),
         CacheType(CATALOG_SERVICES, "Catalog.ServiceNodes",
                   key_fields=("service", "tag", "dc")),
         CacheType(CATALOG_LIST_NODES, "Catalog.ListNodes",

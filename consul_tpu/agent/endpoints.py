@@ -139,7 +139,9 @@ class Catalog(_Endpoint):
             "Catalog.ServiceKindNodes", body,
             lambda ws: _wrap(
                 self.server.store.services_by_kind(
-                    body.get("kind", ""), ws=ws),
+                    body.get("kind", ""),
+                    passing_only=bool(body.get("passing_only", False)),
+                    ws=ws),
                 "nodes",
             ),
         )

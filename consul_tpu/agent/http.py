@@ -519,6 +519,8 @@ class HTTPApi:
         # snapshot reads per registered connect-proxy
         # (proxycfg/manager.go via agent_endpoint.go, re-designed as a
         # longpoll JSON endpoint instead of an Envoy gRPC stream).
+        r("GET", r"/v1/agent/connect/proxy/(?P<pid>[^/?]+)/xds",
+          self.connect_proxy_xds)
         r("GET", r"/v1/agent/connect/proxy/(?P<pid>[^/?]+)",
           self.connect_proxy_config)
         # keyring (operator_endpoint.go /v1/operator/keyring)
@@ -1260,20 +1262,26 @@ class HTTPApi:
             "reason": out.get("reason", ""),
         })
 
+    async def _proxy_snapshot(self, req, pid: str):
+        """Shared longpoll fetch for the proxy-config feeds: honor
+        ?index/?wait, wait out the first assembly of a just-registered
+        proxy, None for unknown ids."""
+        min_version = int(req.query.get("index", 0) or 0)
+        wait = _parse_ttl(req.query.get("wait", "")) or 300.0
+        if min_version > 0:
+            return await self.agent.proxycfg.wait(
+                pid, min_version=min_version, timeout=wait)
+        out = self.agent.proxycfg.snapshot(pid)
+        if out is None and pid in self.agent.proxycfg.proxy_ids():
+            # Registered but not yet assembled: wait for the first.
+            out = await self.agent.proxycfg.wait(pid, 0, timeout=wait)
+        return out
+
     async def connect_proxy_config(self, req, m) -> HTTPResponse:
         """GET /v1/agent/connect/proxy/:proxy_id?index=N&wait=30s —
         the proxy's config snapshot, longpolling on its version."""
         pid = m.group("pid")
-        min_version = int(req.query.get("index", 0) or 0)
-        wait = _parse_ttl(req.query.get("wait", "")) or 300.0
-        if min_version > 0:
-            out = await self.agent.proxycfg.wait(
-                pid, min_version=min_version, timeout=wait)
-        else:
-            out = self.agent.proxycfg.snapshot(pid)
-            if out is None and pid in self.agent.proxycfg.proxy_ids():
-                # Registered but not yet assembled: wait for the first.
-                out = await self.agent.proxycfg.wait(pid, 0, timeout=wait)
+        out = await self._proxy_snapshot(req, pid)
         if out is None:
             return HTTPResponse(404, {"error": f"unknown proxy {pid!r}"})
         version, snap = out
@@ -1285,6 +1293,28 @@ class HTTPApi:
                   })}
         return HTTPResponse(200, shaped,
                             headers={"X-Consul-Index": str(version)})
+
+    async def connect_proxy_xds(self, req, m) -> HTTPResponse:
+        """GET /v1/agent/connect/proxy/:proxy_id/xds?index=N&wait=30s —
+        the ADS-shaped export of the same snapshot (agent/xds/server.go
+        re-designed as a blocking JSON feed; each resource family keyed
+        by its v2 type URL)."""
+        from consul_tpu.connect import xds as xds_mod
+
+        pid = m.group("pid")
+        out = await self._proxy_snapshot(req, pid)
+        if out is None:
+            return HTTPResponse(404, {"error": f"unknown proxy {pid!r}"})
+        version, snap = out
+        public_port = int(req.query.get("port", 0) or 0)
+        ads = xds_mod.ads_snapshot(snap, version, public_port=public_port)
+        # The whole response is an Envoy-shaped wire structure
+        # (DiscoveryResponse-style), not our struct fields — ship it
+        # byte-exact, no camelization anywhere in the tree.
+        return HTTPResponse(
+            200, _raw_tree(ads),
+            headers={"X-Consul-Index": str(version)},
+        )
 
     # -- keyring -------------------------------------------------------------
 
@@ -1535,6 +1565,17 @@ class HTTPApi:
 
 
 _CAMEL_SPLIT = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def _raw_tree(obj: Any) -> Any:
+    """Recursively mark every dict as KeyedMap so camelize ships the
+    structure byte-exact (Envoy-shaped xDS resources use their own
+    snake_case wire names)."""
+    if isinstance(obj, dict):
+        return KeyedMap({k: _raw_tree(v) for k, v in obj.items()})
+    if isinstance(obj, list):
+        return [_raw_tree(v) for v in obj]
+    return obj
 
 
 def _shield_claim_keys(method: dict) -> dict:

@@ -52,7 +52,7 @@ async def _keepalive_worker(addr: str, requests) -> None:
         writer.close()
 
 
-def _put_batches(trial: int) -> list:
+def _put_batches() -> list:
     return [
         [("PUT", f"/v1/kv/bench/{w}/{i}", b"x" * 64)
          for i in range(PER_WORKER)]
@@ -60,9 +60,9 @@ def _put_batches(trial: int) -> list:
     ]
 
 
-def _get_batches(trial: int) -> list:
+def _get_batches() -> list:
     return [
-        [("GET", f"/v1/kv/bench/{w}/{i % PER_WORKER}?stale", b"")
+        [("GET", f"/v1/kv/bench/{w}/{i}?stale", b"")
          for i in range(PER_WORKER)]
         for w in range(WORKERS)
     ]
@@ -111,20 +111,21 @@ async def _run() -> dict:
     try:
         # Warmup: populate the keyspace and heat every code path the
         # timed trials hit (route tables, camelize caches, radix paths).
-        await _timed(addr, _put_batches(-1))
-        await _timed(addr, _get_batches(-1))
+        puts, gets = _put_batches(), _get_batches()
+        await _timed(addr, puts)
+        await _timed(addr, gets)
 
         import gc
 
         put_rates, get_rates = [], []
-        for trial in range(TRIALS):
+        for _trial in range(TRIALS):
             # Collect BETWEEN trials so a major GC landing mid-trial
             # doesn't smear one sample (the rates include normal
             # allocation/GC pressure either way).
             gc.collect()
-            put_rates.append(await _timed(addr, _put_batches(trial)))
+            put_rates.append(await _timed(addr, puts))
             gc.collect()
-            get_rates.append(await _timed(addr, _get_batches(trial)))
+            get_rates.append(await _timed(addr, gets))
         put_med = statistics.median(put_rates)
         get_med = statistics.median(get_rates)
     finally:

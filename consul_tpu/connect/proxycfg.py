@@ -196,6 +196,7 @@ class _ProxyState:
         self.snapshot = {
             "proxy_id": self.proxy_id,
             "destination_service": self.destination,
+            "datacenter": self.m.datacenter,
             "local_service_address": self.local_service_address,
             "roots": roots,
             "active_root_id": active_root_id,
@@ -227,13 +228,15 @@ class _ProxyState:
 
         cache = self.m.cache
         if mode == "local":
-            # KIND-indexed catalog watch: any local mesh gateway routes
-            # service traffic regardless of its service name or wanfed
-            # meta (the wanfed:1 gate belongs to the SERVER plane's
-            # gateway_locator.go, not to upstream endpoints —
-            # xds/endpoints.go makeUpstreamLoadAssignmentForMeshGateway
-            # uses the plain kind watch).
-            req = {"kind": KIND_MESH_GATEWAY}
+            # KIND-indexed health-aware catalog watch: any local mesh
+            # gateway routes service traffic regardless of its service
+            # name or wanfed meta (the wanfed:1 gate belongs to the
+            # SERVER plane's gateway_locator.go, not to upstream
+            # endpoints — xds/endpoints.go
+            # makeUpstreamLoadAssignmentForMeshGateway uses the
+            # kind-filtered CheckServiceNodes watch), but a gateway with
+            # a failing check must drop out.
+            req = {"kind": KIND_MESH_GATEWAY, "passing_only": True}
             if "local-gateways" not in self._health_watched:
                 cache.notify(SERVICE_KIND_NODES, req, self._queue)
                 self._health_watched.add("local-gateways")

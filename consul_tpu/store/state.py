@@ -1153,18 +1153,33 @@ class StateStore:
         return True
 
     def services_by_kind(
-        self, kind: str, ws: Optional[WatchSet] = None
+        self, kind: str, passing_only: bool = False,
+        ws: Optional[WatchSet] = None,
     ) -> tuple[int, list[dict]]:
         """Service instances of a given kind (mesh-gateway, ...), joined
-        with node addresses like service_nodes
-        (state/catalog.go ServiceDump w/ kind filter)."""
+        with node addresses like service_nodes (state/catalog.go
+        ServiceDump w/ kind filter — health-aware like
+        CheckServiceNodes: ``passing_only`` drops instances with any
+        non-passing node- or service-level check)."""
         tx = self.db.txn()
-        out = [
-            self._join_node(tx, rec, ws)
-            for rec in tx.records("services", ws=ws)
-            if rec.get("kind") == kind
-        ]
-        return self.max_index("services", "nodes", tx=tx), out
+        out = []
+        for rec in tx.records("services", ws=ws):
+            if rec.get("kind") != kind:
+                continue
+            if passing_only:
+                checks = [
+                    c
+                    for c in tx.records(
+                        "checks", _b(rec["node"]) + SEP, ws=ws)
+                    if c["service_id"] in ("", rec["id"])
+                ]
+                if any(c["status"] != HEALTH_PASSING for c in checks):
+                    continue
+            out.append(self._join_node(tx, rec, ws))
+        idx = self.max_index("services", "nodes", tx=tx)
+        if passing_only:
+            idx = max(idx, self.max_index("checks", tx=tx))
+        return idx, out
 
     def acl_tokens_expired(self, now: float, limit: int = 256) -> list[dict]:
         """Tokens whose expiration_time has passed (acl_token_exp.go

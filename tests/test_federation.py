@@ -306,3 +306,24 @@ class TestGatewayRoutedUpstreams:
             timeout=10, msg="primary pruned dc2's dead gateway",
         )
         await shutdown_all(p, s)
+
+
+def test_services_by_kind_passing_only_drops_failing_gateway():
+    """A mesh gateway with a critical check must fall out of the
+    kind-indexed health view the data plane watches (state/catalog.go
+    CheckServiceNodes semantics)."""
+    from consul_tpu.store.state import HEALTH_CRITICAL, StateStore
+
+    store = StateStore()
+    for i, status in enumerate(("passing", HEALTH_CRITICAL)):
+        store.ensure_registration(i + 1, {
+            "node": f"gw{i}", "address": f"10.0.0.{i}",
+            "service": {"id": "mgw", "service": "mesh-gateway",
+                        "kind": "mesh-gateway", "port": 8443, "tags": []},
+            "check": {"check_id": "serf", "status": status,
+                      "service_id": ""},
+        })
+    _, all_gws = store.services_by_kind("mesh-gateway")
+    assert {g["node"] for g in all_gws} == {"gw0", "gw1"}
+    _, live = store.services_by_kind("mesh-gateway", passing_only=True)
+    assert {g["node"] for g in live} == {"gw0"}

@@ -1,0 +1,260 @@
+"""ADS-shaped xDS export golden tests.
+
+Parity model: ``agent/xds/golden_test.go`` + the per-family
+``*_test.go`` tables — generated clusters/endpoints/listeners/routes
+for a representative chain-split snapshot are pinned structure-for-
+structure against JSON golden files in ``tests/golden/``.  Regenerate
+with ``GOLDEN_UPDATE=1 pytest tests/test_xds.py``.
+"""
+
+import json
+import os
+import pathlib
+
+import pytest
+
+from consul_tpu.connect.discoverychain import compile_chain
+from consul_tpu.connect.xds import (
+    CLUSTER_TYPE,
+    ENDPOINT_TYPE,
+    LISTENER_TYPE,
+    ROUTE_TYPE,
+    ads_snapshot,
+    clusters_from_snapshot,
+    endpoints_from_snapshot,
+    listeners_from_snapshot,
+    rbac_rules_from_intentions,
+    routes_from_snapshot,
+)
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+
+
+def golden(name: str, got) -> None:
+    """golden_test.go golden(): compare (or update) the pinned file."""
+    path = GOLDEN_DIR / f"{name}.golden.json"
+    text = json.dumps(got, indent=2, sort_keys=True) + "\n"
+    if os.environ.get("GOLDEN_UPDATE"):
+        GOLDEN_DIR.mkdir(exist_ok=True)
+        path.write_text(text)
+    assert path.exists(), f"golden file missing: {path} " \
+        "(run GOLDEN_UPDATE=1 pytest tests/test_xds.py)"
+    assert json.loads(path.read_text()) == json.loads(text), \
+        f"{name} diverged from golden (GOLDEN_UPDATE=1 to regenerate)"
+
+
+def _chain_split_snapshot() -> dict:
+    """A proxy snapshot for 'web' with one upstream 'db' whose chain is
+    an http router + 90/10 splitter over v1/v2 subsets — the
+    'chain-and-splitter' class of case from golden_test.go testdata."""
+    entries = {
+        "services": {"db": {"kind": "service-defaults", "name": "db",
+                            "protocol": "http"}},
+        "routers": {"db": {
+            "kind": "service-router", "name": "db",
+            "routes": [
+                {"match": {"http": {"path_prefix": "/admin"}},
+                 "destination": {"service": "db", "service_subset": "v2"}},
+            ],
+        }},
+        "splitters": {"db": {
+            "kind": "service-splitter", "name": "db",
+            "splits": [
+                {"weight": 90, "service_subset": "v1"},
+                {"weight": 10, "service_subset": "v2"},
+            ],
+        }},
+        "resolvers": {"db": {
+            "kind": "service-resolver", "name": "db",
+            "subsets": {"v1": {"filter": 'Service.Meta.version == "1"'},
+                        "v2": {"filter": 'Service.Meta.version == "2"'}},
+        }},
+        "global_proxy": None,
+    }
+    chain = compile_chain("db", "dc1", entries)
+    instances = {
+        tid: [{"address": f"10.0.0.{i + 1}", "port": 8080 + i,
+               "proxy_id": f"db-{tid}-{i}", "node": f"n{i}"}
+              for i in range(2)]
+        for tid in chain["targets"]
+    }
+    return {
+        "proxy_id": "web-proxy",
+        "destination_service": "web",
+        "datacenter": "dc1",
+        "local_service_address": "127.0.0.1:9090",
+        "roots": [{"id": "root-1", "active": True,
+                   "trust_domain": "11111111-2222.consul",
+                   "root_cert_pem": "-----BEGIN CERT-----fake\n"}],
+        "active_root_id": "root-1",
+        "leaf": {"cert_pem": "-----BEGIN CERT-----leaf\n",
+                 "private_key_pem": "-----BEGIN KEY-----leaf\n",
+                 "root_id": "root-1"},
+        "intentions": [
+            {"source": "api", "action": "allow"},
+            {"source": "*", "action": "deny"},
+        ],
+        "default_allow": True,
+        "upstreams": {"db": {
+            "chain": chain,
+            "instances": instances,
+            "local_bind_port": 5000,
+            "local_bind_address": "127.0.0.1",
+            "datacenter": "",
+        }},
+    }
+
+
+class TestGolden:
+    def test_clusters_golden(self):
+        golden("clusters_chain_split",
+               clusters_from_snapshot(_chain_split_snapshot()))
+
+    def test_endpoints_golden(self):
+        golden("endpoints_chain_split",
+               endpoints_from_snapshot(_chain_split_snapshot()))
+
+    def test_listeners_golden(self):
+        golden("listeners_chain_split",
+               listeners_from_snapshot(_chain_split_snapshot(),
+                                       public_port=20000))
+
+    def test_routes_golden(self):
+        golden("routes_chain_split",
+               routes_from_snapshot(_chain_split_snapshot()))
+
+
+class TestStructure:
+    def test_cluster_names_are_snis_and_local_app_present(self):
+        snap = _chain_split_snapshot()
+        clusters = clusters_from_snapshot(snap)
+        names = {c["name"] for c in clusters}
+        assert "local_app" in names
+        assert "v1.db.default.dc1.internal.11111111-2222.consul" in names
+        assert "v2.db.default.dc1.internal.11111111-2222.consul" in names
+        for c in clusters:
+            if c["name"] == "local_app":
+                continue
+            assert c["type"] == "EDS"
+            assert c["transport_socket"]["typed_config"]["sni"] == c["name"]
+
+    def test_endpoints_cover_every_cluster(self):
+        snap = _chain_split_snapshot()
+        cluster_names = {c["name"] for c in clusters_from_snapshot(snap)
+                         if c["name"] != "local_app"}
+        las = endpoints_from_snapshot(snap)
+        assert {la["cluster_name"] for la in las} == cluster_names
+        for la in las:
+            eps = la["endpoints"][0]["lb_endpoints"]
+            assert len(eps) == 2
+            assert eps[0]["endpoint"]["address"]["socket_address"][
+                "port_value"] == 8080
+
+    def test_route_config_splits_to_weighted_clusters(self):
+        snap = _chain_split_snapshot()
+        routes = routes_from_snapshot(snap)
+        assert len(routes) == 1
+        vh = routes[0]["virtual_hosts"][0]
+        # Router: /admin → v2 exact cluster; catch-all → 90/10 split.
+        admin = vh["routes"][0]
+        assert admin["match"]["prefix"] == "/admin"
+        assert admin["route"]["cluster"].startswith("v2.db.")
+        catchall = vh["routes"][-1]
+        wc = catchall["route"]["weighted_clusters"]
+        weights = {c["name"].split(".")[0]: c["weight"]
+                   for c in wc["clusters"]}
+        assert weights == {"v1": 9000, "v2": 1000}
+        assert wc["total_weight"] == 10000
+
+    def test_listeners_public_rbac_and_outbound_rds(self):
+        snap = _chain_split_snapshot()
+        listeners = listeners_from_snapshot(snap, public_port=20000)
+        public = listeners[0]
+        assert public["name"].startswith("public_listener:")
+        chain0 = public["filter_chains"][0]
+        assert chain0["tls_context"]["require_client_certificate"] is True
+        assert chain0["filters"][0]["name"] == "envoy.filters.network.rbac"
+        # http chain → hcm with rds pointing at the route config.
+        outbound = listeners[1]
+        hcm = outbound["filter_chains"][0]["filters"][0]
+        assert hcm["name"] == "envoy.http_connection_manager"
+        assert hcm["typed_config"]["rds"]["route_config_name"] == "db"
+
+    def test_ads_snapshot_families(self):
+        snap = _chain_split_snapshot()
+        ads = ads_snapshot(snap, 7, public_port=20000)
+        assert ads["version_info"] == "7"
+        assert set(ads["resources"]) == {
+            CLUSTER_TYPE, ENDPOINT_TYPE, LISTENER_TYPE, ROUTE_TYPE}
+
+
+class TestRBAC:
+    TD = "td.consul"
+
+    def test_default_allow_denies_listed_sources(self):
+        rules = rbac_rules_from_intentions(
+            [{"source": "evil", "action": "deny"}], True, self.TD)
+        assert rules["action"] == "DENY"
+        assert set(rules["policies"]) == {"consul-intentions-layer4-evil"}
+        principal = rules["policies"][
+            "consul-intentions-layer4-evil"]["principals"][0]
+        assert "/svc/evil$" in principal["authenticated"][
+            "principal_name"]["safe_regex"]["regex"]
+
+    def test_default_deny_allows_listed_sources(self):
+        rules = rbac_rules_from_intentions(
+            [{"source": "api", "action": "allow"}], False, self.TD)
+        assert rules["action"] == "ALLOW"
+        assert set(rules["policies"]) == {"consul-intentions-layer4-api"}
+
+    def test_wildcard_deny_with_exact_allow_carveout(self):
+        # api allowed, everything else denied, default allow: the
+        # wildcard DENY must NOT match api (rbac.go
+        # removeSourcePrecedence's and-not distribution).
+        rules = rbac_rules_from_intentions(
+            [{"source": "api", "action": "allow"},
+             {"source": "*", "action": "deny"}], True, self.TD)
+        assert rules["action"] == "DENY"
+        wild = rules["policies"]["consul-intentions-layer4-*"]
+        ids = wild["principals"][0]["and_ids"]["ids"]
+        assert any("not_id" in i for i in ids)
+
+    def test_same_source_lower_precedence_dropped(self):
+        rules = rbac_rules_from_intentions(
+            [{"source": "api", "action": "deny"},
+             {"source": "api", "action": "allow"}], True, self.TD)
+        # First (most precedent) wins: api is denied.
+        assert set(rules["policies"]) == {"consul-intentions-layer4-api"}
+
+
+class TestHTTPSurface:
+    async def test_xds_feed_over_http(self):
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (agent, addr, _dns, _dns_addr):
+            agent.add_service({"service": "web", "port": 9090})
+            agent.add_service({
+                "service": "web-proxy", "kind": "connect-proxy",
+                "port": 0,
+                "proxy": {"destination_service": "web",
+                          "upstreams": [{"destination_name": "db",
+                                         "local_bind_port": 5000}]},
+            })
+            st, hdrs, body = await http_call(
+                addr, "GET", "/v1/agent/connect/proxy/web-proxy/xds")
+            assert st == 200, body
+            assert int(hdrs.get("x-consul-index", "0")) >= 1
+            res = body["resources"]
+            # Type-URL keys and Envoy wire names are NOT camelized.
+            assert CLUSTER_TYPE in res
+            clusters = res[CLUSTER_TYPE]
+            assert any(c["name"] == "local_app" for c in clusters)
+            assert all("connect_timeout" in c for c in clusters
+                       if c["name"] != "local_app")
+            listeners = res[LISTENER_TYPE]
+            assert listeners[0]["name"].startswith("public_listener:")
+            assert "filter_chains" in listeners[0]
+            # 404 for unknown proxies.
+            st, _, _b = await http_call(
+                addr, "GET", "/v1/agent/connect/proxy/nope/xds")
+            assert st == 404
