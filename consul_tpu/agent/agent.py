@@ -171,6 +171,7 @@ class Agent:
         # live, so reload changes DNS behavior without a restart.
         self.dns_only_passing = True
         self.dns_node_ttl_s = 0.0
+        self.dns_recursors: list[str] = []
         # Config-file-sourced definitions (loadServices/loadChecks),
         # swapped wholesale on reload.
         self._config_services: list[dict] = []
@@ -358,9 +359,13 @@ class Agent:
                 else self._config_checks
             )
             self.load_definitions(services, checks)
-        for knob in ("dns_only_passing", "dns_node_ttl_s"):
+        for knob in ("dns_only_passing", "dns_node_ttl_s",
+                     "dns_recursors"):
             if knob in apply:
-                setattr(self, knob, apply[knob])
+                value = apply[knob]
+                if knob == "dns_recursors":
+                    value = list(value)
+                setattr(self, knob, value)
 
     # ------------------------------------------------------------------
     # service & check registration (agent.go AddService/AddCheck)

@@ -48,6 +48,7 @@ INTENTION_MATCH = "intention-match"
 DISCOVERY_CHAIN = "discovery-chain"
 FEDERATION_MESH_GATEWAYS = "federation-state-list-mesh-gateways"
 SERVICE_KIND_NODES = "catalog-service-kind-nodes"
+CATALOG_SERVICES_DUMP = "catalog-service-dump"
 
 REFRESH_BACKOFF_MIN = 0.5   # cache.go RefreshBackoffMin (scaled-friendly)
 REFRESH_TIMEOUT = 600.0     # cache-types' 10-minute blocking wait
@@ -86,6 +87,8 @@ TYPES: dict[str, CacheType] = {
         # ServiceDump kind filter) — local mesh-gateway discovery.
         CacheType(SERVICE_KIND_NODES, "Catalog.ServiceKindNodes",
                   key_fields=("kind", "passing_only", "dc")),
+        CacheType(CATALOG_SERVICES_DUMP, "Catalog.ServiceDump",
+                  key_fields=("dc",)),
         CacheType(CATALOG_SERVICES, "Catalog.ServiceNodes",
                   key_fields=("service", "tag", "dc")),
         CacheType(CATALOG_LIST_NODES, "Catalog.ListNodes",

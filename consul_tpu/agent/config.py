@@ -41,7 +41,7 @@ _APPEND_FIELDS = {"services", "checks", "retry_join", "retry_join_wan"}
 # restart).
 RELOADABLE = {
     "services", "checks", "dns_only_passing", "dns_node_ttl_s",
-    "log_level",
+    "dns_recursors", "log_level",
 }
 
 _GOSSIP_TUNABLES = (
@@ -90,6 +90,8 @@ class RuntimeConfig:
     enable_script_checks: bool = False
     dns_only_passing: bool = True
     dns_node_ttl_s: float = 0.0
+    # Upstream resolvers for non-.consul names (config "recursors").
+    dns_recursors: tuple = ()
     reconcile_interval_s: float = 60.0
     sync_interval_s: float = 60.0
     gossip_interval_scale: float = 1.0
@@ -237,6 +239,7 @@ _BLOCKS = {
     "dns_config": {
         "only_passing": "dns_only_passing",
         "node_ttl_s": "dns_node_ttl_s",
+        "recursors": "dns_recursors",
     },
     "ports": {
         "http": "ports_http",
@@ -345,6 +348,11 @@ class Builder:
                 merged[key] = tuple(
                     _freeze(v) for v in merged[key]
                 )
+        if "dns_recursors" in merged:
+            v = merged["dns_recursors"]
+            merged["dns_recursors"] = tuple(
+                v if isinstance(v, (list, tuple)) else [v]
+            )
         rc = RuntimeConfig(**merged)
         _validate(rc)
         return rc

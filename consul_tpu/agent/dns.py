@@ -24,23 +24,27 @@ from consul_tpu.agent.agent import Agent
 
 log = logging.getLogger("consul_tpu.dns")
 
-# RR types/classes (RFC 1035 + 3596).
+# RR types/classes (RFC 1035 + 3596 + 6891).
 TYPE_A = 1
 TYPE_NS = 2
 TYPE_SOA = 6
 TYPE_PTR = 12
 TYPE_TXT = 16
 TYPE_AAAA = 28
+TYPE_OPT = 41  # EDNS0 pseudo-RR (RFC 6891)
 TYPE_SRV = 33
 TYPE_ANY = 255
 CLASS_IN = 1
 
 RCODE_OK = 0
+RCODE_SERVFAIL = 2
 RCODE_NXDOMAIN = 3
 RCODE_NOTIMPL = 4
 
-UDP_PAYLOAD_MAX = 512  # pre-EDNS budget (dns.go truncation)
+UDP_PAYLOAD_MAX = 512    # pre-EDNS budget (dns.go truncation)
+EDNS_PAYLOAD_MAX = 4096  # what we advertise back (dns.go setEDNS)
 MAX_ANSWERS = 32  # dns.go a-record limit analogue
+RECURSOR_TIMEOUT_S = 3.0  # dns.go recursor client timeout
 
 
 # ---------------------------------------------------------------------------
@@ -108,7 +112,17 @@ def _decode_name(buf: bytes, pos: int) -> tuple[str, int]:
 
 
 def parse_query(buf: bytes) -> tuple[int, list[DNSQuestion]]:
-    txid, flags, qd, _an, _ns, _ar = struct.unpack(">HHHHHH", buf[:12])
+    txid, questions, _edns = parse_query_edns(buf)
+    return txid, questions
+
+
+def parse_query_edns(
+    buf: bytes,
+) -> tuple[int, list[DNSQuestion], Optional[int]]:
+    """Decode (txid, questions, edns_payload).  ``edns_payload`` is the
+    client's advertised UDP payload size from an OPT pseudo-RR in the
+    additional section (RFC 6891 §6.2.3), or None without EDNS."""
+    txid, flags, qd, an, ns, ar = struct.unpack(">HHHHHH", buf[:12])
     pos = 12
     questions = []
     for _ in range(qd):
@@ -116,7 +130,19 @@ def parse_query(buf: bytes) -> tuple[int, list[DNSQuestion]]:
         qtype, qclass = struct.unpack(">HH", buf[pos:pos + 4])
         pos += 4
         questions.append(DNSQuestion(name, qtype, qclass))
-    return txid, questions
+    edns_payload: Optional[int] = None
+    try:
+        for _ in range(an + ns + ar):
+            _, pos = _decode_name(buf, pos)
+            rtype, rclass, _ttl, rdlen = struct.unpack(
+                ">HHIH", buf[pos:pos + 10])
+            pos += 10 + rdlen
+            if rtype == TYPE_OPT:
+                # For OPT the CLASS field carries the payload size.
+                edns_payload = rclass
+    except (ValueError, struct.error):
+        pass  # malformed tail: serve the question without EDNS
+    return txid, questions, edns_payload
 
 
 def build_query(txid: int, name: str, qtype: int = TYPE_A) -> bytes:
@@ -149,10 +175,15 @@ def build_response(
     authority: list[DNSRecord],
     rcode: int,
     truncate_to: Optional[int] = UDP_PAYLOAD_MAX,
+    edns: bool = False,
 ) -> bytes:
     flags = 0x8480 | (rcode & 0xF)  # QR|AA|RD-echo
     out = bytearray()
     offsets: dict[str, int] = {}
+    # RFC 6891: when the client spoke EDNS we echo an OPT RR with our
+    # own payload budget; reserve its 11 bytes from the truncation math.
+    opt_rr = b"\x00" + struct.pack(
+        ">HHIH", TYPE_OPT, EDNS_PAYLOAD_MAX, 0, 0) if edns else b""
 
     def emit_q(q: DNSQuestion) -> bytes:
         return _encode_name(q.name, offsets, 12 + len(out)) + struct.pack(
@@ -165,13 +196,14 @@ def build_response(
             ">HHIH", r.rtype, CLASS_IN, r.ttl, len(r.rdata)
         ) + r.rdata
 
+    budget = (truncate_to - len(opt_rr)) if truncate_to else None
     for q in questions:
         out += emit_q(q)
     n_ans = 0
     truncated = False
     for r in answers:
         rr = emit_rr(r)
-        if truncate_to and 12 + len(out) + len(rr) > truncate_to:
+        if budget and 12 + len(out) + len(rr) > budget:
             truncated = True
             break
         out += rr
@@ -180,16 +212,17 @@ def build_response(
     if not truncated:
         for r in authority:
             rr = emit_rr(r)
-            if truncate_to and 12 + len(out) + len(rr) > truncate_to:
+            if budget and 12 + len(out) + len(rr) > budget:
                 break
             out += rr
             n_auth += 1
     if truncated:
         flags |= 0x0200  # TC
     header = struct.pack(
-        ">HHHHHH", txid, flags, len(questions), n_ans, n_auth, 0
+        ">HHHHHH", txid, flags, len(questions), n_ans, n_auth,
+        1 if edns else 0,
     )
-    return header + bytes(out)
+    return header + bytes(out) + opt_rr
 
 
 def _rd_a(ip: str) -> bytes:
@@ -220,6 +253,23 @@ def _rd_txt(text: str) -> bytes:
 # ---------------------------------------------------------------------------
 
 
+def _split_host_port(addr: str, default_port: str = "53") -> tuple[str, str]:
+    """IPv6-aware host:port split: "[::1]:53", "::1" (bare v6),
+    "10.0.0.1:53", and "10.0.0.1" all parse correctly (the reference
+    normalizes recursor addresses through net.SplitHostPort the same
+    way, dns.go formatRecursorAddress)."""
+    if addr.startswith("["):
+        host, _, rest = addr[1:].partition("]")
+        port = rest.lstrip(":") or default_port
+        return host, port
+    if addr.count(":") > 1:
+        return addr, default_port  # bare IPv6, no port
+    host, _, port = addr.rpartition(":")
+    if not host:
+        return addr, default_port
+    return host, port or default_port
+
+
 class DNSServer:
     """agent/dns.go DNSServer: dispatch on the .consul name space."""
 
@@ -241,6 +291,12 @@ class DNSServer:
     @property
     def only_passing(self) -> bool:
         return bool(getattr(self.agent, "dns_only_passing", True))
+
+    @property
+    def recursors(self) -> list[str]:
+        """Upstream resolvers for non-.consul names (dns.go
+        handleRecurse; config ``dns_config.recursors``)."""
+        return list(getattr(self.agent, "dns_recursors", []) or [])
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         loop = asyncio.get_running_loop()
@@ -272,11 +328,13 @@ class DNSServer:
 
     async def _handle(self, transport, data: bytes, addr) -> None:
         try:
-            txid, questions = parse_query(data)
+            txid, questions, edns_payload = parse_query_edns(data)
         except (ValueError, struct.error):
             return
         try:
-            resp = await self.answer(txid, questions)
+            resp = await self.answer(txid, questions,
+                                     edns_payload=edns_payload,
+                                     raw_query=data)
         except Exception:  # noqa: BLE001
             log.exception("dns handler failed")
             resp = build_response(txid, questions, [], [], RCODE_NOTIMPL)
@@ -284,9 +342,23 @@ class DNSServer:
 
     # -- resolution (dns.go:427 handleQuery → dispatch) -----------------
 
-    async def answer(self, txid: int, questions: list[DNSQuestion]) -> bytes:
+    async def answer(self, txid: int, questions: list[DNSQuestion],
+                     edns_payload: Optional[int] = None,
+                     raw_query: Optional[bytes] = None) -> bytes:
+        edns = edns_payload is not None
+        # RFC 6891 payload negotiation replaces the fixed 512 B budget
+        # (dns.go setEDNS / truncation math).
+        budget = UDP_PAYLOAD_MAX
+        if edns:
+            budget = max(UDP_PAYLOAD_MAX,
+                         min(int(edns_payload), EDNS_PAYLOAD_MAX))
+
+        def respond(answers, authority, rcode):
+            return build_response(txid, questions, answers, authority,
+                                  rcode, truncate_to=budget, edns=edns)
+
         if not questions:
-            return build_response(txid, [], [], [], RCODE_NXDOMAIN)
+            return respond([], [], RCODE_NXDOMAIN)
         q = questions[0]
         name = q.name.lower().rstrip(".")
         labels = name.split(".")
@@ -294,7 +366,21 @@ class DNSServer:
         # Label-boundary match: "web.service.notconsul" and
         # "anythingconsul" are NOT ours (dns.go trimDomain).
         if labels[-len(domain_labels):] != domain_labels:
-            return build_response(txid, questions, [], [], RCODE_NXDOMAIN)
+            # dns.go registers "arpa." for reverse lookups and "." for
+            # recursor forwarding.
+            if labels[-1] == "arpa":
+                try:
+                    answers = await self._ptr_lookup(labels, q)
+                except LookupError:
+                    answers = []
+                if answers:
+                    return respond(answers, [], RCODE_OK)
+                if self.recursors and raw_query is not None:
+                    return await self._recurse(txid, questions, raw_query)
+                return respond([], [self._soa()], RCODE_NXDOMAIN)
+            if self.recursors and raw_query is not None:
+                return await self._recurse(txid, questions, raw_query)
+            return respond([], [], RCODE_NXDOMAIN)
         core = labels[: -len(domain_labels)]
         answers: list[DNSRecord] = []
         rcode = RCODE_OK
@@ -316,7 +402,78 @@ class DNSServer:
         if not answers and rcode == RCODE_OK:
             rcode = RCODE_NXDOMAIN
         authority = [] if answers else [self._soa()]
-        return build_response(txid, questions, answers, authority, rcode)
+        return respond(answers, authority, rcode)
+
+    async def _ptr_lookup(self, labels: list[str],
+                          q: DNSQuestion) -> list[DNSRecord]:
+        """Reverse lookups over the node address index
+        (dns.go:199 registers ``arpa.`` → handlePtr at :324): the
+        in-addr.arpa octets reverse into an IPv4 address, matched
+        against catalog node addresses; service addresses answer with
+        their service name."""
+        if labels[-2:] != ["in-addr", "arpa"] or len(labels) < 3:
+            raise LookupError(".".join(labels))
+        ip = ".".join(reversed(labels[:-2]))
+        out = await self.agent.cached_rpc(
+            cache.CATALOG_LIST_NODES, {"allow_stale": True}
+        )
+        recs = []
+        for node in out.get("nodes") or []:
+            if node.get("address") == ip:
+                target = f"{node['node']}.node.{self.domain}."
+                recs.append(DNSRecord(q.name, TYPE_PTR, self.node_ttl,
+                                      _rd_name(target)))
+        if not recs:
+            # handlePtr also answers for service addresses
+            # (dns.go:376-393 checkServiceNodes by ServiceAddress).
+            svc_out = await self.agent.cached_rpc(
+                cache.CATALOG_SERVICES_DUMP, {"allow_stale": True}
+            )
+            for svc in svc_out.get("services") or []:
+                if svc.get("address") == ip:
+                    target = (f"{svc['service']}.service."
+                              f"{self.domain}.")
+                    recs.append(DNSRecord(
+                        q.name, TYPE_PTR, self.node_ttl,
+                        _rd_name(target)))
+        if not recs:
+            raise LookupError(ip)
+        return recs
+
+    async def _recurse(self, txid: int, questions: list[DNSQuestion],
+                       raw_query: bytes) -> bytes:
+        """Forward the raw query to the configured recursors in order
+        (dns.go handleRecurse): first response wins, SERVFAIL when all
+        fail."""
+        loop = asyncio.get_running_loop()
+        for recursor in self.recursors:
+            host, port = _split_host_port(recursor)
+            try:
+                reply_fut: asyncio.Future = loop.create_future()
+
+                class _Client(asyncio.DatagramProtocol):
+                    def connection_made(self, transport):
+                        transport.sendto(raw_query)
+
+                    def datagram_received(self, data, _addr):
+                        if not reply_fut.done():
+                            reply_fut.set_result(data)
+
+                    def error_received(self, exc):
+                        if not reply_fut.done():
+                            reply_fut.set_exception(exc)
+
+                transport, _ = await loop.create_datagram_endpoint(
+                    _Client, remote_addr=(host, int(port))
+                )
+                try:
+                    return await asyncio.wait_for(
+                        reply_fut, RECURSOR_TIMEOUT_S)
+                finally:
+                    transport.close()
+            except (OSError, asyncio.TimeoutError, ValueError) as e:
+                log.warning("recursor %s failed: %s", recursor, e)
+        return build_response(txid, questions, [], [], RCODE_SERVFAIL)
 
     def _soa(self) -> DNSRecord:
         """dns.go soa(): ns.<domain> authority record."""

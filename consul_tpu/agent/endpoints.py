@@ -131,6 +131,22 @@ class Catalog(_Endpoint):
             ]
         return out
 
+    async def service_dump(self, body: dict):
+        """All service instances + node join (internal ServiceDump —
+        the DNS PTR index and debug consumers)."""
+        out = await self._read(
+            "Catalog.ServiceDump", body,
+            lambda ws: _wrap(
+                self.server.store.service_dump(ws=ws), "services"),
+        )
+        authz = self._authz(body)
+        if authz is not None and "services" in out:
+            out["services"] = [
+                s for s in out["services"]
+                if authz.service_read(s.get("service", ""))
+            ]
+        return out
+
     async def service_kind_nodes(self, body: dict):
         """Instances of a service KIND — mesh-gateway discovery for the
         data plane (catalog_endpoint.go ServiceNodes with ServiceKind /

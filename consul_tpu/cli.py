@@ -266,6 +266,7 @@ async def cmd_agent(args) -> int:
     )
     agent.dns_only_passing = rc.dns_only_passing
     agent.dns_node_ttl_s = rc.dns_node_ttl_s
+    agent.dns_recursors = list(rc.dns_recursors)
     api = HTTPApi(agent)
     http_addr = await api.start(rc.bind_addr, rc.ports_http)
     dns = DNSServer(agent)

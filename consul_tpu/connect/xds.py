@@ -201,7 +201,12 @@ def clusters_from_snapshot(snap: dict) -> list[dict]:
     """clusters.go clustersFromSnapshotConnectProxy: the local_app
     cluster plus one cluster per chain target of every upstream."""
     trust_domain = trust_domain_from_roots(snap)
-    host, _, port = snap.get("local_service_address", "").rpartition(":")
+    # local_service_address may be "host:port" or bare "host" (the
+    # reference keeps LocalServiceAddress and LocalServicePort separate).
+    lsa = snap.get("local_service_address", "")
+    host, _, port = lsa.rpartition(":")
+    if not host or not port.isdigit():
+        host, port = lsa, "0"
     clusters: list[dict] = [{
         "@type": CLUSTER_TYPE,
         "name": LOCAL_APP_CLUSTER,
@@ -354,6 +359,12 @@ def _route_action(chain: dict, next_node: str, trust_domain: str) -> dict:
                     10000 * float(split.get("weight", 0))
                     / (total or 1))),
             })
+        # Envoy validates sum(weights) == total_weight; independent
+        # rounding can drift (three equal splits → 3×3333) — land the
+        # remainder on the largest cluster.
+        drift = 10000 - sum(c["weight"] for c in wc)
+        if drift and wc:
+            max(wc, key=lambda c: c["weight"])["weight"] += drift
         return {"weighted_clusters": {"clusters": wc,
                                       "total_weight": 10000}}
     tid = (node.get("resolver") or {}).get("target", "")

@@ -1152,6 +1152,19 @@ class StateStore:
         tx.commit()
         return True
 
+    def service_dump(
+        self, ws: Optional[WatchSet] = None
+    ) -> tuple[int, list[dict]]:
+        """Every service instance joined with its node
+        (state/catalog.go ServiceDump) — the PTR index and other
+        whole-catalog consumers."""
+        tx = self.db.txn()
+        out = [
+            self._join_node(tx, rec, ws)
+            for rec in tx.records("services", ws=ws)
+        ]
+        return self.max_index("services", "nodes", tx=tx), out
+
     def services_by_kind(
         self, kind: str, passing_only: bool = False,
         ws: Optional[WatchSet] = None,

@@ -9,6 +9,7 @@ import asyncio
 import base64
 import contextlib
 import json
+import struct
 
 import pytest
 
@@ -373,3 +374,144 @@ class TestDNS:
             _, rcode, answers = await dns_query(dns_addr, "cache-q.query.consul")
             assert rcode == 0
             assert bytes(answers[0].rdata) == bytes([10, 3, 0, 1])
+
+
+# ---------------------------------------------------------------------------
+# PTR / recursors / EDNS0 (dns.go:199 handlePtr, :427 handleRecurse,
+# setEDNS)
+# ---------------------------------------------------------------------------
+
+
+def _build_edns_query(txid, name, qtype, payload):
+    """A query advertising an EDNS payload budget (OPT in additional)."""
+    from consul_tpu.agent.dns import CLASS_IN, TYPE_OPT, _rd_name
+    header = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 1)
+    q = _rd_name(name) + struct.pack(">HH", qtype, CLASS_IN)
+    opt = b"\x00" + struct.pack(">HHIH", TYPE_OPT, payload, 0, 0)
+    return header + q + opt
+
+
+async def _raw_dns(dns_addr, payload_bytes):
+    host, port = dns_addr.rsplit(":", 1)
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(payload_bytes)
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=(host, int(port))
+    )
+    try:
+        return await asyncio.wait_for(fut, 5)
+    finally:
+        transport.close()
+
+
+class TestPtrRecursorsEdns:
+    async def test_ptr_for_node_and_service_addresses(self):
+        from consul_tpu.agent.dns import TYPE_PTR
+
+        async with dev_stack() as (agent, addr, _dns, dns_addr):
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/catalog/register",
+                json.dumps({"Node": "n1", "Address": "10.1.2.3",
+                            "Service": {"Service": "web",
+                                        "Address": "10.9.9.9",
+                                        "Port": 80}}).encode())
+            assert st == 200
+            # Node address → <node>.node.consul
+            _, rcode, answers = await dns_query(
+                dns_addr, "3.2.1.10.in-addr.arpa", TYPE_PTR)
+            assert rcode == 0 and answers
+            assert answers[0].rtype == TYPE_PTR
+            assert b"n1" in answers[0].rdata
+            # Service address → <service>.service.consul
+            _, rcode, answers = await dns_query(
+                dns_addr, "9.9.9.10.in-addr.arpa", TYPE_PTR)
+            assert rcode == 0 and answers
+            assert b"web" in answers[0].rdata
+            # Unknown address → NXDOMAIN (no recursors configured)
+            _, rcode, answers = await dns_query(
+                dns_addr, "1.0.0.127.in-addr.arpa", TYPE_PTR)
+            assert rcode == 3 and not answers
+
+    async def test_recursor_forwarding(self):
+        """Non-.consul names forward to the configured recursor and the
+        upstream's raw reply is relayed (dns.go handleRecurse)."""
+
+        async with dev_stack() as (agent, addr, _dns, dns_addr):
+            # A fake upstream resolver answering everything 1.2.3.4.
+            from consul_tpu.agent.dns import (
+                DNSQuestion, DNSRecord, TYPE_A, build_response,
+                parse_query,
+            )
+            loop = asyncio.get_running_loop()
+
+            class Upstream(asyncio.DatagramProtocol):
+                def connection_made(self, transport):
+                    self.transport = transport
+
+                def datagram_received(self, data, src):
+                    txid, questions = parse_query(data)
+                    resp = build_response(
+                        txid, questions,
+                        [DNSRecord(questions[0].name, TYPE_A, 60,
+                                   bytes([1, 2, 3, 4]))],
+                        [], 0)
+                    self.transport.sendto(resp, src)
+
+            upstream, _ = await loop.create_datagram_endpoint(
+                Upstream, local_addr=("127.0.0.1", 0))
+            up_host, up_port = upstream.get_extra_info("sockname")[:2]
+            try:
+                agent.dns_recursors = [f"{up_host}:{up_port}"]
+                _, rcode, answers = await dns_query(
+                    dns_addr, "example.com")
+                assert rcode == 0 and answers
+                assert answers[0].rdata == bytes([1, 2, 3, 4])
+                # Without recursors the same name is NXDOMAIN.
+                agent.dns_recursors = []
+                _, rcode, _x = await dns_query(dns_addr, "example.com")
+                assert rcode == 3
+            finally:
+                upstream.close()
+
+    async def test_edns_payload_lifts_truncation(self):
+        """A 512-byte answer set truncates for plain clients but fits
+        when the client advertises an EDNS budget (RFC 6891 payload
+        negotiation replacing the fixed 512 B cap)."""
+
+        from consul_tpu.agent.dns import TYPE_OPT, parse_response
+
+        async with dev_stack() as (agent, addr, _dns, dns_addr):
+            for i in range(30):
+                st, _, _x = await http_call(
+                    addr, "PUT", "/v1/catalog/register",
+                    json.dumps({
+                        "Node": f"bulk-{i}",
+                        "Address": f"10.0.{i // 250}.{i % 250}",
+                        "Service": {"Service": "bulk", "Port": 80},
+                    }).encode())
+                assert st == 200
+            # Plain 512-byte query: TC bit set, partial answers.
+            raw = await _raw_dns(
+                dns_addr, build_query(7, "bulk.service.consul"))
+            flags = struct.unpack(">H", raw[2:4])[0]
+            assert flags & 0x0200, "expected TC for plain client"
+            # EDNS query with a 4k budget: all answers, no TC, and
+            # an OPT RR echoed in the additional section.
+            raw = await _raw_dns(dns_addr, _build_edns_query(
+                8, "bulk.service.consul", TYPE_A, 4096))
+            flags = struct.unpack(">H", raw[2:4])[0]
+            assert not (flags & 0x0200), "EDNS reply must not truncate"
+            arcount = struct.unpack(">H", raw[10:12])[0]
+            assert arcount == 1
+            assert raw[-11:-9] == b"\x00" + bytes([TYPE_OPT >> 8])
+            _, rcode, answers = parse_response(raw)
+            assert rcode == 0 and len(answers) == 30
