@@ -144,11 +144,15 @@ class HTTPRequest:
 
 class HTTPResponse:
     def __init__(self, status: int = 200, body: Any = None,
-                 headers: Optional[dict] = None, raw: Optional[bytes] = None):
+                 headers: Optional[dict] = None, raw: Optional[bytes] = None,
+                 stream=None):
         self.status = status
         self.body = body
         self.headers = headers or {}
         self.raw = raw
+        # Async iterator of bytes → Transfer-Encoding: chunked response
+        # (the /v1/agent/monitor live feed).
+        self.stream = stream
 
 
 def _meta_headers(meta: Optional[dict]) -> dict:
@@ -214,7 +218,7 @@ class HTTPApi:
                 if req is None:
                     break
                 resp = await self._dispatch(req)
-                await self._write_response(writer, req, resp)
+                await self._write_response(writer, req, resp, reader=reader)
                 if req.headers.get("connection", "").lower() == "close":
                     break
         except (asyncio.IncompleteReadError, ConnectionError,
@@ -279,7 +283,9 @@ class HTTPApi:
         return HTTPRequest(method, path, query, headers, body)
 
     async def _write_response(self, writer, req: HTTPRequest,
-                              resp: HTTPResponse) -> None:
+                              resp: HTTPResponse, reader=None) -> None:
+        if resp.stream is not None:
+            return await self._write_chunked(writer, resp, reader)
         if resp.raw is not None:
             payload = resp.raw
             ctype = "application/octet-stream"
@@ -314,6 +320,41 @@ class HTTPApi:
             head.append(f"{k}: {v}")
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
         await writer.drain()
+
+    async def _write_chunked(self, writer, resp: HTTPResponse,
+                             reader=None) -> None:
+        """Stream an async byte iterator as a chunked response
+        (agent_endpoint.go AgentMonitor's flushing writer).  The
+        connection closes when the stream ends or the client hangs up —
+        a live feed has no meaningful keep-alive continuation."""
+        head = [f"HTTP/1.1 {resp.status} {_STATUS_TEXT.get(resp.status, 'OK')}",
+                "Content-Type: "
+                + resp.headers.get("Content-Type", "text/plain"),
+                "Transfer-Encoding: chunked",
+                "Connection: close"]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+        await writer.drain()
+        try:
+            async for chunk in resp.stream:
+                # Empty chunks are liveness ticks from the stream: a
+                # cleanly-closed client delivers EOF on the read side
+                # (a FIN alone never flips writer.is_closing), so check
+                # the reader to tear down while the stream is quiet.
+                if writer.is_closing() or (
+                    reader is not None and reader.at_eof()
+                ):
+                    break
+                if not chunk:
+                    continue
+                writer.write(f"{len(chunk):x}\r\n".encode()
+                             + chunk + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        finally:
+            if hasattr(resp.stream, "aclose"):
+                await resp.stream.aclose()
+            writer.close()
 
     async def _dispatch(self, req: HTTPRequest) -> HTTPResponse:
         import time as _time
@@ -419,6 +460,7 @@ class HTTPApi:
           self.agent_force_leave)
         r("GET", r"/v1/agent/host", self.agent_host)
         r("GET", r"/v1/agent/metrics", self.agent_metrics)
+        r("GET", r"/v1/agent/monitor", self.agent_monitor)
         r("GET", r"/v1/agent/self", self.agent_self)
         r("GET", r"/v1/agent/members", self.agent_members)
         r("GET", r"/v1/agent/services", self.agent_services)
@@ -636,6 +678,34 @@ class HTTPApi:
         """/v1/agent/metrics (agent_endpoint.go AgentMetrics): the
         in-memory sink's aggregated view."""
         return HTTPResponse(200, KeyedMap(metrics().snapshot()))
+
+    async def agent_monitor(self, req, m) -> HTTPResponse:
+        """/v1/agent/monitor (agent_endpoint.go:1140 AgentMonitor):
+        chunked stream of live log lines from the whole consul_tpu
+        logger tree at ?loglevel= (default info)."""
+        from consul_tpu.agent.monitor import Monitor
+
+        # agent_endpoint.go AgentMonitor: requires agent:read.
+        await self._acl_check(
+            req, "agent", self.agent.config.node_name, "read")
+        try:
+            mon = Monitor(req.query.get("loglevel", "info")).start()
+        except ValueError as e:
+            return HTTPResponse(400, {"error": str(e)})
+
+        async def lines():
+            try:
+                while True:
+                    try:
+                        yield await mon.next_line(timeout=5.0)
+                    except asyncio.TimeoutError:
+                        yield b""  # liveness tick → hang-up detection
+            finally:
+                dropped = mon.stop()
+                if dropped:
+                    log.warning("monitor dropped %d log lines", dropped)
+
+        return HTTPResponse(200, stream=lines())
 
     async def ui_index(self, req, m) -> HTTPResponse:
         from consul_tpu.agent.ui import UI_HTML

@@ -72,6 +72,43 @@ class ConsulClient:
         self.config = ConfigAPI(self)
         self.acl = ACLAPI(self)
 
+    def _host_port(self) -> tuple[str, int]:
+        host, _, port = self.addr.rpartition(":")
+        if not host or not port.isdigit():
+            return self.addr, 8500
+        return host, int(port)
+
+    async def stream(self, path: str):
+        """GET a chunked-streaming endpoint (/v1/agent/monitor), yielding
+        raw body chunks until the server ends the stream."""
+        host, port = self._host_port()
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            token_hdr = (
+                f"X-Consul-Token: {self.token}\r\n" if self.token else ""
+            )
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nHost: {host}\r\n{token_hdr}\r\n"
+                .encode())
+            await writer.drain()
+            status = int((await reader.readline()).split()[1])
+            while (await reader.readline()) not in (b"\r\n", b""):
+                pass
+            if status != 200:
+                raise APIError(status, path)
+            while True:
+                size_line = await reader.readline()
+                if not size_line:
+                    return
+                size = int(size_line.strip() or b"0", 16)
+                if size == 0:
+                    return
+                chunk = await reader.readexactly(size)
+                await reader.readexactly(2)  # trailing CRLF
+                yield chunk
+        finally:
+            writer.close()
+
     # -- raw request -----------------------------------------------------
 
     async def request(

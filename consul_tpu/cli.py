@@ -25,7 +25,6 @@ from pathlib import Path
 from typing import Optional
 
 from consul_tpu.api import ConsulClient, parse_watch
-from consul_tpu.api.client import QueryOptions
 from consul_tpu.version import __version__
 
 DEFAULT_HTTP = "127.0.0.1:8500"
@@ -166,7 +165,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = cmd("services", cmd_services, "register/deregister agent services")
     sp.add_argument("verb", choices=["register", "deregister"])
     sp.add_argument("arg", help="JSON definition file (or '-'), or id")
-    sp = cmd("monitor", cmd_monitor, "stream user events")
+    sp = cmd("monitor", cmd_monitor, "stream the agent's live logs")
+    sp.add_argument("-log-level", default="info", dest="log_level")
 
     # connect --------------------------------------------------------------
     sp = cmd("connect", cmd_connect, "service mesh tools")
@@ -761,19 +761,23 @@ async def cmd_services(args) -> int:
 
 
 async def cmd_monitor(args) -> int:
-    """Stream user events as they arrive (lightweight stand-in for the
-    reference's log-streaming monitor)."""
+    """Stream the agent's live logs (command/monitor → chunked
+    /v1/agent/monitor, agent_endpoint.go:1140)."""
+    from consul_tpu.api.client import APIError
+
     c = _client(args)
-    _, meta = await c.event.list()
-    index = meta.index
-    while True:
-        events, meta = await c.event.list(
-            opts=QueryOptions(index=index, wait="30s"))
-        if meta.index != index:
-            for e in events:
-                print(json.dumps(e, default=_json_bytes))
+    try:
+        async for chunk in c.stream(
+            f"/v1/agent/monitor?loglevel={args.log_level}"
+        ):
+            sys.stdout.write(chunk.decode(errors="replace"))
             sys.stdout.flush()
-            index = meta.index
+    except APIError as e:
+        print(f"monitor failed: {e}", file=sys.stderr)
+        return 1
+    except (asyncio.IncompleteReadError, KeyboardInterrupt):
+        pass
+    return 0
 
 
 async def cmd_connect(args) -> int:

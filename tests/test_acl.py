@@ -656,3 +656,24 @@ class TestRolesAndLogin:
             store = agent.delegate.store
             assert store.acl_binding_rule_get(br["ID"]) is None
             assert store.acl_token_get(tok["SecretID"]) is None
+
+
+class TestMonitorACL:
+    async def test_monitor_requires_agent_read(self):
+        """/v1/agent/monitor is gated on agent:read
+        (agent_endpoint.go AgentMonitor)."""
+        async with acl_stack() as (_agent, addr):
+            st, _, _b = await http_call(addr, "GET", "/v1/agent/monitor")
+            assert st == 403
+            # Master token passes the gate: status line says 200 and the
+            # response is a chunked stream (read just the head).
+            host, port = addr.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            writer.write((
+                "GET /v1/agent/monitor HTTP/1.1\r\n"
+                f"Host: {host}\r\nX-Consul-Token: {MASTER}\r\n\r\n"
+            ).encode())
+            await writer.drain()
+            status_line = await asyncio.wait_for(reader.readline(), 10)
+            assert b"200" in status_line
+            writer.close()

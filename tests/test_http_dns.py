@@ -515,3 +515,74 @@ class TestPtrRecursorsEdns:
             assert raw[-11:-9] == b"\x00" + bytes([TYPE_OPT >> 8])
             _, rcode, answers = parse_response(raw)
             assert rcode == 0 and len(answers) == 30
+
+
+class TestAgentMonitor:
+    async def test_monitor_streams_live_log_lines(self):
+        """/v1/agent/monitor (agent_endpoint.go:1140): chunked stream of
+        log lines at the requested level, fed by the consul_tpu logger
+        tree (logging/monitor/monitor.go sink)."""
+        import logging as _logging
+
+        async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+            host, port = addr.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            writer.write((
+                "GET /v1/agent/monitor?loglevel=debug HTTP/1.1\r\n"
+                f"Host: {host}\r\n\r\n").encode())
+            await writer.drain()
+            status_line = await reader.readline()
+            assert b"200" in status_line
+            hdrs = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                hdrs[k.strip().lower()] = v.strip()
+            assert hdrs.get("transfer-encoding") == "chunked"
+
+            async def read_chunk():
+                size = int((await reader.readline()).strip() or b"0", 16)
+                data = await reader.readexactly(size)
+                await reader.readexactly(2)
+                return data
+
+            # Emit a log line INTO the tree and watch it stream out.
+            _logging.getLogger("consul_tpu.test").warning("hello-monitor")
+            got = b""
+            while b"hello-monitor" not in got:
+                got += await asyncio.wait_for(read_chunk(), 10)
+            assert b"WARNING" in got and b"consul_tpu.test" in got
+
+            # Level filtering: a debug record under loglevel=warn never
+            # surfaces (checked via a second subscription).
+            writer.close()
+            reader2, writer2 = await asyncio.open_connection(
+                host, int(port))
+            writer2.write((
+                "GET /v1/agent/monitor?loglevel=warn HTTP/1.1\r\n"
+                f"Host: {host}\r\n\r\n").encode())
+            await writer2.drain()
+            while (await reader2.readline()) not in (b"\r\n", b""):
+                pass
+
+            async def read_chunk2():
+                size = int((await reader2.readline()).strip() or b"0", 16)
+                data = await reader2.readexactly(size)
+                await reader2.readexactly(2)
+                return data
+
+            _logging.getLogger("consul_tpu.test").debug("too-quiet")
+            _logging.getLogger("consul_tpu.test").error("loud-enough")
+            got = b""
+            while b"loud-enough" not in got:
+                got += await asyncio.wait_for(read_chunk2(), 10)
+            assert b"too-quiet" not in got
+            writer2.close()
+
+    async def test_monitor_bad_level_and_acl(self):
+        async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+            st, _, err = await http_call(
+                addr, "GET", "/v1/agent/monitor?loglevel=nope")
+            assert st == 400, err
