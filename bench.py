@@ -67,6 +67,35 @@ def main() -> None:
     bcast_report = run_broadcast(bcast_cfg, steps=60, seed=0, warmup=True)
     bcast_summary = bcast_report.summary()
 
+    # Full-membership study past the dense O(N²) wall: 100k observers ×
+    # 100k subjects via the top-K sparse model (models/
+    # membership_sparse.py) — five dense [n, n] arrays would need
+    # ~200 GB; the slot representation fits one chip.  overflow == 0
+    # certifies the run dropped nothing (exactness ladder in the module
+    # docstring).
+    try:
+        from consul_tpu.models import SparseMembershipConfig
+        from consul_tpu.models.membership import MembershipConfig
+        from consul_tpu.sim import run_membership_sparse
+
+        mcfg = SparseMembershipConfig(
+            base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),)),
+            k_slots=64,
+        )
+        mreport, moverflow = run_membership_sparse(
+            mcfg, steps=30, track=(42,), warmup=False
+        )
+        membership = {
+            "membership_sparse_n": 100_000,
+            "membership_sparse_k": 64,
+            "membership_sparse_rounds_per_sec": round(
+                mreport.rounds_per_sec, 2),
+            "membership_sparse_overflow": int(moverflow),
+        }
+    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
+        membership = {"membership_sparse_error": str(e)[:200]}
+
     # Host-plane KV/HTTP throughput vs the reference's published numbers
     # (bench/results-0.7.1.md: 3,780 PUT/s, 9,774 stale GET/s).  Run in
     # a clean subprocess: the host plane never touches JAX, and this
@@ -108,6 +137,7 @@ def main() -> None:
                     # The headline scan is unsharded: the whole 1M-node
                     # population lives and steps on ONE chip.
                     "nodes_per_chip": N,
+                    **membership,
                     **kv,
                 },
             }

@@ -391,6 +391,49 @@ class Agent:
                 del self.checks[cid]
         return self.local.remove_service(service_id)
 
+    # -- maintenance mode (agent.go:3411-3483 EnableServiceMaintenance /
+    # EnableNodeMaintenance): a synthetic CRITICAL check pulls the
+    # service (or every service on the node) out of discovery until
+    # disabled; the reason lands in the check notes.
+
+    def enable_service_maintenance(self, service_id: str,
+                                   reason: str = "") -> bool:
+        entry = self.local.services.get(service_id)
+        if entry is None or entry.deleted:
+            return False
+        self.local.add_check({
+            "check_id": f"_service_maintenance:{service_id}",
+            "name": "Service Maintenance Mode",
+            "status": "critical",
+            "notes": reason or "Maintenance mode is enabled for this "
+                               "service, but no reason was provided.",
+            "service_id": service_id,
+            "service_name": entry.service.get("service", ""),
+        })
+        return True
+
+    def disable_service_maintenance(self, service_id: str) -> bool:
+        if self.local.services.get(service_id) is None:
+            return False
+        self.local.remove_check(f"_service_maintenance:{service_id}")
+        return True
+
+    def enable_node_maintenance(self, reason: str = "") -> None:
+        self.local.add_check({
+            "check_id": "_node_maintenance",
+            "name": "Node Maintenance Mode",
+            "status": "critical",
+            "notes": reason or "Maintenance mode is enabled for this "
+                               "node, but no reason was provided.",
+        })
+
+    def disable_node_maintenance(self) -> None:
+        self.local.remove_check("_node_maintenance")
+
+    def in_node_maintenance(self) -> bool:
+        entry = self.local.checks.get("_node_maintenance")
+        return entry is not None and not entry.deleted
+
     def add_check(self, defn: dict) -> None:
         cid = defn.get("check_id") or defn.get("name")
         runner = build_check_runner(

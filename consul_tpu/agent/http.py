@@ -464,9 +464,13 @@ class HTTPApi:
         r("GET", r"/v1/agent/self", self.agent_self)
         r("GET", r"/v1/agent/members", self.agent_members)
         r("GET", r"/v1/agent/services", self.agent_services)
+        r("GET", r"/v1/agent/service/(?P<sid>[^/?]+)", self.agent_service)
         r("GET", r"/v1/agent/checks", self.agent_checks)
         r("PUT", r"/v1/agent/join/(?P<addr>.+)", self.agent_join)
         r("PUT", r"/v1/agent/leave", self.agent_leave)
+        r("PUT", r"/v1/agent/maintenance", self.agent_node_maintenance)
+        r("PUT", r"/v1/agent/service/maintenance/(?P<sid>[^/?]+)",
+          self.agent_service_maintenance)
         r("PUT", r"/v1/agent/service/register", self.agent_service_register)
         r("PUT", r"/v1/agent/service/deregister/(?P<sid>.+)",
           self.agent_service_deregister)
@@ -766,6 +770,53 @@ class HTTPApi:
             e.service["id"]: e.service for e in
             self.agent.local.services.values() if not e.deleted
         }))
+
+    async def agent_node_maintenance(self, req, m) -> HTTPResponse:
+        """PUT /v1/agent/maintenance?enable=true|false&reason=...
+        (agent_endpoint.go AgentNodeMaintenance)."""
+        await self._acl_check(
+            req, "node", self.agent.config.node_name, "write")
+        enable = req.query.get("enable", "").lower()
+        if enable not in ("true", "false"):
+            return HTTPResponse(400, {"error": "missing ?enable=true|false"})
+        if enable == "true":
+            self.agent.enable_node_maintenance(req.query.get("reason", ""))
+        else:
+            self.agent.disable_node_maintenance()
+        return HTTPResponse(200, True)
+
+    async def agent_service_maintenance(self, req, m) -> HTTPResponse:
+        """PUT /v1/agent/service/maintenance/:id?enable=...&reason=...
+        (agent_endpoint.go AgentServiceMaintenance)."""
+        sid = m.group("sid")
+        # Lookup first, ACL with the REAL service name second (the
+        # reference orders it the same way — a typo'd id is a 404, not
+        # a spurious permission-denied on the empty name).
+        entry = self.agent.local.services.get(sid)
+        if entry is None or entry.deleted:
+            return HTTPResponse(404, {"error": f"unknown service id {sid!r}"})
+        await self._acl_check(
+            req, "service", entry.service.get("service", ""), "write")
+        enable = req.query.get("enable", "").lower()
+        if enable not in ("true", "false"):
+            return HTTPResponse(400, {"error": "missing ?enable=true|false"})
+        if enable == "true":
+            ok = self.agent.enable_service_maintenance(
+                sid, req.query.get("reason", ""))
+        else:
+            ok = self.agent.disable_service_maintenance(sid)
+        if not ok:
+            return HTTPResponse(404, {"error": f"unknown service id {sid!r}"})
+        return HTTPResponse(200, True)
+
+    async def agent_service(self, req, m) -> HTTPResponse:
+        """GET /v1/agent/service/:id (agent_endpoint.go AgentService) —
+        one locally registered service, the agent_service watch's
+        source."""
+        entry = self.agent.local.services.get(m.group("sid"))
+        if entry is None or entry.deleted:
+            return HTTPResponse(404, {"error": "unknown service id"})
+        return HTTPResponse(200, entry.service)
 
     async def agent_checks(self, req, m) -> HTTPResponse:
         return HTTPResponse(200, KeyedMap({
@@ -1268,10 +1319,50 @@ class HTTPApi:
         }, headers=_meta_headers(out.get("meta")))
 
     async def connect_ca_leaf(self, req, m) -> HTTPResponse:
-        out = await self.agent.rpc("ConnectCA.Sign", {
-            "service": m.group("svc"), **req.dc_option(),
-        })
-        return HTTPResponse(200, out.get("leaf"))
+        """GET /v1/agent/connect/ca/leaf/:service — cached per service
+        like the reference's connect-ca-leaf cache type: re-signed only
+        when the active root rotates or the cert passes half-life
+        (cache-types/connect_ca_leaf.go), so repeated reads (and the
+        connect_leaf watch) see a STABLE cert, not a fresh signature
+        per request."""
+        import datetime as _dt
+
+        svc = m.group("svc")
+        # agent_endpoint.go AgentConnectCALeafCert: service:write on the
+        # named service — enforced per request, cached cert or not (the
+        # cache must never bypass the ACL gate).
+        await self._acl_check(req, "service", svc, "write")
+        roots_out = await self.agent.rpc(
+            "ConnectCA.Roots", dict(req.query_options()))
+        active = next(
+            (r["id"] for r in roots_out.get("roots") or []
+             if r.get("active")), "")
+        cache = getattr(self.agent, "_leaf_cache", None)
+        if cache is None:
+            cache = self.agent._leaf_cache = {}
+        cache_key = (svc, req.query.get("dc", ""))
+        leaf = cache.get(cache_key)
+        stale = leaf is None or leaf.get("root_id") != active
+        if leaf is not None and not stale:
+            try:
+                expires = _dt.datetime.fromisoformat(leaf["valid_before"])
+                issued = _dt.datetime.fromisoformat(
+                    leaf.get("valid_after", leaf["valid_before"]))
+                life = (expires - issued).total_seconds()
+                left = (expires - _dt.datetime.now(_dt.timezone.utc)
+                        ).total_seconds()
+                stale = life > 0 and left < life * 0.5
+            except (KeyError, ValueError):
+                stale = False
+        if stale:
+            # query_options() carries the caller's token — the Sign RPC
+            # enforces its own ACL with it.
+            out = await self.agent.rpc("ConnectCA.Sign", {
+                "service": svc, **req.query_options(),
+            })
+            leaf = out.get("leaf")
+            cache[cache_key] = leaf
+        return HTTPResponse(200, leaf)
 
     async def intention_create(self, req, m) -> HTTPResponse:
         out = await self.agent.rpc("Intention.Apply", {

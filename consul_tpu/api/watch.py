@@ -119,6 +119,37 @@ async def _event(c: ConsulClient, p: dict, opts) -> tuple[int, Any]:
     return meta.index, events
 
 
+async def _connect_roots(c: ConsulClient, p: dict, opts) -> tuple[int, Any]:
+    """funcs.go connectRootsWatch: the CA root set."""
+    data, meta = await c.read("/v1/connect/ca/roots", opts=opts,
+                              allow_404=False)
+    return meta.index, data
+
+
+async def _connect_leaf(c: ConsulClient, p: dict, opts) -> tuple[int, Any]:
+    """funcs.go connectLeafWatch: a service's leaf certificate.  The
+    agent caches the leaf per service (re-signed only on root rotation
+    or half-life), so a paced poll + payload fingerprint gives the same
+    change semantics as the reference's cache-notify watch."""
+    if opts.index:
+        await asyncio.sleep(1.0)
+    data, _meta = await c.read(
+        f"/v1/agent/connect/ca/leaf/{p['service']}", allow_404=False)
+    return opts.index + 1, data
+
+
+async def _agent_service(c: ConsulClient, p: dict, opts) -> tuple[int, Any]:
+    """funcs.go agentServiceWatch: one locally registered service.  The
+    agent-local endpoint has no blocking index, so this POLLS on a fixed
+    cadence (the reference's hash-based watch does the same under the
+    hood) — the returned pseudo-index always advances and the plan's
+    payload fingerprint suppresses no-change wakeups."""
+    if opts.index:
+        await asyncio.sleep(1.0)  # pacing between polls
+    data, _meta = await c.read(f"/v1/agent/service/{p['service_id']}")
+    return opts.index + 1, data
+
+
 _FETCHERS = {
     "key": _key,
     "keyprefix": _keyprefix,
@@ -127,6 +158,9 @@ _FETCHERS = {
     "service": _service,
     "checks": _checks,
     "event": _event,
+    "connect_roots": _connect_roots,
+    "connect_leaf": _connect_leaf,
+    "agent_service": _agent_service,
 }
 
 
@@ -139,7 +173,8 @@ def parse_watch(params: dict, client: ConsulClient) -> WatchPlan:
             f"{sorted(_FETCHERS)}"
         )
     required = {"key": ["key"], "keyprefix": ["prefix"],
-                "service": ["service"]}.get(wtype, [])
+                "service": ["service"], "connect_leaf": ["service"],
+                "agent_service": ["service_id"]}.get(wtype, [])
     for field in required:
         if not params.get(field):
             raise ValueError(f"watch type {wtype!r} requires {field!r}")

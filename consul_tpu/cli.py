@@ -167,6 +167,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("arg", help="JSON definition file (or '-'), or id")
     sp = cmd("monitor", cmd_monitor, "stream the agent's live logs")
     sp.add_argument("-log-level", default="info", dest="log_level")
+    sp = cmd("maint", cmd_maint, "toggle node/service maintenance mode")
+    sp.add_argument("-enable", action="store_true")
+    sp.add_argument("-disable", action="store_true")
+    sp.add_argument("-service", default="", help="service id (node-wide "
+                    "when omitted)")
+    sp.add_argument("-reason", default="")
 
     # connect --------------------------------------------------------------
     sp = cmd("connect", cmd_connect, "service mesh tools")
@@ -620,6 +626,26 @@ async def cmd_login(args) -> int:
         print(f"token written to {args.token_sink_file}")
     else:
         print(f"SecretID: {secret}")
+    return 0
+
+
+async def cmd_maint(args) -> int:
+    """command/maint: service or node maintenance toggle
+    (agent.go:3411 EnableServiceMaintenance)."""
+    if args.enable == args.disable:
+        print("exactly one of -enable / -disable is required",
+              file=sys.stderr)
+        return 1
+    c = _client(args)
+    params = {"enable": "true" if args.enable else "false"}
+    if args.reason:
+        params["reason"] = args.reason
+    if args.service:
+        path = f"/v1/agent/service/maintenance/{args.service}"
+    else:
+        path = "/v1/agent/maintenance"
+    await c.write("PUT", path, params=params)
+    print("maintenance " + ("enabled" if args.enable else "disabled"))
     return 0
 
 

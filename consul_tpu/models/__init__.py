@@ -11,6 +11,12 @@ from consul_tpu.models.broadcast import (
     broadcast_init,
     broadcast_round,
 )
+from consul_tpu.models.membership_sparse import (
+    SparseMembershipConfig,
+    SparseMembershipState,
+    sparse_membership_init,
+    sparse_membership_round,
+)
 from consul_tpu.models.membership import (
     RANK_ALIVE,
     RANK_DEAD,
@@ -55,6 +61,10 @@ __all__ = [
     "broadcast_round",
     "MembershipConfig",
     "MembershipState",
+    "SparseMembershipConfig",
+    "SparseMembershipState",
+    "sparse_membership_init",
+    "sparse_membership_round",
     "membership_init",
     "membership_round",
     "make_key",

@@ -1,0 +1,619 @@
+"""Top-K sparse full-membership SWIM: past the O(N²) wall.
+
+``models/membership.py`` carries the complete N×N view matrix — exact,
+but five int32 [n, n] arrays cap one chip near n ≈ 3·10⁴.  This model
+exploits the protocol's own steady state: almost every cell of the view
+matrix is the DEFAULT value (alive at incarnation 0, no pending
+retransmits, no suspicion timer).  Each observer therefore keeps only K
+explicit slots — its own row's NON-default cells — and every absent
+subject implicitly holds the default.  State drops to O(N·K); with
+K = 64 a 100k-node study fits in ~130 MB instead of ~200 GB.
+
+Exactness ladder (each level counted, nothing silent):
+  overflow == 0 and forgotten == 0   bit-exact dense dynamics — the
+        representation dropped nothing.
+  forgotten > 0   SETTLED cells (alive rank, no pending retransmit or
+        suspicion timer) were evicted to make room; the only loss is a
+        remembered incarnation, which the next push/pull or gossip
+        about the subject re-teaches.  Active state — suspicions,
+        queued retransmits, confirmations — is never evicted.
+  overflow > 0    genuinely urgent news found no slot and was dropped;
+        the sender's remaining retransmit budget is the retry.  A study
+        whose overflow grows materially needs a bigger K.
+With K == n and the identity slot layout the per-tick computation
+consumes the SAME random draws in the SAME shapes as
+``membership_round``, so tests/test_membership_sparse.py pins
+sparse == dense array equality.
+
+Redesign notes (no reference counterpart — the reference's per-process
+hashmap IS sparse; this is its SPMD analogue):
+  slots         slot_subj[i, k] names the subject of (i, k); -1 empty.
+                Empty slots hold default contents as an invariant, so
+                eviction = overwriting slot_subj.
+  deliveries    all inbound news (gossip scatters + push/pull row
+                merges) becomes one flat (receiver, subject, value)
+                arrival stream, located into slot indices by a chunked
+                compare-scan (bounded temp memory), then scatter-max'd
+                — the sparse form of the dense model's one-max() merge.
+  allocation    arrivals for subjects without a slot first stage into a
+                hash-indexed [n, P] buffer, then claim evictable slots
+                (empty first, then default-content slots); failures
+                count into ``overflow`` and the sender's retransmit
+                budget provides the retry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.models.membership import (
+    NEVER,
+    RANK_ALIVE,
+    RANK_DEAD,
+    RANK_LEFT,
+    RANK_SUSPECT,
+    MembershipConfig,
+    _lifeguard_timeout_ticks,
+    _schedule_array,
+    key_inc,
+    key_rank,
+    make_key,
+)
+from consul_tpu.ops import bernoulli_mask, sample_peers, sample_probe_targets
+
+DEFAULT_KEY = 0  # make_key(0, RANK_ALIVE): the steady-state cell
+
+_CHUNK = 1 << 18  # arrival-locate chunk: bounds the [chunk, K] temp
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseMembershipConfig:
+    """A membership study bounded to K explicit cells per observer.
+
+    ``join_at`` is unsupported: a joiner's row/column default is
+    "unknown", not "alive@0", which the shared-default representation
+    cannot express (use the dense model for join studies)."""
+
+    base: MembershipConfig
+    k_slots: int = 64
+    stage_width: int = 8  # P: new-subject allocations per node per tick
+
+    def __post_init__(self):
+        if self.base.join_at:
+            raise ValueError(
+                "sparse membership does not support join_at schedules"
+            )
+        if self.k_slots < 2:
+            raise ValueError("k_slots must be >= 2")
+
+
+class SparseMembershipState(NamedTuple):
+    slot_subj: jax.Array        # int32[n, K] — subject ids, -1 empty
+    key: jax.Array              # int32[n, K]
+    suspect_since: jax.Array    # int32[n, K]
+    confirms: jax.Array         # int32[n, K]
+    tx: jax.Array               # int32[n, K]
+    own_inc: jax.Array          # int32[n]
+    awareness: jax.Array        # int32[n]
+    probe_pending_at: jax.Array # int32[n]
+    probe_subject: jax.Array    # int32[n]
+    overflow: jax.Array         # int32 — news dropped to slot pressure
+    forgotten: jax.Array        # int32 — settled cells evicted (benign)
+    tick: jax.Array             # int32 scalar
+
+
+def sparse_membership_init(cfg: SparseMembershipConfig) -> SparseMembershipState:
+    n, K = cfg.base.n, cfg.k_slots
+    if K >= n:
+        # Identity layout: slot j == subject j (the exact-parity mode).
+        slot_subj = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32)[None, :], (n, n)
+        )
+        K = n
+    else:
+        # Slot 0 = self; the rest allocate on demand.
+        slot_subj = jnp.full((n, K), -1, jnp.int32)
+        slot_subj = slot_subj.at[:, 0].set(jnp.arange(n, dtype=jnp.int32))
+    return SparseMembershipState(
+        slot_subj=slot_subj,
+        key=jnp.zeros((n, K), jnp.int32),
+        suspect_since=jnp.full((n, K), NEVER, jnp.int32),
+        confirms=jnp.zeros((n, K), jnp.int32),
+        tx=jnp.zeros((n, K), jnp.int32),
+        own_inc=jnp.zeros((n,), jnp.int32),
+        awareness=jnp.zeros((n,), jnp.int32),
+        probe_pending_at=jnp.full((n,), NEVER, jnp.int32),
+        probe_subject=jnp.zeros((n,), jnp.int32),
+        overflow=jnp.int32(0),
+        forgotten=jnp.int32(0),
+        tick=jnp.int32(0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# slot lookup / arrival machinery
+# ---------------------------------------------------------------------------
+
+
+def _locate_rows(slot_subj: jax.Array, recv: jax.Array, subj: jax.Array):
+    """Slot index of ``subj`` in receiver ``recv``'s table, -1 when
+    absent.  [A] → [A]; the [A, K] compare is the caller's chunk."""
+    rows = slot_subj[recv]                              # [A, K]
+    eq = rows == subj[:, None]
+    found = jnp.any(eq, axis=1)
+    idx = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    return jnp.where(found, idx, -1)
+
+
+def _scan_chunks(fn, carry, arrays, chunk: int):
+    """lax.scan ``fn`` over equal chunks of flat arrival arrays (padded
+    with invalid arrivals) so locate temps stay bounded."""
+    a0 = arrays[0]
+    total = a0.shape[0]
+    nchunk = max(1, -(-total // chunk))
+    pad = nchunk * chunk - total
+    padded = [
+        jnp.concatenate([a, jnp.full((pad,), -1, a.dtype)]) if pad else a
+        for a in arrays
+    ]
+    stacked = [a.reshape(nchunk, chunk) for a in padded]
+    carry, _ = jax.lax.scan(
+        lambda c, xs: (fn(c, *xs), None), carry, tuple(stacked)
+    )
+    return carry
+
+
+def settled_of(slots: tuple) -> jax.Array:
+    """Cells whose eviction loses only recoverable information: alive
+    rank with no pending retransmit, suspicion timer, or confirmations.
+    (A settled alive@inc>0 cell forgets the incarnation — the next
+    push/pull or gossip about the subject re-teaches it.)"""
+    slot_subj, key_m, since, conf, tx = slots
+    n = slot_subj.shape[0]
+    self_ids = jnp.arange(n, dtype=jnp.int32)
+    return (
+        (slot_subj >= 0)
+        & (slot_subj != self_ids[:, None])    # the self slot is pinned
+        & (key_rank(key_m) == RANK_ALIVE)
+        & (tx == 0) & (since == NEVER) & (conf == 0)
+    )
+
+
+def _claim_slot(slots: tuple, settled: jax.Array, want: jax.Array,
+                new_subj: jax.Array, n: int, K: int):
+    """Claim one evictable slot per row for ``new_subj``: empty slots
+    first, then SETTLED cells (alive rank, no pending retransmit or
+    suspicion — recoverable information, the protocol re-learns it from
+    the next push/pull).  Claimed slots reset to default contents.
+
+    Returns (slots', claimed_mask, chosen_idx, forgotten_count)."""
+    slot_subj, key_m, since, conf, tx = slots
+    rows = jnp.arange(n, dtype=jnp.int32)
+    evict_score = jnp.where(slot_subj < 0, 2, 0)
+    evict_score = jnp.maximum(evict_score, jnp.where(settled, 1, 0))
+    choice = jnp.argmax(
+        evict_score * K - jnp.arange(K, dtype=jnp.int32)[None, :],
+        axis=1,
+    ).astype(jnp.int32)
+    can = want & (evict_score[rows, choice] > 0)
+    forgot = jnp.sum(
+        (can & (slot_subj[rows, choice] >= 0)
+         & (key_m[rows, choice] != DEFAULT_KEY)).astype(jnp.int32)
+    )
+    col = jnp.where(can, choice, K)
+    slot_subj = slot_subj.at[rows, col].set(new_subj, mode="drop")
+    key_m = key_m.at[rows, col].set(DEFAULT_KEY, mode="drop")
+    since = since.at[rows, col].set(NEVER, mode="drop")
+    conf = conf.at[rows, col].set(0, mode="drop")
+    tx = tx.at[rows, col].set(0, mode="drop")
+    return (slot_subj, key_m, since, conf, tx), can, choice, forgot
+
+
+def _merge_arrivals(
+    slots: tuple,
+    recv: jax.Array, subj: jax.Array, val: jax.Array, sus: jax.Array,
+    ok: jax.Array, alloc: jax.Array, n: int, K: int, P: int,
+    overflow: jax.Array, forgotten: jax.Array,
+):
+    """The delivery pipeline: allocate slots for new subjects, then
+    scatter-max arrival values into per-slot staging planes.
+
+    Returns (slots, key_rx[n,K], sus_rx[n,K], overflow, forgotten)."""
+    recv = jnp.where(ok, recv, -1)
+    alloc_i = alloc.astype(jnp.int32)
+    slot_subj = slots[0]
+
+    if K < n:
+        # -- pass A: stage arrivals whose subject has no slot.  One
+        # chunked scan carries (val, subj) together: scatter-max the
+        # value, then attach the subject wherever this arrival's value
+        # IS the current max (ties pick one arbitrarily — losers are
+        # counted as dropped in pass B and retry off retransmits).
+        def stage(carry, r, s, v, su, al):
+            stage_val, stage_subj = carry
+            valid = (r >= 0) & (al > 0)
+            slot = _locate_rows(slot_subj, jnp.maximum(r, 0), s)
+            need = valid & (slot < 0) & (v > DEFAULT_KEY)
+            h = jnp.where(need, s % P, P)
+            flat = jnp.where(need, r * P + h, n * P)
+            stage_val = stage_val.at[flat].max(v, mode="drop")
+            win = need & (stage_val[jnp.minimum(flat, n * P - 1)] == v)
+            stage_subj = stage_subj.at[
+                jnp.where(win, flat, n * P)
+            ].set(s, mode="drop")
+            return stage_val, stage_subj
+
+        stage_val, stage_subj = _scan_chunks(
+            stage,
+            (jnp.full((n * P,), -1, jnp.int32),
+             jnp.full((n * P,), -1, jnp.int32)),
+            (recv, subj, val, sus, alloc_i), _CHUNK,
+        )
+        stage_val = stage_val.reshape(n, P)
+        stage_subj = stage_subj.reshape(n, P)
+
+        # -- allocation: one claim round per stage column.  Slots
+        # claimed THIS tick are protected from later columns (their
+        # reset-to-default contents would otherwise read as settled).
+        fresh = jnp.zeros((n, K), bool)
+        rows_n = jnp.arange(n, dtype=jnp.int32)
+        for p in range(P):
+            want = (stage_val[:, p] > DEFAULT_KEY) & (stage_subj[:, p] >= 0)
+            # The hash partitions subjects across columns, but re-check
+            # presence to keep the invariant obvious and cheap.
+            present = jnp.any(
+                slots[0] == stage_subj[:, p][:, None], axis=1
+            )
+            want = want & ~present
+            settled_now = settled_of(slots) & ~fresh
+            slots, can, choice, forgot = _claim_slot(
+                slots, settled_now, want, stage_subj[:, p], n, K,
+            )
+            fresh = fresh.at[
+                rows_n, jnp.where(can, choice, K)
+            ].set(True, mode="drop")
+            forgotten = forgotten + forgot
+        slot_subj = slots[0]
+
+    # -- pass B: locate (post-allocation) and scatter-max --------------
+    def scatter(carry, r, s, v, su, al):
+        key_rx, sus_rx, dropped = carry
+        valid = r >= 0
+        slot = _locate_rows(slot_subj, jnp.maximum(r, 0), s)
+        hit = valid & (slot >= 0)
+        flat = jnp.where(hit, r * K + slot, n * K)
+        key_rx = key_rx.at[flat].max(v, mode="drop")
+        sus_rx = sus_rx.at[flat].max(su, mode="drop")
+        # Allocation-eligible news that STILL has no slot was dropped —
+        # whether its claim failed or it lost a stage-hash collision.
+        dropped = dropped + jnp.sum(
+            (valid & (al > 0) & (slot < 0)
+             & (v > DEFAULT_KEY)).astype(jnp.int32)
+        )
+        return key_rx, sus_rx, dropped
+
+    key_rx, sus_rx, dropped = _scan_chunks(
+        scatter,
+        (jnp.full((n * K,), -1, jnp.int32),
+         jnp.full((n * K,), -1, jnp.int32),
+         jnp.int32(0)),
+        (recv, subj, val, sus, alloc_i), _CHUNK,
+    )
+    return (slots, key_rx.reshape(n, K), sus_rx.reshape(n, K),
+            overflow + dropped, forgotten)
+
+
+def _view_of(slot_subj, slot_key, who: jax.Array, subj: jax.Array):
+    """who's view key of subj, defaulting absent cells to alive@0.
+    Shapes: who [..,], subj [..,] → [..,]."""
+    rows = slot_subj[who]                       # [.., K]
+    eq = rows == subj[..., None]
+    found = jnp.any(eq, axis=-1)
+    idx = jnp.argmax(eq, axis=-1)
+    got = jnp.take_along_axis(
+        slot_key[who], idx[..., None], axis=-1
+    )[..., 0]
+    return jnp.where(found, got, DEFAULT_KEY)
+
+
+def sparse_membership_round(
+    state: SparseMembershipState, key_rng: jax.Array,
+    cfg: SparseMembershipConfig,
+) -> SparseMembershipState:
+    """One tick — step-for-step mirror of ``membership_round`` over the
+    slot representation (same RNG split order and shapes at K == n)."""
+    base = cfg.base
+    n, F = base.n, base.fanout
+    K = state.key.shape[1]
+    P = min(cfg.stage_width, K)
+    M = min(base.piggyback, K)
+    t = state.tick
+    (k_tie, k_tgt, k_loss, k_pp, k_ppsel, k_probe, k_pfail) = jax.random.split(
+        key_rng, 7
+    )
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    fail_tick = _schedule_array(n, base.fail_at, NEVER)
+    leave_tick = _schedule_array(n, base.leave_at, NEVER)
+    present = jnp.ones((n,), bool)
+    crashed = t >= fail_tick
+    leaving = present & (t >= leave_tick) & ~crashed
+    departed = present & ~crashed & (
+        t >= jnp.where(
+            leave_tick == NEVER, NEVER, leave_tick + base.leave_grace_ticks
+        )
+    )
+    participates = present & ~crashed & ~departed
+
+    slot_subj = state.slot_subj
+    key_m = state.key
+    tx = state.tx
+    suspect_since = state.suspect_since
+    confirms = state.confirms
+    own_inc = state.own_inc
+    awareness = state.awareness
+    overflow = state.overflow
+
+    occupied = slot_subj >= 0
+    self_eq = slot_subj == rows[:, None]
+    self_slot = jnp.argmax(self_eq, axis=1).astype(jnp.int32)
+
+    # Self-view re-stamp (leave intent) — the self slot always exists.
+    diag = key_m[rows, self_slot]
+    diag_val = jnp.where(
+        leaving, make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE)
+    )
+    diag_val = jnp.maximum(diag, diag_val)
+    key_m = key_m.at[rows, self_slot].set(diag_val)
+    tx = tx.at[rows, self_slot].set(
+        jnp.where(diag_val > diag, base.tx_limit, tx[rows, self_slot])
+    )
+
+    # -- 1. gossip ------------------------------------------------------
+    prio = jnp.where(
+        occupied, tx.astype(jnp.float32), -jnp.inf
+    ) + jax.random.uniform(k_tie, (n, K))
+    _, sslot = jax.lax.top_k(prio, M)                    # slot idx [n, M]
+    sslot = sslot.astype(jnp.int32)
+    msg_subj = jnp.take_along_axis(slot_subj, sslot, axis=1)
+    msg_key = jnp.take_along_axis(key_m, sslot, axis=1)
+    msg_valid = (
+        (jnp.take_along_axis(tx, sslot, axis=1) > 0)
+        & (msg_subj >= 0)
+        & participates[:, None]
+    )
+
+    targets = sample_peers(k_tgt, n, F)
+    tgt_view = _view_of(slot_subj, key_m, rows[:, None], targets)
+    tgt_sendable = key_rank(tgt_view) <= RANK_SUSPECT
+    packet_ok = (
+        participates[:, None]
+        & tgt_sendable
+        & bernoulli_mask(k_loss, (n, F), 1.0 - base.loss)
+        & participates[targets]
+    )
+
+    recv_g = jnp.broadcast_to(targets[:, :, None], (n, F, M)).ravel()
+    subj_g = jnp.broadcast_to(msg_subj[:, None, :], (n, F, M)).ravel()
+    val_g = jnp.broadcast_to(msg_key[:, None, :], (n, F, M)).ravel()
+    ok_g = (packet_ok[:, :, None] & msg_valid[:, None, :]).ravel()
+    sus_g = jnp.where(
+        key_rank(val_g) == RANK_SUSPECT, key_inc(val_g), -1
+    )
+
+    spend = jnp.where(msg_valid, F, 0)
+    tx = jnp.maximum(
+        tx.at[jnp.repeat(rows, M), sslot.ravel()].add(-spend.ravel()), 0
+    )
+
+    # -- 2. push/pull ---------------------------------------------------
+    alloc_g = jnp.ones(recv_g.shape, bool)
+    arrs = [(recv_g, subj_g, val_g, sus_g, ok_g, alloc_g)]
+    if base.push_pull_enabled:
+        dead_cnt = jnp.sum(
+            occupied & (key_rank(key_m) > RANK_SUSPECT), axis=1
+        )
+        known_cnt = n - dead_cnt  # absent slots default to alive
+        needs_join = participates & (known_cnt <= 1)
+        initiate = participates & (
+            needs_join
+            | bernoulli_mask(k_pp, (n,), 1.0 / base.push_pull_ticks)
+        )
+        partner = sample_probe_targets(k_ppsel, n)
+        pp_ok = initiate & participates[partner]
+        # Pull: partner's occupied slots flow to the initiator...
+        recv_pull = jnp.repeat(rows, K)
+        subj_pull = slot_subj[partner].ravel()
+        val_pull = key_m[partner].ravel()
+        ok_pull = jnp.repeat(pp_ok, K) & (subj_pull >= 0)
+        # ...push: the initiator's slots flow to the partner.
+        recv_push = jnp.repeat(partner, K)
+        subj_push = slot_subj.ravel()
+        val_push = key_m.ravel()
+        ok_push = jnp.repeat(pp_ok, K) & (subj_push >= 0)
+        minus1 = jnp.full(recv_pull.shape, -1, jnp.int32)
+        # Push/pull rows holding settled alive@inc values merge into
+        # EXISTING slots but never allocate: reintroducing a remembered
+        # incarnation into a row that evicted it would re-arm a full
+        # retransmit budget and amplify forever (the evict→relearn
+        # loop).  Suspect/dead/left pp news stays allocation-worthy —
+        # that's the anti-entropy backstop for detection.
+        alloc_pull = key_rank(val_pull) >= RANK_SUSPECT
+        alloc_push = key_rank(val_push) >= RANK_SUSPECT
+        arrs.append((recv_pull, subj_pull, val_pull, minus1, ok_pull,
+                     alloc_pull))
+        arrs.append((recv_push, subj_push, val_push, minus1, ok_push,
+                     alloc_push))
+
+    recv = jnp.concatenate([a[0] for a in arrs])
+    subj = jnp.concatenate([a[1] for a in arrs])
+    val = jnp.concatenate([a[2] for a in arrs])
+    sus = jnp.concatenate([a[3] for a in arrs])
+    ok = jnp.concatenate([a[4] for a in arrs])
+    alloc = jnp.concatenate([a[5] for a in arrs])
+
+    slots_t, key_rx, sus_rx, overflow, forgotten = _merge_arrivals(
+        (slot_subj, key_m, suspect_since, confirms, tx),
+        recv, subj, val, sus, ok, alloc, n, K, P,
+        overflow, state.forgotten,
+    )
+    slot_subj, key_m, suspect_since, confirms, tx = slots_t
+
+    # -- 3. refutation --------------------------------------------------
+    self_rx = key_rx[rows, self_slot]
+    accused = jnp.where(
+        key_rank(self_rx) >= RANK_SUSPECT, key_inc(self_rx), -1
+    )
+    refuting = participates & ~leaving & (accused >= own_inc)
+    own_inc = jnp.where(refuting, accused + 1, own_inc)
+    awareness = jnp.clip(
+        awareness + refuting.astype(jnp.int32),
+        0, base.profile.awareness_max_multiplier - 1,
+    )
+    key_rx = key_rx.at[rows, self_slot].set(-1)
+    self_key = jnp.where(
+        leaving, make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE)
+    )
+    key_after_refute = key_m.at[rows, self_slot].max(self_key)
+    tx = tx.at[rows, self_slot].set(
+        jnp.where(refuting, base.tx_limit, tx[rows, self_slot])
+    )
+
+    # -- 4. merge -------------------------------------------------------
+    old_key = key_after_refute
+    new_key = jnp.maximum(old_key, key_rx)
+    changed = new_key > old_key
+    fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
+    suspect_since = jnp.where(
+        fresh_suspect, t, jnp.where(changed, NEVER, suspect_since)
+    )
+    confirming = (
+        ~changed
+        & (key_rank(old_key) == RANK_SUSPECT)
+        & (sus_rx >= key_inc(old_key))
+    )
+    new_confirms = jnp.minimum(
+        confirms + confirming.astype(jnp.int32), base.confirmations_k
+    )
+    gained_conf = confirming & (new_confirms > confirms)
+    confirms = jnp.where(changed, 0, new_confirms)
+    tx = jnp.where(changed | gained_conf, base.tx_limit, tx)
+    key_m = new_key
+
+    # -- 5. probes ------------------------------------------------------
+    if base.probe_enabled:
+        is_probe_tick = (t % base.probe_interval_ticks) == 0
+        ptarget = sample_probe_targets(k_probe, n)
+        pt_view = _view_of(slot_subj, key_m, rows, ptarget)
+        probing = (
+            is_probe_tick
+            & participates
+            & (key_rank(pt_view) <= RANK_SUSPECT)
+        )
+        target_up = participates[ptarget]
+        p_fail = jnp.where(
+            target_up, jnp.float32(base.probe_fail_prob_alive), 1.0
+        )
+        failed = probing & bernoulli_mask(k_pfail, (n,), p_fail)
+        can_pend = failed & (state.probe_pending_at == NEVER)
+        matures_at = (
+            t + base.probe_interval_ticks
+            + awareness * base.probe_timeout_ticks
+        )
+        awareness = jnp.clip(
+            awareness + failed.astype(jnp.int32)
+            - (probing & ~failed).astype(jnp.int32),
+            0, base.profile.awareness_max_multiplier - 1,
+        )
+        probe_pending_at = jnp.where(
+            can_pend, matures_at, state.probe_pending_at
+        )
+        probe_subject = jnp.where(can_pend, ptarget, state.probe_subject)
+
+        mature = (probe_pending_at <= t) & participates
+        # Locate (or allocate) the matured subject's slot.
+        mslot = _locate_rows(slot_subj, rows, probe_subject)
+        if K < n:
+            # One allocation per maturing probe with no slot, claimed
+            # the same way arrivals claim.
+            need = mature & (mslot < 0)
+            slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
+            slots_p, can, choice, forgot = _claim_slot(
+                slots_p, settled_of(slots_p), need, probe_subject, n, K,
+            )
+            slot_subj, key_m, suspect_since, confirms, tx = slots_p
+            forgotten = forgotten + forgot
+            overflow = overflow + jnp.sum((need & ~can).astype(jnp.int32))
+            mslot = jnp.where(can, choice, mslot)
+        mview = jnp.where(
+            mslot >= 0, key_m[rows, jnp.maximum(mslot, 0)], DEFAULT_KEY
+        )
+        apply_sus = mature & (mslot >= 0) & (
+            key_rank(mview) == RANK_ALIVE
+        )
+        sus_key = make_key(key_inc(mview), RANK_SUSPECT)
+        scol = jnp.where(apply_sus, mslot, K)
+        key_m = key_m.at[rows, scol].set(
+            jnp.where(apply_sus, sus_key, 0), mode="drop"
+        )
+        suspect_since = suspect_since.at[rows, scol].set(
+            jnp.where(apply_sus, t, 0), mode="drop"
+        )
+        confirms = confirms.at[rows, scol].set(0, mode="drop")
+        tx = tx.at[rows, scol].set(base.tx_limit, mode="drop")
+        probe_pending_at = jnp.where(mature, NEVER, probe_pending_at)
+    else:
+        probe_pending_at = state.probe_pending_at
+        probe_subject = state.probe_subject
+
+    # -- 6. suspicion expiry --------------------------------------------
+    timeout = _lifeguard_timeout_ticks(base, confirms)
+    elapsed = (t - suspect_since).astype(jnp.float32)
+    expire = (
+        (key_rank(key_m) == RANK_SUSPECT)
+        & (suspect_since != NEVER)
+        & (elapsed >= timeout)
+        & participates[:, None]
+    )
+    key_m = jnp.where(expire, make_key(key_inc(key_m), RANK_DEAD), key_m)
+    suspect_since = jnp.where(expire, NEVER, suspect_since)
+    tx = jnp.where(expire, base.tx_limit, tx)
+
+    return SparseMembershipState(
+        slot_subj=slot_subj,
+        key=key_m,
+        suspect_since=suspect_since,
+        confirms=confirms,
+        tx=tx,
+        own_inc=own_inc,
+        awareness=awareness,
+        probe_pending_at=probe_pending_at,
+        probe_subject=probe_subject,
+        overflow=overflow,
+        forgotten=forgotten,
+        tick=t + 1,
+    )
+
+
+def densify(state: SparseMembershipState, n: int):
+    """Expand slots to the dense [n, n] arrays (parity checks)."""
+    K = state.key.shape[1]
+    key = jnp.full((n, n), DEFAULT_KEY, jnp.int32)
+    since = jnp.full((n, n), NEVER, jnp.int32)
+    conf = jnp.zeros((n, n), jnp.int32)
+    tx = jnp.zeros((n, n), jnp.int32)
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), K)
+    cols = state.slot_subj.ravel()
+    okc = jnp.where(cols >= 0, cols, n)
+    flat = jnp.where(cols >= 0, rows * n + okc, n * n)
+    key = key.ravel().at[flat].set(state.key.ravel(), mode="drop").reshape(n, n)
+    since = since.ravel().at[flat].set(
+        state.suspect_since.ravel(), mode="drop").reshape(n, n)
+    conf = conf.ravel().at[flat].set(
+        state.confirms.ravel(), mode="drop").reshape(n, n)
+    tx = tx.ravel().at[flat].set(state.tx.ravel(), mode="drop").reshape(n, n)
+    return key, since, conf, tx

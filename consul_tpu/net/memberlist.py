@@ -16,10 +16,13 @@ Reference call stacks mirrored here (SURVEY.md §3.1-3.2):
   leave-vs-die      dead msg with From == the node itself means an
                     intentional leave (state.go deadNode -> StateLeft)
 
-Deliberate v0 deviations (gated, not silently dropped): no AES-GCM
-encryption, no LZW compression, no CRC (wire enum slots reserved in
-wire.py); probe ring is a fresh shuffle each wrap rather than an
-incremental shuffle.
+AES-GCM gossip encryption with a multi-key keyring is enforced at the
+packet layer (``net/security.py``; install/use/remove via the keyring
+RPCs) — when a keyring is configured, plaintext and undecryptable
+packets are dropped (see ``_handle_packet``).  Remaining deliberate
+deviations (gated, not silently dropped): no LZW compression, no CRC
+(wire enum slots reserved in wire.py); probe ring is a fresh shuffle
+each wrap rather than an incremental shuffle.
 """
 
 from __future__ import annotations

@@ -2,6 +2,8 @@
 
 from consul_tpu.sim.engine import (
     membership_scan,
+    run_membership_sparse,
+    sparse_membership_scan,
     multidc_scan,
     run_broadcast,
     run_membership,
@@ -21,6 +23,8 @@ from consul_tpu.sim.scenarios import SCENARIOS, run_scenario
 
 __all__ = [
     "membership_scan",
+    "run_membership_sparse",
+    "sparse_membership_scan",
     "run_membership",
     "MembershipReport",
     "run_broadcast",

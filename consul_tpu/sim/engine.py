@@ -234,6 +234,88 @@ def run_membership(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"))
+def sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
+                           track: tuple = ()):
+    """Sparse-model twin of :func:`membership_scan`: per tracked subject
+    j, how many observers hold a SUSPECT / DEAD slot for j, plus the
+    global suspect-slot count and mean known-membership size."""
+    from consul_tpu.models.membership_sparse import sparse_membership_round
+    from consul_tpu.models.membership import RANK_SUSPECT as _SUS
+    from consul_tpu.models.membership import RANK_DEAD as _DEAD
+
+    track_idx = jnp.asarray(track, jnp.int32) if track else jnp.zeros(
+        (0,), jnp.int32
+    )
+
+    def tick(carry, k):
+        nxt = sparse_membership_round(carry, k, cfg)
+        ranks = key_rank(nxt.key)
+        if track:
+            # [n, K] slots vs tracked ids → per-subject observer counts.
+            hit = nxt.slot_subj[:, :, None] == track_idx[None, None, :]
+            sus_t = jnp.sum(
+                hit & (ranks == _SUS)[:, :, None], axis=(0, 1),
+                dtype=jnp.int32,
+            )
+            dead_t = jnp.sum(
+                hit & (ranks == _DEAD)[:, :, None], axis=(0, 1),
+                dtype=jnp.int32,
+            )
+        else:
+            sus_t = jnp.zeros((0,), jnp.int32)
+            dead_t = jnp.zeros((0,), jnp.int32)
+        occupied = nxt.slot_subj >= 0
+        dead_cells = jnp.sum(
+            occupied & (ranks > _SUS), dtype=jnp.float32
+        )
+        out = (
+            sus_t,
+            dead_t,
+            jnp.sum(occupied & (ranks == _SUS), dtype=jnp.int32),
+            # Absent slots default to known-alive; n² overflows int32 at
+            # the scales this model exists for, so the membership-size
+            # sum rides float32 (a gauge, not an exact count).
+            jnp.float32(cfg.base.n) * cfg.base.n - dead_cells,
+        )
+        return nxt, out
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+def run_membership_sparse(
+    cfg,
+    steps: int,
+    seed: int = 0,
+    track: tuple = (),
+    warmup: bool = True,
+):
+    """Top-K sparse membership study (models/membership_sparse.py): the
+    n ≥ 10⁵ regime the dense model's O(N²) state cannot reach."""
+    from consul_tpu.models.membership_sparse import sparse_membership_init
+    from consul_tpu.sim.metrics import MembershipReport
+
+    key = jax.random.PRNGKey(seed)
+    scan = functools.partial(sparse_membership_scan, track=tuple(track))
+    final, (sus, dead, sus_cells, known), wall = _timed(
+        lambda: sparse_membership_init(cfg), scan, key, cfg, steps, warmup
+    )
+    report = MembershipReport(
+        n=cfg.base.n,
+        ticks=steps,
+        tick_ms=cfg.base.profile.gossip_interval_ms,
+        probe_interval_ms=cfg.base.profile.probe_interval_ms,
+        track=tuple(track),
+        suspecting=sus,
+        dead_known=dead,
+        suspect_cells=sus_cells,
+        known_members=known,
+        wall_s=wall,
+    )
+    return report, int(np.asarray(final.overflow))
+
+
 def run_swim(
     cfg: SwimConfig,
     steps: int,

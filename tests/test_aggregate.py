@@ -62,3 +62,81 @@ def test_aggregate_total_loss_never_spreads():
     cfg = BroadcastConfig(n=256, loss=1.0, delivery="aggregate")
     r = run_broadcast(cfg, steps=10, seed=0, warmup=False)
     assert r.infected[-1] == 1
+
+
+# ---------------------------------------------------------------------------
+# Quantile-band error bars at scale (VERDICT r4 weak #2): the headline's
+# aggregate mode must track the exact edges path with a MEASURED
+# distributional bound at n = 10^4..10^5, not just mean agreement at
+# n = 4096.
+#
+# Statistic: time-to-fraction quantiles of the infection/detection CDF.
+# A raw KS distance between mean curves is dominated by epidemic takeoff
+# jitter — the knee covers ~80% of the population in two ticks, so a
+# half-tick seed-to-seed offset reads as KS ~ 0.14 even for two runs of
+# the SAME model.  Convergence TIMES are what BASELINE.json's 5% clause
+# binds, and they are stable: we assert every quantile's mean
+# time-to-fraction agrees within max(1 tick, 5%) — one tick being the
+# simulation's resolution floor.
+# ---------------------------------------------------------------------------
+
+REL_BOUND = 0.05  # BASELINE.json acceptance clause
+ABS_FLOOR = 1.0   # one gossip tick: the discretization floor
+
+
+def _tq(reports, frac, denom, attr="infected"):
+    ts = [time_to_fraction(np.asarray(getattr(r, attr)), denom, frac)
+          for r in reports]
+    assert all(t is not None for t in ts), f"no run reached {frac}"
+    return float(np.mean(ts))
+
+
+def _assert_quantile_band(r_e, r_a, denom, fracs, attr="infected"):
+    for frac in fracs:
+        te = _tq(r_e, frac, denom, attr)
+        ta = _tq(r_a, frac, denom, attr)
+        bound = max(ABS_FLOOR, REL_BOUND * te)
+        assert abs(te - ta) <= bound, (
+            f"t{int(frac * 100)}: edges {te:.2f} vs aggregate {ta:.2f} "
+            f"ticks — gap {abs(te - ta):.2f} > bound {bound:.2f}"
+        )
+
+
+def test_broadcast_quantile_band_at_10k():
+    n = 10_000
+    cfg_e = BroadcastConfig(n=n, fanout=4, loss=0.2, delivery="edges")
+    cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")
+    r_e = [run_broadcast(cfg_e, steps=50, seed=s, warmup=False)
+           for s in range(5)]
+    r_a = [run_broadcast(cfg_a, steps=50, seed=s, warmup=False)
+           for s in range(5)]
+    _assert_quantile_band(r_e, r_a, n, (0.25, 0.5, 0.9, 0.99))
+
+
+def test_broadcast_quantile_band_at_100k():
+    """The 10^5 regime the headline banks on."""
+    n = 100_000
+    cfg_e = BroadcastConfig(n=n, fanout=4, loss=0.2, delivery="edges")
+    cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")
+    r_e = [run_broadcast(cfg_e, steps=50, seed=s, warmup=False)
+           for s in range(3)]
+    r_a = [run_broadcast(cfg_a, steps=50, seed=s, warmup=False)
+           for s in range(3)]
+    _assert_quantile_band(r_e, r_a, n, (0.25, 0.5, 0.9, 0.99))
+
+
+def test_swim_detection_quantile_band_at_10k():
+    """Death-propagation CDF across observers, edges vs aggregate, at
+    the scale band the VERDICT asked for.  Detection horizons are
+    O(100) ticks here, so the 5% relative clause (not the 1-tick floor)
+    is the operative bound."""
+    n = 10_000
+    cfg_e = SwimConfig(n=n, subject=3, loss=0.2, delivery="edges")
+    cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")
+    r_e = [run_swim(cfg_e, steps=220, seed=s, warmup=False) for s in SEEDS]
+    r_a = [run_swim(cfg_a, steps=220, seed=s, warmup=False) for s in SEEDS]
+    _assert_quantile_band(r_e, r_a, n - 1, (0.5, 0.9, 0.99),
+                          attr="dead_known")
+    # Both modes fully converge (a vacuously-passing flat curve can't).
+    assert np.asarray(r_e[0].dead_known)[-1] > 0.95 * (n - 1)
+    assert np.asarray(r_a[0].dead_known)[-1] > 0.95 * (n - 1)

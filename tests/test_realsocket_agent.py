@@ -1,0 +1,212 @@
+"""Tier-4 black-box: full agents over REAL sockets.
+
+The reference's sdk/testutil/server.go:205-264 boots real consul
+binaries and drives them over localhost; this is the same level for the
+framework — server + client agents with UDP gossip/RPC transports on
+real ports, a real HTTP server, and a real DNS socket.  Everything the
+in-memory suites prove must also hold when actual packets move.
+"""
+
+import asyncio
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.agent.agent import Agent, AgentConfig
+from consul_tpu.agent.dns import DNSServer
+from consul_tpu.agent.http import HTTPApi
+from consul_tpu.net.transport import UDPTransport
+
+from test_http_dns import dns_query, http_call
+
+
+async def _real_agent(name, server=True, bootstrap_expect=1):
+    gossip = UDPTransport("127.0.0.1", 0)
+    rpc = UDPTransport("127.0.0.1", 0)
+    await gossip.start()
+    await rpc.start()
+    agent = Agent(
+        AgentConfig(node_name=name, server=server,
+                    bootstrap_expect=bootstrap_expect,
+                    gossip_interval_scale=0.05, sync_interval_s=0.3,
+                    sync_retry_interval_s=0.2, reconcile_interval_s=0.2),
+        gossip_transport=gossip,
+        rpc_transport=rpc,
+    )
+    await agent.start()
+    return agent, gossip.local_addr()
+
+
+class TestRealSocketCluster:
+    async def test_join_kv_dns_over_real_sockets(self):
+        s1, s1_addr = await _real_agent("rs-server", server=True)
+        c1, _ = await _real_agent("rs-client", server=False)
+        api = None
+        dns = None
+        try:
+            await wait_until(lambda: s1.delegate.is_leader(),
+                             msg="server elected itself")
+            # Client joins over real UDP gossip.
+            assert await c1.join([s1_addr]) == 1
+            await wait_until(
+                lambda: set(s1.serf.members) >= {"rs-server", "rs-client"},
+                msg="gossip converged over real sockets",
+            )
+            await wait_until(lambda: c1.delegate.routers.servers(),
+                             msg="client discovered the server")
+
+            # HTTP against the CLIENT agent: the KV write crosses the
+            # real RPC socket to the server's raft.
+            api = HTTPApi(c1)
+            addr = await api.start()
+            st, _, ok = await http_call(addr, "PUT", "/v1/kv/rs/x", b"v1")
+            assert st == 200 and ok is True
+            assert s1.delegate.store.kv_get("rs/x")[1]["value"] == b"v1"
+            st, _, rows = await http_call(addr, "GET", "/v1/kv/rs/x")
+            assert st == 200 and rows[0]["Key"] == "rs/x"
+
+            # Service registration syncs through anti-entropy, then
+            # resolves over a real DNS socket.
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/agent/service/register",
+                b'{"Name": "web", "Port": 8080}')
+            assert st == 200
+            await wait_until(
+                lambda: s1.delegate.store.service_nodes("web")[1],
+                msg="service synced to the catalog",
+            )
+            dns = DNSServer(c1)
+            dns_addr = await dns.start()
+            _, rcode, answers = await dns_query(
+                dns_addr, "web.service.consul")
+            assert rcode == 0 and answers
+        finally:
+            if dns:
+                await dns.stop()
+            if api:
+                await api.stop()
+            await c1.shutdown()
+            await s1.shutdown()
+
+
+class TestMaintenanceMode:
+    async def test_service_and_node_maintenance(self):
+        from test_http_dns import dev_stack
+
+        async with dev_stack() as (agent, addr, _dns, dns_addr):
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/agent/service/register",
+                b'{"Name": "web", "Port": 8080}')
+            assert st == 200
+            await wait_until(
+                lambda: agent.delegate.store.check_service_nodes(
+                    "web", passing_only=True)[1],
+                msg="service passing",
+            )
+            # Enable service maintenance: a critical synthetic check
+            # pulls it from passing-only discovery (agent.go:3411).
+            st, _, ok = await http_call(
+                addr, "PUT",
+                "/v1/agent/service/maintenance/web?enable=true"
+                "&reason=redeploy")
+            assert st == 200 and ok is True
+            await wait_until(
+                lambda: not agent.delegate.store.check_service_nodes(
+                    "web", passing_only=True)[1],
+                msg="maintenance hides the service",
+            )
+            # The synthetic check carries the reason.
+            st, _, checks = await http_call(addr, "GET", "/v1/agent/checks")
+            mcheck = checks.get("_service_maintenance:web")
+            assert mcheck and "redeploy" in mcheck["Notes"]
+            # Disable restores discovery.
+            st, _, _x = await http_call(
+                addr, "PUT",
+                "/v1/agent/service/maintenance/web?enable=false")
+            assert st == 200
+            await wait_until(
+                lambda: agent.delegate.store.check_service_nodes(
+                    "web", passing_only=True)[1],
+                msg="service visible again",
+            )
+            # Node-wide maintenance.
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/agent/maintenance?enable=true")
+            assert st == 200
+            assert agent.in_node_maintenance()
+            await wait_until(
+                lambda: not agent.delegate.store.check_service_nodes(
+                    "web", passing_only=True)[1],
+                msg="node maintenance hides every service",
+            )
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/agent/maintenance?enable=false")
+            assert st == 200
+            assert not agent.in_node_maintenance()
+            # Bad query param is a 400.
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/agent/maintenance")
+            assert st == 400
+            # Unknown service id is a 404.
+            st, _, _x = await http_call(
+                addr, "PUT",
+                "/v1/agent/service/maintenance/ghost?enable=true")
+            assert st == 404
+
+
+class TestNewWatchTypes:
+    async def test_connect_roots_leaf_and_agent_service_watches(self):
+        from test_http_dns import dev_stack
+
+        from consul_tpu.api import ConsulClient, parse_watch
+
+        async with dev_stack() as (agent, addr, _dns, _dns_addr):
+            st, _, _x = await http_call(
+                addr, "PUT", "/v1/agent/service/register",
+                b'{"Name": "web", "Port": 8080}')
+            assert st == 200
+            c = ConsulClient(addr)
+            # Prime the CA (it initializes lazily on first sign) so the
+            # roots watch has something to deliver.
+            st, _, leaf0 = await http_call(
+                addr, "GET", "/v1/agent/connect/ca/leaf/web")
+            assert st == 200 and leaf0["CertPEM"]
+            seen = {"roots": [], "leaf": [], "svc": []}
+
+            plans = []
+            for wtype, params, bucket in (
+                ("connect_roots", {}, "roots"),
+                ("connect_leaf", {"service": "web"}, "leaf"),
+                ("agent_service", {"service_id": "web"}, "svc"),
+            ):
+                plan = parse_watch({"type": wtype, **params}, c)
+                plan.on_change(
+                    lambda idx, data, b=bucket: seen[b].append(data))
+                plan.start()
+                plans.append(plan)
+            try:
+                await wait_until(
+                    lambda: (seen["roots"]
+                             and seen["roots"][-1]["Roots"]
+                             and seen["leaf"] and seen["svc"]),
+                    timeout=15, msg="all three watches fired",
+                )
+            finally:
+                for plan in plans:
+                    plan.stop()
+            assert seen["roots"][-1]["Roots"][0]["RootCert"]
+            assert seen["leaf"][0]["CertPEM"]
+            assert seen["svc"][0]["Service"] == "web"
+            # The cached leaf is STABLE: the watch must not refire with
+            # a fresh signature every poll.
+            assert len(seen["leaf"]) == 1
+
+
+def test_unknown_watch_type_rejected():
+    from consul_tpu.api import parse_watch
+
+    with pytest.raises(ValueError, match="unknown watch type"):
+        parse_watch({"type": "nope"}, None)
+    with pytest.raises(ValueError, match="requires"):
+        parse_watch({"type": "agent_service"}, None)
