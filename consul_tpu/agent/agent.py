@@ -73,6 +73,15 @@ class AgentConfig:
     # fetch an agent-kind SPIFFE leaf + CA roots from the servers at
     # startup.
     auto_encrypt: bool = False
+    # Full auto-config bootstrap (agent/auto-config/config.go +
+    # consul/auto_config_endpoint.go): a CLIENT with only a server RPC
+    # address and a JWT intro token fetches its whole runtime (gossip
+    # keys, agent token, TLS identity, cluster settings) before joining.
+    auto_config_enabled: bool = False
+    auto_config_intro_token: str = ""
+    auto_config_server_addresses: tuple = ()
+    # Server side: the JWT authorizer spec (ServerConfig field).
+    auto_config_authorizer: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -121,6 +130,7 @@ class Agent:
                     keyring=self.keyring,
                     primary_datacenter=config.primary_datacenter,
                     acl_replication_token=config.acl_replication_token,
+                    auto_config_authorizer=config.auto_config_authorizer,
                 ),
                 gossip_transport,
                 rpc_transport,
@@ -241,6 +251,11 @@ class Agent:
         return await self.cache.get(cache_type, body)
 
     async def start(self) -> None:
+        if self.config.auto_config_enabled and not self.is_server():
+            # agent/auto-config/auto_config.go InitialConfiguration:
+            # runs BEFORE gossip starts — the response carries the
+            # gossip encryption keys the join itself needs.
+            await self._auto_config_bootstrap()
         await self.delegate.start()
         self.syncer.start()
         # TLS identity: servers mint theirs locally; clients ask the
@@ -250,6 +265,83 @@ class Agent:
             self._auto_encrypt_task = asyncio.create_task(
                 self._auto_encrypt_loop()
             )
+
+    async def _auto_config_bootstrap(self) -> None:
+        """Fetch and APPLY the initial configuration from a configured
+        server address, retrying across addresses with backoff (the
+        reference persists the response; here it is applied live)."""
+        from consul_tpu.agent.rpc import RPCError
+
+        addrs = list(self.config.auto_config_server_addresses)
+        if not addrs:
+            raise ValueError(
+                "auto_config requires auto_config_server_addresses"
+            )
+        backoff = 0.2
+        while True:
+            last: Exception = RPCError("no auto-config server reachable")
+            for addr in addrs:
+                try:
+                    out = await self.delegate.rpc_client.call(
+                        addr, "AutoConfig.InitialConfiguration",
+                        {"node": self.config.node_name,
+                         "jwt": self.config.auto_config_intro_token},
+                    )
+                    self._apply_auto_config(out)
+                    return
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — next addr/retry
+                    # A denial from ANY address means the intro token is
+                    # bad — that never heals by retrying (a later
+                    # unreachable address must not mask it).
+                    if isinstance(e, RPCError) and \
+                            "Permission denied" in str(e):
+                        raise
+                    last = e
+            log.warning("auto-config bootstrap failed (%s); retrying", last)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
+    def _apply_auto_config(self, out: dict) -> None:
+        cfg = out.get("config") or {}
+        # Gossip encryption: install the keys into the delegate's
+        # memberlist config before serf starts.
+        keys = out.get("gossip_keys") or []
+        if keys:
+            from consul_tpu.net.security import Keyring
+
+            keyring = Keyring.from_b64(keys[0])
+            for extra in keys[1:]:
+                keyring.install(extra)
+            self.keyring = keyring
+            self.delegate.serf.memberlist.config.keyring = keyring
+        # ACL agent token for anti-entropy + agent-plane RPCs.
+        token = ((cfg.get("acl") or {}).get("tokens") or {}).get("agent")
+        if token:
+            self.config.acl_agent_token = token
+        # TLS identity (the auto-encrypt shape).
+        if out.get("tls"):
+            self.tls_identity = out["tls"]
+        # Datacenter: the delegate, its serf 'dc' tag, and the server
+        # manager were all constructed with the pre-bootstrap value —
+        # re-point ALL of them (a dc applied only to AgentConfig would
+        # leave ServerManager filtering on the wrong tag and the client
+        # unable to find any server).
+        dc = cfg.get("datacenter", self.config.datacenter)
+        if dc != self.config.datacenter:
+            self.config.datacenter = dc
+            self.delegate.config.datacenter = dc
+            self.delegate.routers.datacenter = dc
+            self.delegate.serf.config.tags["dc"] = dc
+        self.config.primary_datacenter = cfg.get(
+            "primary_datacenter", self.config.primary_datacenter)
+        log.info(
+            "auto-config: applied initial configuration "
+            "(%d gossip key(s), token=%s, tls=%s)",
+            len(keys), "yes" if token else "no",
+            "yes" if out.get("tls") else "no",
+        )
 
     async def _auto_encrypt_loop(self) -> None:
         """Fetch, then RENEW: retry with backoff until the servers

@@ -92,6 +92,12 @@ class RuntimeConfig:
     dns_node_ttl_s: float = 0.0
     # Upstream resolvers for non-.consul names (config "recursors").
     dns_recursors: tuple = ()
+    # auto_config (agent/auto-config/config.go): client bootstrap via a
+    # JWT intro token; servers hold the authorizer spec.
+    auto_config_enabled: bool = False
+    auto_config_intro_token: str = ""
+    auto_config_server_addresses: tuple = ()
+    auto_config_authorizer: object = None
     reconcile_interval_s: float = 60.0
     sync_interval_s: float = 60.0
     gossip_interval_scale: float = 1.0
@@ -241,6 +247,12 @@ _BLOCKS = {
         "node_ttl_s": "dns_node_ttl_s",
         "recursors": "dns_recursors",
     },
+    "auto_config": {
+        "enabled": "auto_config_enabled",
+        "intro_token": "auto_config_intro_token",
+        "server_addresses": "auto_config_server_addresses",
+        "authorization": "auto_config_authorizer",
+    },
     "ports": {
         "http": "ports_http",
         "dns": "ports_dns",
@@ -270,7 +282,11 @@ def _flatten(raw: dict, source: str) -> dict:
                 raise ConfigError(f"{source}: {key} must be a block")
             mapping = _BLOCKS[key]
             for sub, subval in value.items():
-                if isinstance(subval, dict):
+                if sub in mapping:
+                    # Direct mapping wins — a dict value here is the
+                    # field's value wholesale (auto_config.authorization).
+                    flat[mapping[sub]] = subval
+                elif isinstance(subval, dict):
                     for s2, v2 in subval.items():
                         field = mapping.get(f"{sub}.{s2}")
                         if field is None:
@@ -279,10 +295,7 @@ def _flatten(raw: dict, source: str) -> dict:
                             )
                         flat[field] = v2
                 else:
-                    field = mapping.get(sub)
-                    if field is None:
-                        raise ConfigError(f"{source}: unknown key {key}.{sub}")
-                    flat[field] = subval
+                    raise ConfigError(f"{source}: unknown key {key}.{sub}")
             continue
         if key in ("service", "check"):
             field = "services" if key == "service" else "checks"
@@ -348,11 +361,12 @@ class Builder:
                 merged[key] = tuple(
                     _freeze(v) for v in merged[key]
                 )
-        if "dns_recursors" in merged:
-            v = merged["dns_recursors"]
-            merged["dns_recursors"] = tuple(
-                v if isinstance(v, (list, tuple)) else [v]
-            )
+        for key in ("dns_recursors", "auto_config_server_addresses"):
+            if key in merged:
+                v = merged[key]
+                merged[key] = tuple(
+                    v if isinstance(v, (list, tuple)) else [v]
+                )
         rc = RuntimeConfig(**merged)
         _validate(rc)
         return rc

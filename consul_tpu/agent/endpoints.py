@@ -946,6 +946,126 @@ def _interpolate_bind_name(template: str, vars_: dict[str, str]) -> str:
     return _re.sub(r"\$\{([A-Za-z0-9_.]+)\}", sub, template)
 
 
+class AutoConfig(_Endpoint):
+    """consul/auto_config_endpoint.go InitialConfiguration: a brand-new
+    CLIENT with nothing but a server address and a JWT intro token
+    bootstraps its full runtime — gossip encryption keys, an ACL agent
+    token, its TLS identity, and cluster-level settings — in ONE RPC,
+    before it can join gossip or speak ACL'd RPCs."""
+
+    async def initial_configuration(self, body: dict):
+        fwd = await self.server.forward(
+            "AutoConfig.InitialConfiguration", body
+        )
+        if fwd is not None:
+            return fwd
+        authz = self.server.config.auto_config_authorizer
+        if not authz:
+            raise RPCError("auto-config is disabled on this server")
+        node = body.get("node", "")
+        if not node:
+            raise ValueError("auto-config request must name a node")
+        # The node name is caller-controlled AND interpolated into the
+        # claim-assertion selectors below — restrict it to the hostname
+        # alphabet so it can never smuggle bexpr syntax
+        # (auto_config_endpoint.go validates against InvalidDnsRe the
+        # same way).
+        import re as _re
+
+        if not _re.fullmatch(r"[A-Za-z0-9_.-]{1,128}", node):
+            raise ValueError(f"invalid node name {node!r}")
+        from consul_tpu.acl import jwt as jwt_mod
+
+        try:
+            claims = jwt_mod.validate(
+                body.get("jwt", ""),
+                secret=authz.get("jwt_secret", ""),
+                pub_keys=authz.get("jwt_validation_pub_keys") or [],
+                bound_issuer=authz.get("bound_issuer", ""),
+                bound_audiences=authz.get("bound_audiences") or [],
+                clock_skew_s=float(authz.get("clock_skew_s", 30.0)),
+            )
+        except jwt_mod.JWTError as e:
+            raise RPCError(ERR_PERMISSION_DENIED) from e
+        selectable, _projected = jwt_mod.identity_from_claims(
+            claims,
+            authz.get("claim_mappings") or {},
+            authz.get("list_claim_mappings") or {},
+        )
+        # auto_config_endpoint.go claim assertions: every configured
+        # selector must match the verified identity; @@node@@ stands in
+        # for the claimed node name (lib.InterpolateHIL equivalent).
+        from consul_tpu.agent.bexpr import FilterError, create_filter
+
+        for raw in authz.get("claim_assertions") or []:
+            selector = raw.replace("${node}", node)
+            try:
+                if not create_filter(selector).match(selectable):
+                    raise RPCError(ERR_PERMISSION_DENIED)
+            except FilterError as e:
+                raise RPCError(ERR_PERMISSION_DENIED) from e
+
+        cfg = self.server.config
+        out: dict = {
+            "config": {
+                "datacenter": cfg.datacenter,
+                "primary_datacenter": cfg.primary_datacenter
+                or cfg.datacenter,
+                "node_name": node,
+                "acl": {
+                    "enabled": cfg.acl_enabled,
+                    "default_policy": cfg.acl_default_policy,
+                },
+            },
+            # Primary key FIRST, then the rest of the ring — a client
+            # bootstrapping mid-rotation must decrypt traffic still
+            # using older keys.
+            "gossip_keys": (
+                [cfg.keyring.primary_b64()]
+                + [k for k in cfg.keyring.list_keys()
+                   if k != cfg.keyring.primary_b64()]
+                if cfg.keyring else []
+            ),
+        }
+        if cfg.acl_enabled:
+            # Mint (or REUSE) a node-identity agent token so
+            # anti-entropy and agent-plane RPCs work under enforcement
+            # (auto_config_endpoint.go updateTokenResponse persists and
+            # reuses) — a retrying or restarting client must not grow
+            # an orphaned token per call.
+            desc = f"auto-config token for node {node!r}"
+            _, tokens = self.server.store.acl_token_list()
+            existing = next(
+                (t for t in tokens
+                 if t.get("description") == desc
+                 and t.get("node_identities")), None,
+            )
+            if existing is not None:
+                secret = existing["secret_id"]
+            else:
+                token = {
+                    "secret_id": str(uuid.uuid4()),
+                    "accessor_id": str(uuid.uuid4()),
+                    "description": desc,
+                    "auth_method": "",
+                    "local": True,
+                    "node_identities": [
+                        {"node_name": node, "datacenter": cfg.datacenter}
+                    ],
+                }
+                await self.server.raft_apply(
+                    MessageType.ACL_TOKEN_SET, {"token": token}
+                )
+                secret = token["secret_id"]
+            out["config"]["acl"]["tokens"] = {"agent": secret}
+        # TLS identity, exactly the auto-encrypt shape.
+        ca = await self.server.connect_ca()
+        leaf = ca.sign_leaf(node, kind="agent")
+        _, roots = self.server.store.ca_roots()
+        out["tls"] = {"leaf": leaf, "roots": roots}
+        return out
+
+
 class ACL(_Endpoint):
     """acl_endpoint.go — token/policy CRUD + one-shot bootstrap.
 
@@ -1536,4 +1656,5 @@ def build_endpoints(server: "Server") -> dict[str, _Endpoint]:
         "Subscribe": Subscribe(server),
         "DiscoveryChain": DiscoveryChain(server),
         "FederationState": FederationState(server),
+        "AutoConfig": AutoConfig(server),
     }

@@ -117,6 +117,11 @@ class ServerConfig:
     # leader_federation_state_ae.go: cadence for publishing this DC's
     # mesh-gateway set to the primary.
     federation_state_ae_interval_s: float = 30.0
+    # auto_config_endpoint.go authorizer: when set, clients may
+    # bootstrap via AutoConfig.InitialConfiguration with a JWT matching
+    # this spec ({jwt_secret | jwt_validation_pub_keys, bound_issuer,
+    # bound_audiences, claim_mappings, claim_assertions}).
+    auto_config_authorizer: Optional[dict] = None
 
 
 class Server:

@@ -262,6 +262,11 @@ async def cmd_agent(args) -> int:
                 else ""
             ),
             rejoin_after_leave=rc.rejoin_after_leave,
+            auto_config_enabled=rc.auto_config_enabled,
+            auto_config_intro_token=rc.auto_config_intro_token,
+            auto_config_server_addresses=tuple(
+                rc.auto_config_server_addresses),
+            auto_config_authorizer=rc.auto_config_authorizer,
         ),
         gossip_transport=gossip,
         rpc_transport=rpc,

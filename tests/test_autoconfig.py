@@ -1,0 +1,259 @@
+"""Auto-config bootstrap: JWT intro token → full client runtime.
+
+Parity model: agent/consul/auto_config_endpoint.go
+(InitialConfiguration: JWT validation + claim assertions → cluster
+settings, gossip keys, ACL token, TLS identity) + agent/auto-config/
+(the client fetches BEFORE joining gossip, because the response carries
+the keys gossip needs).
+"""
+
+import asyncio
+
+import pytest
+
+from helpers import wait_for as wait_until
+
+from consul_tpu.acl.jwt import encode_hs256
+from consul_tpu.agent.agent import Agent, AgentConfig
+from consul_tpu.agent.rpc import RPCError
+from consul_tpu.net.security import generate_key
+from consul_tpu.net.transport import InMemoryNetwork
+
+SECRET = "introspectable"
+AUTHORIZER = {
+    "jwt_secret": SECRET,
+    "bound_issuer": "https://provisioner",
+    "claim_mappings": {"node": "node"},
+    # The claimed node name must match the JWT's node claim
+    # (auto_config_endpoint.go claim assertions with @@node@@).
+    "claim_assertions": ['value.node == "${node}"'],
+}
+
+
+def _server(net, encrypt=True, acl=True):
+    return Agent(
+        AgentConfig(
+            node_name="ac-server", bootstrap_expect=1,
+            gossip_interval_scale=0.05, sync_interval_s=0.3,
+            sync_retry_interval_s=0.2, reconcile_interval_s=0.2,
+            encrypt_key=generate_key() if encrypt else "",
+            acl_enabled=acl, acl_default_policy="deny",
+            acl_master_token="root",
+            auto_config_authorizer=AUTHORIZER,
+        ),
+        gossip_transport=net.new_transport("acs:gossip"),
+        rpc_transport=net.new_transport("acs:rpc"),
+    )
+
+
+def _client(net, name="ac-client", jwt=None):
+    return Agent(
+        AgentConfig(
+            node_name=name, server=False,
+            gossip_interval_scale=0.05, sync_interval_s=0.3,
+            sync_retry_interval_s=0.2,
+            auto_config_enabled=True,
+            auto_config_intro_token=jwt if jwt is not None else
+            encode_hs256({"iss": "https://provisioner", "node": name},
+                         SECRET),
+            auto_config_server_addresses=("acs:rpc",),
+        ),
+        gossip_transport=net.new_transport(f"{name}:gossip"),
+        rpc_transport=net.new_transport(f"{name}:rpc"),
+    )
+
+
+class TestAutoConfig:
+    async def test_jwt_boots_client_into_encrypted_acl_cluster(self):
+        net = InMemoryNetwork()
+        server = _server(net)
+        await server.start()
+        client = _client(net)
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="server leader")
+            # The client has ONLY a server address + JWT: start()
+            # performs the bootstrap before gossip.
+            await client.start()
+            # Gossip keys arrived → the ENCRYPTED join succeeds.
+            assert client.keyring is not None
+            assert await client.join(["acs:gossip"]) == 1
+            await wait_until(
+                lambda: "ac-client" in server.serf.members,
+                msg="client joined encrypted gossip",
+            )
+            # The minted agent token carries the client's node identity:
+            # node anti-entropy works under default-deny ACLs (service
+            # registration still needs its own service:write token —
+            # node identities deliberately grant only node:write +
+            # service:read, structs/acl.go ACLNodeIdentity).
+            assert client.config.acl_agent_token
+            authz = server.delegate.acl.resolve(
+                client.config.acl_agent_token)
+            assert authz.node_write("ac-client")
+            assert not authz.service_write("web")
+            await wait_until(lambda: client.delegate.routers.servers(),
+                             msg="client discovered server")
+            await wait_until(
+                lambda: server.delegate.store.node("ac-client")[1],
+                timeout=10, msg="node synced under ACL enforcement",
+            )
+            # TLS identity issued (the auto-encrypt shape).
+            assert client.tls_identity["leaf"]["cert_pem"]
+            assert client.tls_identity["roots"]
+        finally:
+            await client.shutdown()
+            await server.shutdown()
+
+    async def test_forged_jwt_is_refused(self):
+        net = InMemoryNetwork()
+        server = _server(net)
+        await server.start()
+        forged = encode_hs256(
+            {"iss": "https://provisioner", "node": "ac-client"}, "wrong")
+        client = _client(net, jwt=forged)
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="server leader")
+            with pytest.raises(RPCError, match="Permission denied"):
+                await client.start()
+        finally:
+            await client.shutdown()
+            await server.shutdown()
+
+    async def test_node_claim_assertion_enforced(self):
+        """A JWT minted for node A cannot bootstrap node B
+        (the ${node} claim assertion)."""
+        net = InMemoryNetwork()
+        server = _server(net)
+        await server.start()
+        stolen = encode_hs256(
+            {"iss": "https://provisioner", "node": "other-node"}, SECRET)
+        client = _client(net, jwt=stolen)
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="server leader")
+            with pytest.raises(RPCError, match="Permission denied"):
+                await client.start()
+        finally:
+            await client.shutdown()
+            await server.shutdown()
+
+    async def test_disabled_server_refuses(self):
+        net = InMemoryNetwork()
+        server = Agent(
+            AgentConfig(node_name="plain", bootstrap_expect=1,
+                        gossip_interval_scale=0.05,
+                        reconcile_interval_s=0.2),
+            gossip_transport=net.new_transport("acs:gossip"),
+            rpc_transport=net.new_transport("acs:rpc"),
+        )
+        await server.start()
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="leader")
+            out = server.delegate.rpc_server
+            with pytest.raises(Exception, match="disabled"):
+                await out.dispatch_local(
+                    "AutoConfig.InitialConfiguration",
+                    {"node": "x", "jwt": "y"})
+        finally:
+            await server.shutdown()
+
+
+class TestAutoConfigHardening:
+    async def test_bootstrap_repoints_datacenter(self):
+        """A client built with the default dc must follow the server's
+        dc after bootstrap — serf tag, router filter, and config all
+        re-point (otherwise ServerManager finds zero servers)."""
+        net = InMemoryNetwork()
+        server = Agent(
+            AgentConfig(node_name="east-server", datacenter="east",
+                        bootstrap_expect=1, gossip_interval_scale=0.05,
+                        reconcile_interval_s=0.2,
+                        auto_config_authorizer=AUTHORIZER),
+            gossip_transport=net.new_transport("acs:gossip"),
+            rpc_transport=net.new_transport("acs:rpc"),
+        )
+        await server.start()
+        client = _client(net)
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="leader")
+            await client.start()
+            assert client.config.datacenter == "east"
+            assert client.delegate.routers.datacenter == "east"
+            assert await client.join(["acs:gossip"]) == 1
+            await wait_until(lambda: client.delegate.routers.servers(),
+                             msg="client finds the east server")
+        finally:
+            await client.shutdown()
+            await server.shutdown()
+
+    async def test_token_mint_is_idempotent_per_node(self):
+        """Bootstrap retries must reuse the node's token, not mint a new
+        one per call (auto_config_endpoint.go updateTokenResponse)."""
+        net = InMemoryNetwork()
+        server = _server(net)
+        await server.start()
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="leader")
+            jwt = encode_hs256(
+                {"iss": "https://provisioner", "node": "n1"}, SECRET)
+            body = {"node": "n1", "jwt": jwt}
+            out1 = await server.delegate.rpc_server.dispatch_local(
+                "AutoConfig.InitialConfiguration", body)
+            out2 = await server.delegate.rpc_server.dispatch_local(
+                "AutoConfig.InitialConfiguration", body)
+            t1 = out1["config"]["acl"]["tokens"]["agent"]
+            t2 = out2["config"]["acl"]["tokens"]["agent"]
+            assert t1 == t2
+            _, tokens = server.delegate.store.acl_token_list()
+            autoconf = [t for t in tokens
+                        if "auto-config" in t.get("description", "")]
+            assert len(autoconf) == 1
+        finally:
+            await server.shutdown()
+
+    async def test_bexpr_injection_in_node_name_rejected(self):
+        """The node name interpolates into claim assertions — bexpr
+        metacharacters must be refused outright."""
+        net = InMemoryNetwork()
+        server = _server(net)
+        await server.start()
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="leader")
+            evil = 'x" or "1" == "1'
+            jwt = encode_hs256(
+                {"iss": "https://provisioner", "node": "a"}, SECRET)
+            with pytest.raises(Exception, match="invalid node name"):
+                await server.delegate.rpc_server.dispatch_local(
+                    "AutoConfig.InitialConfiguration",
+                    {"node": evil, "jwt": jwt})
+        finally:
+            await server.shutdown()
+
+    async def test_full_keyring_shipped(self):
+        """Mid-rotation bootstrap: the response carries the WHOLE ring
+        (primary first), or new nodes drop old-key traffic."""
+        net = InMemoryNetwork()
+        server = _server(net)
+        await server.start()
+        try:
+            await wait_until(lambda: server.delegate.is_leader(),
+                             msg="leader")
+            old = generate_key()
+            server.keyring.install(old)
+            jwt = encode_hs256(
+                {"iss": "https://provisioner", "node": "n2"}, SECRET)
+            out = await server.delegate.rpc_server.dispatch_local(
+                "AutoConfig.InitialConfiguration",
+                {"node": "n2", "jwt": jwt})
+            keys = out["gossip_keys"]
+            assert len(keys) == 2
+            assert keys[0] == server.keyring.primary_b64()
+            assert old in keys
+        finally:
+            await server.shutdown()
