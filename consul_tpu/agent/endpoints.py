@@ -711,7 +711,52 @@ class Operator(_Endpoint):
                     "leader": vid == raft.leader_id,
                     "voter": True,
                 })
+            for nid in raft.non_voters:
+                servers.append({
+                    "id": nid,
+                    "address": self.server._raft_peer_addr(nid) or "",
+                    "leader": False,
+                    "voter": False,
+                })
         return {"servers": servers, "index": raft.commit_index if raft else 0}
+
+    async def autopilot_get_configuration(self, body: dict):
+        """operator_autopilot_endpoint.go AutopilotGetConfiguration."""
+        self.server.acl_check(body, "operator", "", READ)
+        _, entry = self.server.store.config_entry_get(
+            "autopilot-config", "global")
+        cfg = self.server.config
+        defaults = {
+            "cleanup_dead_servers": cfg.autopilot_cleanup_dead_servers,
+            "last_contact_threshold_s": cfg.autopilot_grace_s,
+            "server_stabilization_time_s":
+                cfg.autopilot_server_stabilization_s,
+            "max_trailing_logs": cfg.autopilot_max_trailing_logs,
+        }
+        if entry:
+            defaults.update({
+                k: v for k, v in entry.items()
+                if k in defaults
+            })
+            defaults["modify_index"] = entry.get("modify_index", 0)
+        return {"config": defaults}
+
+    async def autopilot_set_configuration(self, body: dict):
+        """operator_autopilot_endpoint.go AutopilotSetConfiguration
+        (CAS supported via ?cas=<modify_index>)."""
+        self.server.acl_check(body, "operator", "", WRITE)
+        fwd = await self.server.forward(
+            "Operator.AutopilotSetConfiguration", body)
+        if fwd is not None:
+            return fwd
+        result = await self.server.raft_apply(
+            MessageType.AUTOPILOT,
+            {"config": body.get("config") or {},
+             "cas": bool(body.get("cas")),
+             "modify_index": int(body.get("modify_index", 0) or 0)},
+        )
+        self.server.apply_autopilot_overrides()
+        return {"result": result}
 
     async def raft_remove_peer_by_id(self, body: dict):
         self.server.acl_check(body, "operator", "", WRITE)
@@ -724,23 +769,42 @@ class Operator(_Endpoint):
         return {"removed": True}
 
     async def server_health(self, body: dict):
-        """Autopilot-style health summary from serf + raft liveness."""
-        members = self.server._server_members()
-        raft = self.server.raft
-        healthy = [
-            m.name for m in members if m.status.name == "ALIVE"
-        ]
+        """operator_autopilot_endpoint.go ServerHealth — the autopilot
+        health records (healthy flag, stable-since age, log index,
+        voter) plus the cluster roll-up.  On a non-leader the log-lag
+        component is unknown (match_index is leader state), so health
+        there reflects serf liveness only."""
+        srv = self.server
+        members = srv._server_members()
+        raft = srv.raft
+        # Refresh the records on read so a non-leader (or a quiet
+        # leader between autopilot passes) still answers accurately.
+        srv._autopilot_update_health()
+        now = time.monotonic()
+        servers = []
+        for m in members:
+            rec = srv._server_health.get(m.tags.get("id"), {})
+            servers.append({
+                "id": m.tags.get("id", ""),
+                "name": m.name,
+                "serf_status": m.status.name.lower(),
+                "healthy": bool(rec.get("healthy", False)),
+                "stable_since_s": round(
+                    now - rec["stable_since"], 3
+                ) if rec.get("stable_since") else 0.0,
+                "last_index": rec.get("last_index", 0),
+                "voter": raft is not None
+                and m.tags.get("id") in raft.voters,
+            })
+        healthy_voters = sum(
+            1 for s in servers if s["healthy"] and s["voter"]
+        )
         return {
-            "healthy": raft is not None and raft.leader_id is not None,
-            "servers": [
-                {
-                    "name": m.name,
-                    "serf_status": m.status.name.lower(),
-                    "voter": raft is not None and m.tags.get("id") in raft.voters,
-                }
-                for m in members
-            ],
-            "failure_tolerance": max(0, (len(healthy) - 1) // 2),
+            "healthy": all(s["healthy"] for s in servers) and bool(
+                raft is not None and raft.leader_id is not None
+            ),
+            "servers": servers,
+            "failure_tolerance": max(0, (healthy_voters - 1) // 2),
         }
 
 

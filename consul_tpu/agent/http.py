@@ -569,6 +569,13 @@ class HTTPApi:
           self.connect_proxy_xds)
         r("GET", r"/v1/agent/connect/proxy/(?P<pid>[^/?]+)",
           self.connect_proxy_config)
+        # autopilot (operator_autopilot_endpoint.go)
+        r("GET", r"/v1/operator/autopilot/configuration",
+          self.operator_autopilot_get)
+        r("PUT", r"/v1/operator/autopilot/configuration",
+          self.operator_autopilot_set)
+        r("GET", r"/v1/operator/autopilot/health",
+          self.operator_health)
         # keyring (operator_endpoint.go /v1/operator/keyring)
         r("GET", r"/v1/operator/keyring", self.keyring_list)
         r("POST", r"/v1/operator/keyring", self.keyring_install)
@@ -1723,6 +1730,21 @@ class HTTPApi:
     async def operator_health(self, req, m) -> HTTPResponse:
         out = await self.agent.rpc("Operator.ServerHealth", {})
         return HTTPResponse(200, out)
+
+    async def operator_autopilot_get(self, req, m) -> HTTPResponse:
+        out = await self.agent.rpc(
+            "Operator.AutopilotGetConfiguration",
+            dict(req.query_options()))
+        return HTTPResponse(200, out.get("config"))
+
+    async def operator_autopilot_set(self, req, m) -> HTTPResponse:
+        body = {"config": _decamelize(req.json()), **req.query_options()}
+        if "cas" in req.query:
+            body["cas"] = True
+            body["modify_index"] = int(req.query["cas"])
+        out = await self.agent.rpc(
+            "Operator.AutopilotSetConfiguration", body)
+        return HTTPResponse(200, bool(out.get("result", True)))
 
 
 _CAMEL_SPLIT = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")

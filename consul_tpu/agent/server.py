@@ -99,6 +99,12 @@ class ServerConfig:
     autopilot_cleanup_dead_servers: bool = True
     autopilot_interval_s: float = 10.0
     autopilot_grace_s: float = 10.0
+    # autopilot.go promoteStableServers: a staging (non-voter) server is
+    # promoted once continuously healthy for this long.
+    autopilot_server_stabilization_s: float = 10.0
+    # structs.AutopilotConfig MaxTrailingLogs: a server whose log trails
+    # the leader by more than this is unhealthy.
+    autopilot_max_trailing_logs: int = 250
     # Gossip encryption keyring (shared LAN/WAN, security.go).
     keyring: object = None
     # WAN replication (leader.go:834-979 + {acl,config}_replication.go):
@@ -236,6 +242,8 @@ class Server:
         self._coord_updates: dict[str, dict] = {}
         self._session_deadlines: dict[str, float] = {}
         self._tombstone_marks: list[tuple[float, int]] = []
+        # Autopilot server-health records (autopilot.go clusterHealth).
+        self._server_health: dict[str, dict] = {}
         self._shutdown = False
 
         # RPC endpoint services (server_oss.go:8-23).
@@ -670,8 +678,14 @@ class Server:
 
     async def _handle_alive_member(self, m: Member) -> None:
         if self._is_peer_server(m) and self.raft is not None:
-            if m.tags["id"] not in self.raft.voters:
-                await self.raft.add_voter(m.tags["id"])
+            sid = m.tags["id"]
+            if sid not in self.raft.voters and \
+                    sid not in self.raft.non_voters:
+                # New servers join as STAGING non-voters; autopilot
+                # promotes them once stable (leader.go joinConsulServer
+                # → AddNonvoter under raft protocol 3, then
+                # autopilot.promoteStableServers).
+                await self.raft.add_nonvoter(sid)
         if not self._member_needs_update(m, HEALTH_PASSING):
             return
         await self.raft_apply(
@@ -716,33 +730,129 @@ class Server:
         if node is not None:
             await self.raft_apply(MessageType.DEREGISTER, {"node": m.name})
 
-    async def _autopilot_loop(self) -> None:
-        """Autopilot CleanupDeadServers (autopilot.go:192 pruneDead
-        Servers): raft voters whose serf member has been FAILED past the
-        grace window are removed — but never more than (voters-1)//2 in
-        one pass, so a partition can't talk the leader into destroying
-        its own quorum (autopilot.go removalLimit)."""
-        if not self.config.autopilot_cleanup_dead_servers:
+    def apply_autopilot_overrides(self) -> None:
+        """Fold the replicated autopilot-config entry (Operator.
+        AutopilotSetConfiguration) over the static config defaults."""
+        _, entry = self.store.config_entry_get("autopilot-config", "global")
+        if not entry:
             return
+        mapping = {
+            "cleanup_dead_servers": "autopilot_cleanup_dead_servers",
+            "last_contact_threshold_s": "autopilot_grace_s",
+            "server_stabilization_time_s":
+                "autopilot_server_stabilization_s",
+            "max_trailing_logs": "autopilot_max_trailing_logs",
+        }
+        for key, field in mapping.items():
+            if key in entry:
+                setattr(self.config, field, entry[key])
+
+    def _autopilot_update_health(self) -> None:
+        """autopilot.go serverHealthLoop/updateClusterHealth: score each
+        peer server — serf-alive AND raft log within MaxTrailingLogs of
+        the leader — and track how long it has been CONTINUOUSLY
+        healthy (StableSince resets on any unhealthy observation)."""
+        raft = self.raft
+        is_leader = raft is not None and raft.is_leader()
+        now = time.monotonic()
+        seen = set()
+        for m in list(self.serf.members.values()):
+            if not self._is_peer_server(m):
+                continue
+            sid = m.tags["id"]
+            seen.add(sid)
+            alive = m.status == MemberStatus.ALIVE
+            rec = self._server_health.get(sid)
+            # Log lag is LEADER knowledge (match_index lives on the
+            # leader's replicators) — followers score serf health only,
+            # and a fresh leader whose match_index hasn't converged yet
+            # (0 right after election) keeps the PREVIOUS verdict
+            # instead of resetting every stabilization clock on each
+            # failover.
+            healthy = alive
+            if is_leader and sid != self.node_id:
+                m_idx = raft._match_index.get(sid, 0)
+                if m_idx > 0 or raft.last_index() == 0:
+                    lag = raft.last_index() - m_idx
+                    healthy = alive and \
+                        lag <= self.config.autopilot_max_trailing_logs
+                elif rec is not None:
+                    healthy = alive and rec["healthy"]
+            if rec is None or rec["healthy"] != healthy:
+                rec = {"healthy": healthy, "stable_since": now}
+            rec.update({
+                "name": m.name,
+                "serf_status": m.status.name.lower(),
+                "last_index": (
+                    raft._match_index.get(sid, 0)
+                    if is_leader and sid != self.node_id
+                    else (raft.last_index() if raft else 0)
+                ),
+                "voter": raft is not None and sid in raft.voters,
+            })
+            self._server_health[sid] = rec
+        for sid in list(self._server_health):
+            if sid not in seen:
+                del self._server_health[sid]
+
+    async def _autopilot_loop(self) -> None:
+        """autopilot.go run(): each pass promotes stable staging servers
+        and prunes dead ones.
+
+        promotion   a non-voter continuously healthy for
+                    ServerStabilizationTime becomes a voter
+                    (promoteStableServers)
+        pruning     voters/non-voters whose serf member has been FAILED
+                    past the grace window are removed — never more than
+                    (voters-1)//2 voters in one pass, so a partition
+                    can't talk the leader into destroying its own
+                    quorum (autopilot.go removalLimit)
+        """
         while not self._shutdown:
             await asyncio.sleep(self.config.autopilot_interval_s)
             try:
                 if self.raft is None or not self.raft.is_leader():
                     continue
+                self.apply_autopilot_overrides()
+                self._autopilot_update_health()
                 now = time.monotonic()
-                dead = []
+
+                # -- promote stable non-voters -------------------------
+                for sid in list(self.raft.non_voters):
+                    rec = self._server_health.get(sid)
+                    if (
+                        rec is not None
+                        and rec["healthy"]
+                        and now - rec["stable_since"]
+                        >= self.config.autopilot_server_stabilization_s
+                    ):
+                        log.info("autopilot: promoting server %s", sid)
+                        await self.raft.promote_server(sid)
+
+                if not self.config.autopilot_cleanup_dead_servers:
+                    continue
+                # -- prune dead servers --------------------------------
+                dead_voters, dead_staging = [], []
                 for m in list(self.serf.members.values()):
+                    sid = m.tags.get("id")
                     if (
                         self._is_peer_server(m)
                         and m.status == MemberStatus.FAILED
-                        and m.tags.get("id") in self.raft.voters
-                        and m.tags.get("id") != self.node_id
+                        and sid != self.node_id
                         and (m.leave_time or now) + self.config.autopilot_grace_s
                         <= now
                     ):
-                        dead.append(m.tags["id"])
+                        if sid in self.raft.voters:
+                            dead_voters.append(sid)
+                        elif sid in self.raft.non_voters:
+                            dead_staging.append(sid)
+                # Dead staging servers cost no quorum — drop them all.
+                for node_id in dead_staging:
+                    log.info("autopilot: removing dead staging server %s",
+                             node_id)
+                    await self.raft.remove_server(node_id)
                 limit = max((len(self.raft.voters) - 1) // 2, 0)
-                for node_id in dead[:limit]:
+                for node_id in dead_voters[:limit]:
                     log.info("autopilot: removing dead server %s", node_id)
                     await self.raft.remove_server(node_id)
             except Exception:

@@ -168,6 +168,10 @@ class RaftNode:
         self.snapshot_term = 0
         self.snapshot_data: Any = None
         self.voters: list[str] = list(voters)
+        # Staging servers: replicated to, never counted for quorum or
+        # elections (hashicorp/raft nonvoter/staging servers; autopilot
+        # promotes them once stable).
+        self.non_voters: list[str] = []
         # Bootstrap writes the initial configuration INTO THE LOG
         # (hashicorp/raft BootstrapCluster appends a configuration entry
         # at index 1) so it replicates to servers that lost the
@@ -272,19 +276,52 @@ class RaftNode:
         """Single-server membership change (api.go AddVoter)."""
         if node_id in self.voters:
             return
-        await self._change_config([*self.voters, node_id], timeout)
-
-    async def remove_server(self, node_id: str, timeout: float = 10.0) -> None:
-        if node_id not in self.voters:
-            return
         await self._change_config(
-            [v for v in self.voters if v != node_id], timeout
+            [*self.voters, node_id],
+            [p for p in self.non_voters if p != node_id],
+            timeout,
         )
 
-    async def _change_config(self, new_voters: list[str], timeout: float) -> None:
+    async def add_nonvoter(self, node_id: str,
+                           timeout: float = 10.0) -> None:
+        """Add a STAGING server: receives the log, counts for nothing
+        (api.go AddNonvoter) — autopilot's promotion pipeline input."""
+        if node_id in self.voters or node_id in self.non_voters:
+            return
+        await self._change_config(
+            list(self.voters), [*self.non_voters, node_id], timeout
+        )
+
+    async def promote_server(self, node_id: str,
+                             timeout: float = 10.0) -> None:
+        """Non-voter → voter (autopilot.go promoteServers →
+        raft.AddVoter on a staging server)."""
+        if node_id in self.voters or node_id not in self.non_voters:
+            return
+        await self._change_config(
+            [*self.voters, node_id],
+            [p for p in self.non_voters if p != node_id],
+            timeout,
+        )
+
+    async def remove_server(self, node_id: str, timeout: float = 10.0) -> None:
+        if node_id not in self.voters and node_id not in self.non_voters:
+            return
+        await self._change_config(
+            [v for v in self.voters if v != node_id],
+            [p for p in self.non_voters if p != node_id],
+            timeout,
+        )
+
+    async def _change_config(self, new_voters: list[str],
+                             new_non_voters: list[str],
+                             timeout: float) -> None:
         if self.role != Role.LEADER:
             raise NotLeaderError(self.leader_id)
-        entry = self._append_local(ENTRY_CONFIG, {"voters": new_voters})
+        entry = self._append_local(
+            ENTRY_CONFIG,
+            {"voters": new_voters, "non_voters": new_non_voters},
+        )
         self._apply_config(entry)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._apply_waiters[entry.index] = fut
@@ -303,6 +340,7 @@ class RaftNode:
             "applied_index": self.last_applied,
             "leader": self.leader_id,
             "voters": list(self.voters),
+            "non_voters": list(self.non_voters),
             "snapshot_index": self.snapshot_index,
         }
 
@@ -403,8 +441,9 @@ class RaftNode:
         self.role = Role.LEADER
         self.leader_id = self.id
         last = self.last_index()
-        self._next_index = {p: last + 1 for p in self.voters if p != self.id}
-        self._match_index = {p: 0 for p in self.voters if p != self.id}
+        peers = [*self.voters, *self.non_voters]
+        self._next_index = {p: last + 1 for p in peers if p != self.id}
+        self._match_index = {p: 0 for p in peers if p != self.id}
         # Noop barrier so the new term has a committable entry (§5.4.2,
         # raft.go runLeader -> dispatchLogs noop).
         self._append_local(ENTRY_NOOP, None)
@@ -426,14 +465,16 @@ class RaftNode:
 
     def _apply_config(self, entry: Entry) -> None:
         self.voters = list(entry.data["voters"])
+        self.non_voters = list(entry.data.get("non_voters", []))
         if self.role == Role.LEADER:
-            for p in self.voters:
+            peers = set(self.voters) | set(self.non_voters)
+            for p in peers:
                 if p != self.id and p not in self._next_index:
                     self._next_index[p] = self.last_index() + 1
                     self._match_index[p] = 0
                     self._spawn_replicator(p)
             for p in list(self._repl_tasks):
-                if p not in self.voters:
+                if p not in peers:
                     self._repl_tasks.pop(p).cancel()
                     self._next_index.pop(p, None)
                     self._match_index.pop(p, None)
@@ -472,7 +513,7 @@ class RaftNode:
     # -- replication (replication.go) ---------------------------------------
 
     def _start_replication(self) -> None:
-        for peer in self.voters:
+        for peer in [*self.voters, *self.non_voters]:
             if peer != self.id:
                 self._spawn_replicator(peer)
 
@@ -581,6 +622,7 @@ class RaftNode:
                     "last_included_term": self.snapshot_term,
                     "data": self.snapshot_data,
                     "voters": list(self.voters),
+                    "non_voters": list(self.non_voters),
                 },
             ),
             self.config.heartbeat_interval * 20,
@@ -686,6 +728,7 @@ class RaftNode:
         self.snapshot_term = req["last_included_term"]
         self.snapshot_data = req["data"]
         self.voters = list(req["voters"])
+        self.non_voters = list(req.get("non_voters", []))
         self.log = [e for e in self.log if e.index > idx]
         self._log_start = idx + 1
         self.commit_index = max(self.commit_index, idx)

@@ -19,6 +19,10 @@ from consul_tpu.protocol import LAN
 
 
 def make_server(net, name, expect=3, **kw):
+    # Fast staging→voter promotion (late joiners are non-voters until
+    # autopilot promotes them).
+    kw.setdefault("autopilot_interval_s", 0.3)
+    kw.setdefault("autopilot_server_stabilization_s", 0.3)
     cfg = ServerConfig(
         node_name=name,
         bootstrap_expect=expect,

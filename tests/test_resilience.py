@@ -271,3 +271,71 @@ class TestSnapshotEndpoint:
         )
         assert res2["result"] is True
         await shutdown_all(*servers)
+
+
+class TestAutopilotPromotion:
+    async def test_late_joiner_stages_then_promotes(self):
+        """A server joining an established cluster enters raft as a
+        NON-voter and is promoted only after the stabilization window
+        of continuous health (autopilot.go promoteStableServers)."""
+        from test_cluster_agents import make_server as mk
+
+        net = InMemoryNetwork()
+        servers = [
+            mk(net, f"s{i}", expect=3,
+               autopilot_server_stabilization_s=1.0)
+            for i in range(3)
+        ]
+        for s in servers:
+            await s.start()
+        for s in servers[1:]:
+            await s.join(["s0:gossip"])
+        leader = await wait_for_leader(servers)
+
+        late = mk(net, "s9", expect=3,
+                  autopilot_server_stabilization_s=1.0)
+        await late.start()
+        await late.join(["s0:gossip"])
+        # Phase 1: staged as a non-voter (replicated to, no quorum).
+        await wait_until(
+            lambda: "s9" in leader.raft.non_voters,
+            timeout=10, msg="late joiner staged as non-voter",
+        )
+        assert "s9" not in leader.raft.voters
+        # Phase 2: promoted after the stabilization window.
+        await wait_until(
+            lambda: "s9" in leader.raft.voters
+            and "s9" not in leader.raft.non_voters,
+            timeout=15, msg="stable staging server promoted",
+        )
+        await shutdown_all(late, *servers)
+
+    async def test_autopilot_config_and_health_surface(self):
+        """/v1/operator/autopilot/{configuration,health}
+        (operator_autopilot_endpoint.go)."""
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (agent, addr, _dns, _dns_addr):
+            st, _, cfg = await http_call(
+                addr, "GET", "/v1/operator/autopilot/configuration")
+            assert st == 200 and cfg["CleanupDeadServers"] is True
+            # Set: flip cleanup off, raise stabilization.
+            st, _, ok = await http_call(
+                addr, "PUT", "/v1/operator/autopilot/configuration",
+                b'{"CleanupDeadServers": false, '
+                b'"ServerStabilizationTimeS": 99}')
+            assert st == 200 and ok is True
+            st, _, cfg = await http_call(
+                addr, "GET", "/v1/operator/autopilot/configuration")
+            assert cfg["CleanupDeadServers"] is False
+            assert cfg["ServerStabilizationTimeS"] == 99
+            # The running server absorbed the override.
+            assert agent.delegate.config.autopilot_cleanup_dead_servers \
+                is False
+            # Health roll-up: single healthy voter.
+            st, _, health = await http_call(
+                addr, "GET", "/v1/operator/autopilot/health")
+            assert st == 200 and health["Healthy"] is True
+            assert health["Servers"][0]["Voter"] is True
+            assert health["Servers"][0]["Healthy"] is True
+            assert health["FailureTolerance"] == 0
