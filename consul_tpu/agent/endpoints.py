@@ -799,12 +799,18 @@ class Operator(_Endpoint):
         healthy_voters = sum(
             1 for s in servers if s["healthy"] and s["voter"]
         )
+        total_voters = len(raft.voters) if raft is not None else 0
+        quorum = total_voters // 2 + 1 if total_voters else 0
         return {
             "healthy": all(s["healthy"] for s in servers) and bool(
                 raft is not None and raft.leader_id is not None
             ),
             "servers": servers,
-            "failure_tolerance": max(0, (healthy_voters - 1) // 2),
+            # How many MORE healthy voters can fail before quorum is
+            # lost — measured against the full voter set's quorum, so
+            # already-failed voters count against it
+            # (autopilot/structs.go OperatorHealthReply).
+            "failure_tolerance": max(0, healthy_voters - quorum),
         }
 
 

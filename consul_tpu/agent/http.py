@@ -468,6 +468,7 @@ class HTTPApi:
         r("GET", r"/v1/agent/checks", self.agent_checks)
         r("PUT", r"/v1/agent/join/(?P<addr>.+)", self.agent_join)
         r("PUT", r"/v1/agent/leave", self.agent_leave)
+        r("PUT", r"/v1/agent/reload", self.agent_reload)
         r("PUT", r"/v1/agent/maintenance", self.agent_node_maintenance)
         r("PUT", r"/v1/agent/service/maintenance/(?P<sid>[^/?]+)",
           self.agent_service_maintenance)
@@ -777,6 +778,23 @@ class HTTPApi:
             e.service["id"]: e.service for e in
             self.agent.local.services.values() if not e.deleted
         }))
+
+    async def agent_reload(self, req, m) -> HTTPResponse:
+        """PUT /v1/agent/reload (agent_endpoint.go AgentReload): re-read
+        config sources, same path as SIGHUP.  Requires agent:write."""
+        await self._acl_check(
+            req, "agent", self.agent.config.node_name, "write")
+        handler = getattr(self.agent, "reload_handler", None)
+        if handler is None:
+            return HTTPResponse(
+                400, {"error": "agent has no reloadable config sources"})
+        err = handler()
+        if err is not None:
+            # AgentReload returns the failure to the caller — a 200 on
+            # a rejected config would leave the operator believing the
+            # new config is live.
+            return HTTPResponse(500, {"error": f"reload failed: {err}"})
+        return HTTPResponse(200, True)
 
     async def agent_node_maintenance(self, req, m) -> HTTPResponse:
         """PUT /v1/agent/maintenance?enable=true|false&reason=...

@@ -242,8 +242,18 @@ class Server:
         self._coord_updates: dict[str, dict] = {}
         self._session_deadlines: dict[str, float] = {}
         self._tombstone_marks: list[tuple[float, int]] = []
-        # Autopilot server-health records (autopilot.go clusterHealth).
+        # Autopilot server-health records (autopilot.go clusterHealth)
+        # + the static defaults replicated overrides layer over.
         self._server_health: dict[str, dict] = {}
+        self._autopilot_defaults = {
+            "autopilot_cleanup_dead_servers":
+                config.autopilot_cleanup_dead_servers,
+            "autopilot_grace_s": config.autopilot_grace_s,
+            "autopilot_server_stabilization_s":
+                config.autopilot_server_stabilization_s,
+            "autopilot_max_trailing_logs":
+                config.autopilot_max_trailing_logs,
+        }
         self._shutdown = False
 
         # RPC endpoint services (server_oss.go:8-23).
@@ -732,10 +742,10 @@ class Server:
 
     def apply_autopilot_overrides(self) -> None:
         """Fold the replicated autopilot-config entry (Operator.
-        AutopilotSetConfiguration) over the static config defaults."""
-        _, entry = self.store.config_entry_get("autopilot-config", "global")
-        if not entry:
-            return
+        AutopilotSetConfiguration) over the STATIC defaults captured at
+        construction — never over previously-mutated values, so the
+        effective settings are a pure function of replicated state and
+        identical on every (re)elected leader."""
         mapping = {
             "cleanup_dead_servers": "autopilot_cleanup_dead_servers",
             "last_contact_threshold_s": "autopilot_grace_s",
@@ -743,9 +753,13 @@ class Server:
                 "autopilot_server_stabilization_s",
             "max_trailing_logs": "autopilot_max_trailing_logs",
         }
+        _, entry = self.store.config_entry_get("autopilot-config", "global")
+        entry = entry or {}
         for key, field in mapping.items():
-            if key in entry:
-                setattr(self.config, field, entry[key])
+            setattr(
+                self.config, field,
+                entry.get(key, self._autopilot_defaults[field]),
+            )
 
     def _autopilot_update_health(self) -> None:
         """autopilot.go serverHealthLoop/updateClusterHealth: score each
@@ -780,16 +794,14 @@ class Server:
                     healthy = alive and rec["healthy"]
             if rec is None or rec["healthy"] != healthy:
                 rec = {"healthy": healthy, "stable_since": now}
-            rec.update({
-                "name": m.name,
-                "serf_status": m.status.name.lower(),
-                "last_index": (
-                    raft._match_index.get(sid, 0)
-                    if is_leader and sid != self.node_id
-                    else (raft.last_index() if raft else 0)
-                ),
-                "voter": raft is not None and sid in raft.voters,
-            })
+            if is_leader and sid != self.node_id:
+                rec["last_index"] = raft._match_index.get(sid, 0)
+            elif sid == self.node_id and raft is not None:
+                rec["last_index"] = raft.last_index()
+            else:
+                # A follower has no view of other servers' match index —
+                # report 0 rather than fabricating one.
+                rec["last_index"] = 0
             self._server_health[sid] = rec
         for sid in list(self._server_health):
             if sid not in seen:

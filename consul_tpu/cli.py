@@ -167,6 +167,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("arg", help="JSON definition file (or '-'), or id")
     sp = cmd("monitor", cmd_monitor, "stream the agent's live logs")
     sp.add_argument("-log-level", default="info", dest="log_level")
+    sp = sub.add_parser("validate", help="validate config files")
+    sp.set_defaults(fn=cmd_validate)
+    sp.add_argument("paths", nargs="+", help="config files or dirs")
+    cmd("reload", cmd_reload, "trigger a config reload on the agent")
     sp = cmd("maint", cmd_maint, "toggle node/service maintenance mode")
     sp.add_argument("-enable", action="store_true")
     sp.add_argument("-disable", action="store_true")
@@ -286,6 +290,9 @@ async def cmd_agent(args) -> int:
     # SIGHUP: re-read the same sources, apply the reloadable subset
     # (agent.go reloadConfigInternal).
     def on_hup():
+        """Returns None on success, the error on failure — the HTTP
+        reload endpoint surfaces it to the caller (agent_endpoint.go
+        AgentReload returns the error); SIGHUP just logs it."""
         nonlocal rc
         try:
             new_rc = build_runtime(args)
@@ -295,12 +302,17 @@ async def cmd_agent(args) -> int:
             print(f"==> Reloaded configuration ({len(apply)} change(s))")
         except Exception as e:  # noqa: BLE001 - keep running on bad config
             print(f"==> Reload failed: {e}", file=sys.stderr)
+            sys.stdout.flush()
+            return e
         sys.stdout.flush()
+        return None
 
     try:
         asyncio.get_running_loop().add_signal_handler(signal.SIGHUP, on_hup)
     except (NotImplementedError, AttributeError):  # pragma: no cover
         pass
+    # PUT /v1/agent/reload triggers the same path as SIGHUP.
+    agent.reload_handler = on_hup
 
     print("==> consul-tpu agent running!")
     print(f"         Node name: {node}")
@@ -631,6 +643,36 @@ async def cmd_login(args) -> int:
         print(f"token written to {args.token_sink_file}")
     else:
         print(f"SecretID: {secret}")
+    return 0
+
+
+async def cmd_validate(args) -> int:
+    """command/validate: parse + validate config sources without
+    starting an agent (config/builder.go Validate)."""
+    from pathlib import Path as _Path
+
+    from consul_tpu.agent.config import Builder, ConfigError
+
+    b = Builder()
+    try:
+        for path in args.paths:
+            if _Path(path).is_dir():
+                b.add_dir(path)
+            else:
+                b.add_file(path)
+        b.build()
+    except (ConfigError, OSError, ValueError) as e:
+        print(f"Config validation failed: {e}", file=sys.stderr)
+        return 1
+    print("Configuration is valid!")
+    return 0
+
+
+async def cmd_reload(args) -> int:
+    """command/reload: PUT /v1/agent/reload (agent_endpoint.go
+    AgentReload) — same effect as SIGHUP."""
+    await _client(args).write("PUT", "/v1/agent/reload")
+    print("Configuration reload triggered")
     return 0
 
 

@@ -224,3 +224,27 @@ class TestCLIBlackBox:
                 await asyncio.wait_for(proc.wait(), 10)
             except asyncio.TimeoutError:
                 proc.kill()
+
+
+class TestValidateReload:
+    def test_validate_good_and_bad(self, tmp_path, capsys):
+        from consul_tpu.cli import main as cli_main
+
+        good = tmp_path / "ok.json"
+        good.write_text('{"dns_config": {"node_ttl_s": 7}}')
+        assert cli_main(["validate", str(good)]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"unknown_key_xyz": 1}')
+        assert cli_main(["validate", str(bad)]) == 1
+
+    async def test_reload_endpoint(self):
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (agent, addr, _dns, _dns_addr):
+            # No reload handler registered (library embedding): 400.
+            st, _, err = await http_call(addr, "PUT", "/v1/agent/reload")
+            assert st == 400
+            fired = []
+            agent.reload_handler = lambda: fired.append(1)
+            st, _, ok = await http_call(addr, "PUT", "/v1/agent/reload")
+            assert st == 200 and ok is True and fired == [1]

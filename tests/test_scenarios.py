@@ -1,8 +1,19 @@
-"""Scenario preset smoke tests (the million-node presets are exercised
-by bench.py on real hardware; here only the CPU-scale ones run)."""
+"""BASELINE.json study configs: registry + TIMING pins.
+
+Configs 2-5 get the same treatment test_swim_paper.py gives the paper
+curve: convergence times asserted against bounds DERIVED from the
+protocol's own formulas (probe cadence, suspicion timeout scaling,
+serf's convergence basis) — not magic numbers — so a regression in the
+underlying models cannot hide behind a smoke-level "it ran" check.
+(The million-node presets also run on real hardware via bench.py; here
+they run CPU-scale/virtual-mesh.)
+"""
+
+import math
 
 import pytest
 
+from consul_tpu.protocol import LAN, WAN, suspicion_timeout_bounds
 from consul_tpu.sim import SCENARIOS, run_scenario
 
 
@@ -21,3 +32,77 @@ def test_dev3_converges():
 def test_unknown_scenario_raises():
     with pytest.raises(ValueError, match="unknown scenario"):
         run_scenario("nope")
+
+
+def test_probe1k_timing_pins():
+    """Config 2: 1k nodes, 1% concurrent crashes, fanout 3.
+
+    First suspicion is the probe plane's job: with n-10 live probers
+    each probing once per ProbeInterval (LAN 1 s), a crashed subject is
+    probed on average once per interval — detection lands within a few
+    intervals, never before one.  Convergence to DEAD adds the
+    Lifeguard suspicion window: min timeout = SuspicionMult * log10(n)
+    * ProbeInterval (suspicion.go), plus dissemination slack."""
+    out = run_scenario("probe1k")
+    assert out["all_detected"] is True
+
+    probe_ms = LAN.probe_interval_ms
+    assert probe_ms <= out["mean_first_suspect_ms"] <= 10 * probe_ms
+
+    sus_lo_ms, _hi = suspicion_timeout_bounds(
+        LAN.suspicion_mult, LAN.suspicion_max_timeout_mult, 1000,
+        LAN.probe_interval_ms,
+    )
+    # Death can't be declared before the minimum suspicion window after
+    # first suspicion; full convergence follows within ~2x the window.
+    assert out["mean_converged_ms"] >= sus_lo_ms
+    assert out["mean_converged_ms"] <= out["mean_first_suspect_ms"] \
+        + 2 * sus_lo_ms
+
+
+def test_event100k_timing_pins():
+    """Config 3: 100k-node broadcast, LAN fanout 4 — serf's own
+    convergence basis (lib/serf docs: ~log-time full infection, well
+    under 3 s simulated for 100k on LAN timing)."""
+    out = run_scenario("event100k")
+    assert out["infected_final"] == 100_000
+    # Epidemic lower bound: can't beat log_fanout(n) rounds.
+    min_rounds = math.log(100_000) / math.log(1 + 4)
+    assert out["t99_ms"] >= min_rounds * LAN.gossip_interval_ms / 2
+    assert out["t9999_ms"] <= 3000
+
+
+def test_multidc1m_timing_pins():
+    """Config 5: 1M nodes, 8 segments, sharded over the device mesh.
+    Every segment must be reached; cross-segment spread rides the
+    slower WAN cadence, so whole-cluster t99 sits above the one-segment
+    LAN figure but within a small multiple of it."""
+    out = run_scenario("multidc1m")
+    assert out["infected_final"] == 1_000_000
+    assert out["segments_reached"] == 8
+    origin_t99 = out["segment_t99_ms"][0]
+    assert out["t99_ms"] >= origin_t99  # remote segments lag the origin
+    assert out["t99_ms"] <= 4 * origin_t99
+    assert out["t99_ms"] <= 10_000  # absolute sanity vs LAN basis
+
+
+def test_suspect1m_timing_pins():
+    """Config 4 (the headline): 1M nodes, 30% loss, WAN timing.
+
+    First suspicion within a handful of WAN probe intervals; the
+    SUSPECT->DEAD transition cannot land before the 1M-node minimum
+    suspicion window (SuspicionMult * log10(1e6) * ProbeInterval =
+    180 s at WAN cadence), and 99% dead-knowledge follows within ~10%
+    of it.  The slowest test in the suite (~2 min of 1M-node scan on
+    CPU) — it pins the exact numbers the headline bench banks on."""
+    out = run_scenario("suspect1m")
+    probe_ms = WAN.probe_interval_ms
+    assert probe_ms <= out["first_suspect_ms"] <= 10 * probe_ms
+
+    sus_lo_ms, _hi = suspicion_timeout_bounds(
+        WAN.suspicion_mult, WAN.suspicion_max_timeout_mult, 1_000_000,
+        WAN.probe_interval_ms,
+    )
+    assert out["first_dead_ms"] >= sus_lo_ms
+    assert out["t99_dead_known_ms"] <= 1.25 * sus_lo_ms
+    assert out["dead_known_final"] >= 0.99 * (1_000_000 - 1)
