@@ -845,9 +845,11 @@ class ConnectCA(_Endpoint):
 
     async def rotate(self, body: dict):
         """Mint + activate a new signing root (leader_connect.go CA
-        config update path, minus cross-signing: old roots stay stored
-        so outstanding leaves verify until expiry, and proxies roll
-        their certs when they observe the new active root)."""
+        config update path): the outgoing key CROSS-SIGNS the new root
+        (provider_consul.go CrossSignCA) so old-root-pinned peers keep
+        verifying new leaves via the chain; old roots stay stored so
+        outstanding leaves verify until expiry, and proxies roll their
+        certs when they observe the new active root."""
         self.server.acl_check(body, "operator", "", WRITE)
         fwd = await self.server.forward("ConnectCA.Rotate", body)
         if fwd is not None:

@@ -76,6 +76,12 @@ def _unpack(raw: bytes) -> Any:
 _CAMEL_RE = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
 
 
+# Flow control (the yamux window analogue, in frames not bytes):
+# per-streaming-call credit window and the shared write queue's bound.
+STREAM_WINDOW = 32
+SESSION_WINDOW = 1024
+
+
 def snake(name: str) -> str:
     """``ServiceNodes`` → ``service_nodes`` (wire name → method name)."""
     return _CAMEL_RE.sub("_", name).lower()
@@ -261,10 +267,19 @@ class RPCServer:
     async def _serve_frames(self, stream: Stream, dispatch: Callable) -> None:
         """Request pump: decode frames, run each in its own task, write
         responses through a queue (so concurrent handlers never
-        interleave partial writes — the yamux-per-stream analogue)."""
-        write_q: asyncio.Queue = asyncio.Queue()
+        interleave partial writes — the yamux-per-stream analogue).
+
+        Flow control (yamux session/stream windows, yamux/session.go +
+        stream.go): the shared write queue is BOUNDED (session-level
+        backpressure — a slow socket suspends handlers instead of
+        buffering without limit), and each server-streaming call holds a
+        credit window of STREAM_WINDOW frames — the producer blocks when
+        the client stops consuming, and the client grants more credit
+        as its application drains (window-update frames)."""
+        write_q: asyncio.Queue = asyncio.Queue(maxsize=SESSION_WINDOW)
         pending: set[asyncio.Task] = set()
         streams_by_seq: dict[int, asyncio.Task] = {}
+        stream_credits: dict[int, asyncio.Semaphore] = {}
         # Cancels that raced ahead of their handler task starting.
         cancelled_seqs: set[int] = set()
 
@@ -287,6 +302,13 @@ class RPCServer:
             while True:
                 raw = await stream.recv()
                 req = _unpack(raw)
+                if req.get("credit"):
+                    # Window update: the client consumed k frames.
+                    sem = stream_credits.get(req.get("seq", 0))
+                    if sem is not None:
+                        for _ in range(int(req["credit"])):
+                            sem.release()
+                    continue
                 if req.get("cancel"):
                     # Client abandoned a server-streaming call
                     # (grpc-style cancellation for Subscribe).  The
@@ -323,8 +345,13 @@ class RPCServer:
                             # frame per yielded item with more=True,
                             # then a closing frame.
                             streams_by_seq[seq] = asyncio.current_task()
+                            credit = asyncio.Semaphore(STREAM_WINDOW)
+                            stream_credits[seq] = credit
                             try:
                                 async for item in result:
+                                    # One credit per frame: blocks here
+                                    # when the client stops consuming.
+                                    await credit.acquire()
                                     await write_q.put(_pack(
                                         {"seq": seq, "error": None,
                                          "body": item, "more": True}
@@ -336,6 +363,7 @@ class RPCServer:
                                 return
                             finally:
                                 streams_by_seq.pop(seq, None)
+                                stream_credits.pop(seq, None)
                         else:
                             resp = {"seq": seq, "error": None, "body": result}
                     except Exception as e:  # noqa: BLE001 — error -> wire
@@ -480,6 +508,7 @@ class RPCClient:
             await conn.stream.send(
                 _pack({"seq": seq, "method": method, "body": body})
             )
+            consumed = 0
             while True:
                 item = await q.get()
                 if isinstance(item, Exception):
@@ -492,6 +521,17 @@ class RPCClient:
                     finished = True
                     return
                 yield item.get("body")
+                # Window update AFTER the application consumed the item
+                # (yamux stream.go sendWindowUpdate): batched at half
+                # the window so updates amortize.
+                consumed += 1
+                if consumed >= STREAM_WINDOW // 2 and not conn.dead:
+                    try:
+                        await conn.stream.send(
+                            _pack({"seq": seq, "credit": consumed}))
+                        consumed = 0
+                    except Exception:  # noqa: BLE001 - conn tearing down
+                        pass
         finally:
             conn.stream_waiters.pop(seq, None)
             if not finished and not conn.dead:

@@ -9,8 +9,11 @@ certificates whose URI SAN is the service's SPIFFE identity
 
 (``agent/connect/uri_service.go``).  Rotation generates a new root and
 marks it active; old roots stay in the store so already-issued leaves
-keep verifying until they expire (``leader_connect.go`` root
-rotation — cross-signing is not modeled).
+keep verifying until they expire, and the OLD key cross-signs the new
+root (``provider_consul.go CrossSignCA`` / ``leader_connect.go``
+rotation): leaves signed by the new root carry the cross-signed
+intermediate in their chain, so a peer still pinned to the old root
+keeps verifying new leaves until its root set refreshes.
 """
 
 from __future__ import annotations
@@ -49,6 +52,10 @@ class BuiltinCA:
         self.trust_domain = trust_domain or f"{uuid.uuid4()}.consul"
         self._key: Optional[ec.EllipticCurvePrivateKey] = None
         self._cert: Optional[x509.Certificate] = None
+        # Cross-signed form of the CURRENT root, issued by the previous
+        # root's key at rotation time (provider_consul.go CrossSignCA);
+        # rides along in leaf chains for old-root-pinned verifiers.
+        self._cross_pem: Optional[str] = None
         self.root_id = ""
 
     # ------------------------------------------------------------------
@@ -75,7 +82,12 @@ class BuiltinCA:
             .not_valid_before(now - datetime.timedelta(minutes=1))
             .not_valid_after(now + ROOT_TTL)
             .add_extension(
-                x509.BasicConstraints(ca=True, path_length=0), critical=True
+                # path_length=1: the root must be allowed ONE subordinate
+                # CA below it — the cross-signed intermediate minted at
+                # rotation (RFC 5280 pathLenConstraint; pathlen=0 would
+                # make every leaf->cross->old-root chain invalid to
+                # standards-compliant verifiers like OpenSSL).
+                x509.BasicConstraints(ca=True, path_length=1), critical=True
             )
             .add_extension(
                 x509.SubjectAlternativeName([
@@ -100,8 +112,34 @@ class BuiltinCA:
         return self._cert.public_bytes(serialization.Encoding.PEM).decode()
 
     def rotate(self) -> dict:
-        """New active root; the caller stores it (old roots retained)."""
-        return self.generate_root()
+        """New active root; the caller stores it (old roots retained).
+        The outgoing key CROSS-SIGNS the incoming root
+        (provider_consul.go CrossSignCA): the returned record carries
+        the cross-signed intermediate, and every leaf signed from now
+        until the next rotation includes it in its chain."""
+        old_key, old_cert = self._key, self._cert
+        rec = self.generate_root()
+        self._cross_pem = None
+        if old_key is not None and old_cert is not None:
+            now = _now()
+            cross = (
+                x509.CertificateBuilder()
+                .subject_name(self._cert.subject)      # NEW root's name
+                .issuer_name(old_cert.subject)         # signed by OLD
+                .public_key(self._key.public_key())    # NEW root's key
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=1))
+                .not_valid_after(now + ROOT_TTL)
+                .add_extension(
+                    x509.BasicConstraints(ca=True, path_length=0),
+                    critical=True,
+                )
+                .sign(old_key, hashes.SHA256())
+            )
+            self._cross_pem = cross.public_bytes(
+                serialization.Encoding.PEM).decode()
+            rec["cross_signed_cert"] = self._cross_pem
+        return rec
 
     # ------------------------------------------------------------------
     # leaves
@@ -153,9 +191,36 @@ class BuiltinCA:
                 serialization.NoEncryption(),
             ).decode(),
             "root_id": self.root_id,
+            # Chain material for old-root-pinned verifiers (empty when
+            # no rotation has happened under this provider).
+            "intermediate_pems": (
+                [self._cross_pem] if self._cross_pem else []
+            ),
             "valid_after": cert.not_valid_before_utc.isoformat(),
             "valid_before": cert.not_valid_after_utc.isoformat(),
         }
+
+
+def verify_leaf_chain(
+    leaf_pem: str, intermediate_pems: list[str], root_pem: str
+) -> Optional[str]:
+    """Verify a leaf through its cross-signed intermediates against a
+    trusted root (connect/tls.go chain verification): the path is
+    leaf → intermediate (new root cross-signed by old) → root."""
+    direct = verify_leaf(leaf_pem, root_pem)
+    if direct is not None:
+        return direct
+    for inter_pem in intermediate_pems or []:
+        try:
+            inter = x509.load_pem_x509_certificate(inter_pem.encode())
+            root = x509.load_pem_x509_certificate(root_pem.encode())
+            inter.verify_directly_issued_by(root)
+        except Exception:  # noqa: BLE001 - try the next intermediate
+            continue
+        via = verify_leaf(leaf_pem, inter_pem)
+        if via is not None:
+            return via
+    return None
 
 
 def verify_leaf(leaf_pem: str, root_pem: str) -> Optional[str]:

@@ -203,9 +203,11 @@ class ConnectProxy:
         leaf = snap.get("leaf") or {}
         roots_pem = "".join(
             r.get("root_cert", "") for r in snap.get("roots") or [])
-        state = (leaf.get("cert_pem", ""), roots_pem)
+        chain_pem = leaf.get("cert_pem", "") + "".join(
+            leaf.get("intermediate_pems") or [])
+        state = (chain_pem, roots_pem)
         if leaf and state != self._cert_state:
-            cert = self._write_tmp(leaf["cert_pem"])
+            cert = self._write_tmp(chain_pem)
             key = self._write_tmp(leaf["key_pem"])
             ca = self._write_tmp(roots_pem)
             if self._server_ctx is None:

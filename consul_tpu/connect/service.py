@@ -71,7 +71,12 @@ class Service:
         )
         roots = await _http_json(self.agent, "GET", "/v1/connect/ca/roots")
         self.uri = leaf["URI"]
-        self._leaf_pem = leaf["CertPEM"]
+        # Present the FULL chain: leaf plus any cross-signed
+        # intermediate from a rotation, so peers still pinned to the
+        # previous root keep verifying us (provider_consul.go
+        # CrossSignCA; the handshake carries the chain).
+        self._leaf_pem = leaf["CertPEM"] + "".join(
+            leaf.get("IntermediatePems") or [])
         self._key_pem = leaf["KeyPEM"]
         self._roots_pem = "".join(
             r["RootCert"] for r in roots.get("Roots", [])

@@ -374,3 +374,34 @@ def test_auto_encrypt_client_bootstrap():
         await server.shutdown()
 
     run(main())
+
+
+def test_rotation_cross_signs_for_old_root_verifiers():
+    """provider_consul.go CrossSignCA: after rotation, leaves signed by
+    the NEW root must verify for a peer still pinned to the OLD root,
+    via the cross-signed intermediate carried in the leaf chain."""
+    from consul_tpu.connect.ca import (
+        BuiltinCA,
+        verify_leaf,
+        verify_leaf_chain,
+    )
+
+    ca = BuiltinCA("dc1", trust_domain="td.consul")
+    ca.generate_root()
+    old_root_pem = ca.root_pem()
+
+    rec = ca.rotate()
+    assert rec.get("cross_signed_cert")
+    leaf = ca.sign_leaf("web")
+    assert leaf["intermediate_pems"] == [rec["cross_signed_cert"]]
+
+    # Pinned to the NEW root: direct verification.
+    assert verify_leaf(leaf["cert_pem"], ca.root_pem())
+    # Pinned to the OLD root: direct fails, the chain succeeds.
+    assert verify_leaf(leaf["cert_pem"], old_root_pem) is None
+    uri = verify_leaf_chain(
+        leaf["cert_pem"], leaf["intermediate_pems"], old_root_pem)
+    assert uri == leaf["uri"]
+    # Garbage intermediates never help.
+    assert verify_leaf_chain(leaf["cert_pem"], ["junk"], old_root_pem) \
+        is None

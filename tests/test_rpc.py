@@ -218,3 +218,46 @@ class TestBlockingQuery:
         store.abandon()  # snapshot restore path
         meta, _ = await asyncio.wait_for(task, 2)
         assert meta.index == 3
+
+
+class TestStreamFlowControl:
+    @pytest.mark.asyncio
+    async def test_producer_stalls_at_window_until_client_consumes(
+        self, net
+    ):
+        """yamux-style credit window: a server-streaming producer must
+        stop at STREAM_WINDOW unconsumed frames instead of buffering
+        without bound, and resume as the client's application drains."""
+        from consul_tpu.agent.rpc import STREAM_WINDOW
+
+        produced = []
+
+        class Feed:
+            async def subscribe(self, body):
+                i = 0
+                while True:
+                    produced.append(i)
+                    yield {"i": i}
+                    i += 1
+
+        t = net.new_transport("feed-srv")
+        srv = RPCServer(t)
+        srv.register("Feed", Feed())
+        await srv.start()
+        client = RPCClient(net.new_transport("feed-cli"))
+
+        gen = client.stream("feed-srv", "Feed.Subscribe", {})
+        # Consume ONE item, then stop consuming entirely.
+        first = await asyncio.wait_for(gen.__anext__(), 5)
+        assert first == {"i": 0}
+        await asyncio.sleep(0.3)
+        # The producer ran ahead by at most the window (+ a small queue
+        # in flight), NOT unboundedly.
+        assert len(produced) <= STREAM_WINDOW + 2, produced[-1]
+
+        # Draining the stream grants credit and the producer resumes.
+        for _ in range(STREAM_WINDOW * 2):
+            await asyncio.wait_for(gen.__anext__(), 5)
+        await asyncio.sleep(0.1)
+        assert len(produced) > STREAM_WINDOW
+        await gen.aclose()
