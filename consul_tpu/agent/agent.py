@@ -73,6 +73,10 @@ class AgentConfig:
     # fetch an agent-kind SPIFFE leaf + CA roots from the servers at
     # startup.
     auto_encrypt: bool = False
+    # Network segment membership for CLIENT agents (types/area.go /
+    # agent config "segment"): the client's gossip ring name — join
+    # addresses must point at a server's matching segment transport.
+    segment: str = ""
     # Full auto-config bootstrap (agent/auto-config/config.go +
     # consul/auto_config_endpoint.go): a CLIENT with only a server RPC
     # address and a JWT intro token fetches its whole runtime (gossip
@@ -148,6 +152,10 @@ class Agent:
                     profile=config.profile,
                     gossip_interval_scale=config.gossip_interval_scale,
                     keyring=self.keyring,
+                    tags=(
+                        {"segment": config.segment}
+                        if config.segment else {}
+                    ),
                 ),
                 gossip_transport,
                 rpc_transport,

@@ -463,6 +463,7 @@ class HTTPApi:
         r("GET", r"/v1/agent/monitor", self.agent_monitor)
         r("GET", r"/v1/agent/self", self.agent_self)
         r("GET", r"/v1/agent/members", self.agent_members)
+        r("GET", r"/v1/agent/segments", self.agent_segments)
         r("GET", r"/v1/agent/services", self.agent_services)
         r("GET", r"/v1/agent/service/(?P<sid>[^/?]+)", self.agent_service)
         r("GET", r"/v1/agent/checks", self.agent_checks)
@@ -761,6 +762,21 @@ class HTTPApi:
         })
 
     async def agent_members(self, req, m) -> HTTPResponse:
+        # ?segment= filters one ring; ?segment=_all merges every ring a
+        # server bridges (agent_endpoint.go AgentMembers segment param).
+        segment = req.query.get("segment", "")
+        delegate = self.agent.delegate
+        if segment and hasattr(delegate, "segment_serfs"):
+            if segment == "_all":
+                rows = delegate._all_lan_members()
+            else:
+                seg = delegate.segment_serfs.get(segment)
+                if seg is None:
+                    return HTTPResponse(
+                        404, {"error": f"unknown segment {segment!r}"})
+                rows = list(seg.members.values())
+        else:
+            rows = list(self.agent.serf.members.values())
         members = [
             {
                 "name": mem.name,
@@ -769,9 +785,15 @@ class HTTPApi:
                 "tags": KeyedMap(mem.tags),
                 "status": int(mem.status),
             }
-            for mem in self.agent.serf.members.values()
+            for mem in rows
         ]
         return HTTPResponse(200, members)
+
+    async def agent_segments(self, req, m) -> HTTPResponse:
+        """GET /v1/agent/segments (operator segment listing)."""
+        delegate = self.agent.delegate
+        names = list(getattr(delegate, "segment_serfs", {}) or {})
+        return HTTPResponse(200, [""] + names)
 
     async def agent_services(self, req, m) -> HTTPResponse:
         return HTTPResponse(200, KeyedMap({

@@ -128,6 +128,12 @@ class ServerConfig:
     # this spec ({jwt_secret | jwt_validation_pub_keys, bound_issuer,
     # bound_audiences, claim_mappings, claim_assertions}).
     auto_config_authorizer: Optional[dict] = None
+    # Network segments (server_serf.go:50 segmentLAN + types/area.go):
+    # names of the additional LAN gossip rings this server bridges.
+    # Clients join exactly ONE ring; servers join them all, so segment
+    # members stay isolated from each other's gossip but every segment
+    # reaches the servers.
+    segments: tuple = ()
 
 
 class Server:
@@ -139,6 +145,7 @@ class Server:
         gossip_transport: Transport,
         rpc_transport: Transport,
         wan_transport: Optional[Transport] = None,
+        segment_transports: Optional[dict[str, Transport]] = None,
     ):
         self.config = config
         # Change-stream pub/sub fed by the FSM (stream/event_publisher.go
@@ -221,6 +228,27 @@ class Server:
             )
         self.router = Router(config.datacenter, self.serf_wan)
 
+        # Segment rings (server_serf.go segmentLAN map): one extra serf
+        # pool per configured segment, same server tags + the segment
+        # name so clients of that ring discover us.
+        self.segment_serfs: dict[str, Cluster] = {}
+        for seg_name in config.segments:
+            transport = (segment_transports or {}).get(seg_name)
+            if transport is None:
+                raise ValueError(
+                    f"segment {seg_name!r} has no gossip transport"
+                )
+            self.segment_serfs[seg_name] = Cluster(
+                ClusterConfig(
+                    name=config.node_name,
+                    tags={**lan_tags, "segment": seg_name},
+                    profile=config.profile,
+                    interval_scale=config.gossip_interval_scale,
+                    keyring=config.keyring,
+                ),
+                transport,
+            )
+
         # Mesh-gateway locator for wan federation (gateway_locator.go).
         from consul_tpu.connect.gateways import GatewayLocator
 
@@ -270,6 +298,11 @@ class Server:
         if self.serf_wan is not None:
             await self.serf_wan.start()
             self._tasks.append(asyncio.create_task(self._flood_loop()))
+        for seg in self.segment_serfs.values():
+            await seg.start()
+            self._tasks.append(
+                asyncio.create_task(self._segment_event_pump(seg))
+            )
         self._tasks.append(asyncio.create_task(self._serf_event_pump()))
         # Snapshot auto-rejoin BEFORE bootstrap so a restarted server
         # re-discovers the established cluster instead of re-expecting
@@ -281,6 +314,22 @@ class Server:
 
     async def join(self, addrs: list[str]) -> int:
         return await self.serf.join(addrs)
+
+    async def join_segment(self, segment: str, addrs: list[str]) -> int:
+        """Join peers of one segment ring (agent.go JoinLAN w/ segment
+        port selection)."""
+        seg = self.segment_serfs.get(segment)
+        if seg is None:
+            raise RPCError(f"unknown network segment {segment!r}")
+        return await seg.join(addrs)
+
+    async def _segment_event_pump(self, seg: Cluster) -> None:
+        """Membership changes on a segment ring feed the same reconcile
+        path as the main ring (server_serf.go lanEventHandler runs per
+        segment)."""
+        while not self._shutdown:
+            await seg.events.get()
+            self._reconcile_wake.set()
 
     async def join_wan(self, addrs: list[str]) -> int:
         """Join the WAN pool (server.go JoinWAN / `consul join -wan`)."""
@@ -333,6 +382,8 @@ class Server:
             await self.raft.shutdown()
         if self.serf_wan is not None:
             await self.serf_wan.shutdown()
+        for seg in self.segment_serfs.values():
+            await seg.shutdown()
         await self.serf.shutdown()
         await self.rpc_client.shutdown()
         await self._raft_rpc_client.shutdown()
@@ -348,6 +399,18 @@ class Server:
     # ------------------------------------------------------------------
     # bootstrap & raft peer discovery (server_serf.go maybeBootstrap)
     # ------------------------------------------------------------------
+
+    def _all_lan_members(self) -> list[Member]:
+        """Union of the main ring and every segment ring, deduped by
+        node name (a server appears in all rings — its main-ring record
+        wins; a client lives in exactly one)."""
+        merged: dict[str, Member] = {}
+        for seg in self.segment_serfs.values():
+            for m in seg.members.values():
+                merged[m.name] = m
+        for m in self.serf.members.values():
+            merged[m.name] = m
+        return list(merged.values())
 
     def _server_members(self) -> list[Member]:
         return [
@@ -651,7 +714,7 @@ class Server:
         _, catalog_nodes = self.store.nodes()
         known = {n["node"] for n in catalog_nodes}
 
-        for m in list(self.serf.members.values()):
+        for m in self._all_lan_members():
             if m.status == MemberStatus.ALIVE:
                 await self._handle_alive_member(m)
             elif m.status == MemberStatus.FAILED:
@@ -703,7 +766,11 @@ class Server:
             {
                 "node": m.name,
                 "address": m.addr,
-                "node_meta": {"serf": "1"},
+                "node_meta": {
+                    "serf": "1",
+                    **({"segment": m.tags["segment"]}
+                       if m.tags.get("segment") else {}),
+                },
                 "check": {
                     "check_id": SERF_CHECK_ID,
                     "name": SERF_CHECK_NAME,

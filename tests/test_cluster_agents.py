@@ -358,3 +358,84 @@ class TestLockDelay:
         })
         assert allowed["result"] is True
         await shutdown_all(*servers)
+
+
+class TestNetworkSegments:
+    async def test_segment_rings_isolate_clients_but_reach_servers(self):
+        """server_serf.go:50 segmentLAN: clients of different segments
+        never see each other's gossip, the server bridges all rings,
+        and reconcile folds every segment's nodes into one catalog with
+        their segment recorded."""
+        from consul_tpu.agent.agent import Agent, AgentConfig
+
+        net = InMemoryNetwork()
+        srv = Server(
+            ServerConfig(
+                node_name="seg-server", bootstrap_expect=1,
+                gossip_interval_scale=0.05, reconcile_interval_s=0.2,
+                coordinate_update_period_s=0.1, session_ttl_sweep_s=0.1,
+                segments=("alpha", "beta"),
+            ),
+            gossip_transport=net.new_transport("srv:gossip"),
+            rpc_transport=net.new_transport("srv:rpc"),
+            segment_transports={
+                "alpha": net.new_transport("srv:alpha"),
+                "beta": net.new_transport("srv:beta"),
+            },
+        )
+        await srv.start()
+
+        def client(name, segment):
+            return Agent(
+                AgentConfig(node_name=name, server=False,
+                            gossip_interval_scale=0.05,
+                            sync_interval_s=0.3,
+                            sync_retry_interval_s=0.2, segment=segment),
+                gossip_transport=net.new_transport(f"{name}:gossip"),
+                rpc_transport=net.new_transport(f"{name}:rpc"),
+            )
+
+        ca = client("c-alpha", "alpha")
+        cb = client("c-beta", "beta")
+        await ca.start()
+        await cb.start()
+        try:
+            await wait_until(lambda: srv.is_leader(), msg="leader")
+            assert await ca.join(["srv:alpha"]) == 1
+            assert await cb.join(["srv:beta"]) == 1
+            await wait_until(
+                lambda: "c-alpha" in srv.segment_serfs["alpha"].members
+                and "c-beta" in srv.segment_serfs["beta"].members,
+                msg="server bridges both segments",
+            )
+            # Isolation: alpha's ring never learns beta's client.
+            await asyncio.sleep(0.5)
+            assert "c-beta" not in ca.serf.members
+            assert "c-alpha" not in cb.serf.members
+            # The main ring holds only the server itself.
+            assert set(srv.serf.members) == {"seg-server"}
+            # Reconcile registers both segment clients in the catalog
+            # with their segment in node meta.
+            await wait_until(
+                lambda: srv.store.node("c-alpha")[1] is not None
+                and srv.store.node("c-beta")[1] is not None,
+                timeout=10, msg="segment nodes reconciled into catalog",
+            )
+            assert srv.store.node("c-alpha")[1]["meta"]["segment"] == \
+                "alpha"
+            assert srv.store.node("c-beta")[1]["meta"]["segment"] == \
+                "beta"
+        finally:
+            await ca.shutdown()
+            await cb.shutdown()
+            await srv.shutdown()
+
+    async def test_segment_http_surface(self):
+        from test_http_dns import dev_stack, http_call
+
+        async with dev_stack() as (_agent, addr, _dns, _dns_addr):
+            st, _, segs = await http_call(addr, "GET", "/v1/agent/segments")
+            assert st == 200 and segs == [""]
+            st, _, _x = await http_call(
+                addr, "GET", "/v1/agent/members?segment=nope")
+            assert st == 404
