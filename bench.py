@@ -19,6 +19,11 @@ The ``extra`` field carries the honest companions VERDICT r1 asked for:
                             broadcast to reach 99% infection
                             (BASELINE config 3 scaled 10x) + its wall_s
   nodes_per_chip            population per device at the headline run
+  fp_rate_1M / flaps_1M     Lifeguard accuracy A/B (sim/scenarios.py
+                            degraded1m at reduced tick count): the 1M
+                            false-positive suspicion rate and
+                            incarnation-flap count with Lifeguard ON,
+                            plus the _off twins and the reduction ratio
 
 vs_baseline: speedup over the real protocol's wall-clock rate — a real
 WAN-profile cluster advances one gossip round per GossipInterval
@@ -96,6 +101,27 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report the miss, keep headline
         membership = {"membership_sparse_error": str(e)[:200]}
 
+    # Lifeguard accuracy A/B at the headline scale: degraded1m (2%
+    # degraded members, WAN ack tail) at a reduced tick count so bench
+    # wall time stays bounded — the FP-rate question only needs enough
+    # probe cycles for the on/off split, not dead-propagation horizons.
+    try:
+        from consul_tpu.sim.scenarios import degraded1m
+
+        lg = degraded1m(seed=0, steps=160)
+        lifeguard = {
+            "fp_rate_1M": round(lg["fp_rate_on"], 4),
+            "fp_rate_1M_off": round(lg["fp_rate_off"], 4),
+            "fp_reduction_1M": (
+                round(lg["fp_reduction"], 4)
+                if lg["fp_reduction"] is not None else None
+            ),
+            "flaps_1M": lg["flaps_on"],
+            "flaps_1M_off": lg["flaps_off"],
+        }
+    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
+        lifeguard = {"lifeguard_error": str(e)[:200]}
+
     # Host-plane KV/HTTP throughput vs the reference's published numbers
     # (bench/results-0.7.1.md: 3,780 PUT/s, 9,774 stale GET/s).  Run in
     # a clean subprocess: the host plane never touches JAX, and this
@@ -137,6 +163,7 @@ def main() -> None:
                     # The headline scan is unsharded: the whole 1M-node
                     # population lives and steps on ONE chip.
                     "nodes_per_chip": N,
+                    **lifeguard,
                     **membership,
                     **kv,
                 },

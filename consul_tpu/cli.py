@@ -194,6 +194,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("dst", nargs="?", default="")
     sp.add_argument("-deny", action="store_true")
 
+    # simulator -----------------------------------------------------------
+    sp = sub.add_parser(
+        "sim", help="run a TPU-simulator scenario preset"
+    )
+    sp.set_defaults(fn=cmd_sim)
+    sp.add_argument("scenario", nargs="?", default="",
+                    help="preset name (see --list)")
+    sp.add_argument("--list", action="store_true", dest="list_scenarios",
+                    help="enumerate scenario presets and exit")
+    sp.add_argument("-seed", type=int, default=0)
+
     sub.add_parser("version").set_defaults(fn=cmd_version)
     return p
 
@@ -935,6 +946,26 @@ async def cmd_intention(args) -> int:
             return 0
     print("Error: no such intention", file=sys.stderr)
     return 1
+
+
+async def cmd_sim(args) -> int:
+    """Run (or enumerate) the simulator's scenario presets — the only
+    CLI command that touches JAX, so the import stays local and every
+    other subcommand remains accelerator-free."""
+    from consul_tpu.sim.scenarios import SCENARIOS, run_scenario
+
+    if args.list_scenarios:
+        for name in sorted(SCENARIOS):
+            doc = (SCENARIOS[name].__doc__ or "").strip().splitlines()
+            first = doc[0].strip() if doc else ""
+            print(f"{name:<12} {first}")
+        return 0
+    if not args.scenario:
+        print("Error: scenario name required (or --list)", file=sys.stderr)
+        return 1
+    out = run_scenario(args.scenario, seed=args.seed)
+    print(json.dumps(out, indent=2, default=str))
+    return 0
 
 
 async def cmd_version(args) -> int:

@@ -45,6 +45,12 @@ from consul_tpu.models.swim import (
     VIEW_SUSPECT,
     VIEW_DEAD,
 )
+from consul_tpu.models.lifeguard import (
+    LifeguardConfig,
+    LifeguardState,
+    lifeguard_init,
+    lifeguard_round,
+)
 from consul_tpu.models.vivaldi import (
     VivaldiConfig,
     VivaldiState,
@@ -82,6 +88,10 @@ __all__ = [
     "SwimState",
     "swim_init",
     "swim_round",
+    "LifeguardConfig",
+    "LifeguardState",
+    "lifeguard_init",
+    "lifeguard_round",
     "VIEW_ALIVE",
     "VIEW_SUSPECT",
     "VIEW_DEAD",

@@ -1,0 +1,399 @@
+"""Lifeguard local-health (NHM) layer on the SWIM model, fault-aware.
+
+"Local Health Awareness for More Accurate Failure Detection"
+(PAPERS.md; shipped as memberlist's awareness/NHM code) observes that
+most false-positive suspicions are caused by the *observer* being slow
+or degraded, not the subject being dead — so every node keeps a Local
+Health Multiplier (``awareness``, 0 = healthy) and trades detection
+latency for accuracy when its own health is poor:
+
+  * probe timeouts scale by ``score + 1`` (awareness.go:60-69
+    ScaleTimeout) — a failed probe matures into suspicion later;
+  * suspicion minimum timeouts scale the same way (LHA-Suspicion) — a
+    degraded observer waits longer before declaring dead;
+  * the score moves on *evidence about the local node*: an acked probe
+    lowers it; a failed probe raises it only by the number of MISSING
+    nacks from the indirect-probe relays (a relay's NACK proves our own
+    links work, state.go probeNode awarenessDelta); being refuted (we
+    accused a live node) raises it.
+
+This module extends :mod:`consul_tpu.models.swim` — same state machine
+(the merge rules are literally shared via ``swim._merge_deliveries``),
+same single-subject universe — with two additions:
+
+  1. ``lifeguard`` on/off: off freezes awareness at 0, reducing every
+     scaled quantity to the plain SWIM value, so a study isolates
+     exactly the Lifeguard mechanism (the FP-rate A/B the acceptance
+     criteria bind);
+  2. a :class:`consul_tpu.sim.faults.FaultSchedule`: piecewise loss,
+     partitions, degraded-member sets and churn windows evaluated as
+     pure functions of ``(tick, key)`` — the whole faulted study stays
+     one XLA program.
+
+Timeout math is shared with the host plane through
+``consul_tpu.protocol.formulas`` (awareness_scaled_timeout,
+awareness_probe_delta) — no duplicated constants; parity is pinned by
+tests/test_lifeguard.py.
+
+Fault approximations (documented, tested distributionally):
+
+  * degraded nodes drop on their *sends* — their acks and nacks are
+    sends too, which is what starves a degraded prober of nacks and
+    drives its score up;
+  * indirect-probe relays are drawn from the whole population, so relay
+    link quality enters as the population-mean send survival;
+  * a partitioned 4-leg indirect path crosses the cut twice (out and
+    back), so its survival carries ``(1 - severity)^2``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.models.swim import (
+    NEVER,
+    NO_MSG,
+    SwimConfig,
+    SwimState,
+    VIEW_ALIVE,
+    VIEW_DEAD,
+    VIEW_SUSPECT,
+    _lifeguard_timeout_ticks,
+    _merge_deliveries,
+    swim_init,
+)
+from consul_tpu.ops import (
+    deliver_max,
+    poissonized_arrivals,
+    sample_peers,
+    sample_probe_targets,
+)
+from consul_tpu.protocol.formulas import awareness_scaled_timeout
+from consul_tpu.sim.faults import (
+    FaultSchedule,
+    combine_loss,
+    degraded_late,
+    degraded_send_ok,
+    edge_block_prob,
+    extra_loss_at,
+    online_mask,
+    partition_severity_at,
+    segment_ids,
+)
+
+LifeguardState = SwimState  # same carry; awareness is already a field
+
+
+@dataclasses.dataclass(frozen=True)
+class LifeguardConfig(SwimConfig):
+    """SwimConfig + the Lifeguard switch and a fault schedule.
+
+    ``subject_alive=True`` (a false-positive study) is the natural mode
+    here; crash studies (``subject_alive=False`` + ``fail_at_tick``)
+    measure time-to-true-dead under the same faults.
+
+    ``ack_late`` is the cluster-wide probability that a live target's
+    ack lands past the UNSCALED probe window (WAN tail latency / GC
+    pauses — the Lifeguard paper's motivating environment).  A late ack
+    is a probe failure to a score-0 observer but a success to one whose
+    NHM has stretched its window (score >= 1); degraded members add
+    their own ``DegradedSet.late`` on top.
+    """
+
+    lifeguard: bool = True
+    ack_late: float = 0.0
+    faults: FaultSchedule = FaultSchedule()
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.delivery == "aggregate" and len(self.faults.partitions) > 1:
+            # Poissonized arrivals decompose into per-segment sums for
+            # one cut; stacked cuts need the exact edges path.
+            raise ValueError(
+                "aggregate delivery supports at most one Partition; "
+                "use delivery='edges' for stacked partitions"
+            )
+
+
+def lifeguard_init(cfg: LifeguardConfig) -> LifeguardState:
+    return swim_init(cfg)
+
+
+def lifeguard_round(
+    state: LifeguardState, key: jax.Array, cfg: LifeguardConfig
+) -> LifeguardState:
+    n, f = cfg.n, cfg.subject
+    t = state.tick
+    k_gossip, k_loss, k_probe, k_pfail, k_aware, k_nack, k_churn = (
+        jax.random.split(key, 7)
+    )
+
+    # Fault environment this tick (all pure in (tick, key)).
+    loss_t = combine_loss(
+        jnp.float32(cfg.loss), extra_loss_at(cfg.faults, t)
+    )                                             # f32 scalar
+    send_ok = degraded_send_ok(cfg.faults, n)     # f32[n], folds to const
+    online = online_mask(cfg.faults, k_churn, t, n)
+
+    subject_dead_now = jnp.logical_and(
+        jnp.logical_not(cfg.subject_alive), t >= cfg.fail_at_tick
+    )
+    is_subject = jnp.arange(n, dtype=jnp.int32) == f
+    not_subject = jnp.logical_not(is_subject)
+    # A crashed subject is gone for good; churned-off nodes sit out one
+    # tick (neither send, receive, nor probe) and come back.
+    participates = jnp.where(is_subject & subject_dead_now, False, online)
+    can_send = participates
+
+    # ------------------------------------------------------------------
+    # 1. Gossip fan-out under the fault environment.
+    # ------------------------------------------------------------------
+    if cfg.delivery == "edges":
+        targets = sample_peers(k_gossip, n, cfg.fanout)          # [n, F]
+        src = jnp.arange(n, dtype=jnp.int32)[:, None]
+        p_edge = (
+            (1.0 - loss_t)
+            * send_ok[:, None]
+            * (1.0 - edge_block_prob(cfg.faults, t, src, targets, n))
+        )
+        wire_ok = jax.random.uniform(k_loss, (n, cfg.fanout)) < p_edge
+        wire_ok = wire_ok & jnp.take(participates, targets)
+
+        def rx_era(kcls, tx_left, era):
+            send = can_send & (tx_left > 0)
+            delivered = send[:, None] & wire_ok
+            vals = jnp.broadcast_to(era[:, None], (n, cfg.fanout))
+            return deliver_max(
+                jnp.full((n,), NO_MSG, jnp.int32), targets, vals, delivered
+            )
+
+        sus_rx = rx_era(None, state.tx_suspect, state.sus_era)
+        dead_rx = rx_era(None, state.tx_dead, state.dead_era)
+        ref_rx = rx_era(None, state.tx_refute, state.ref_era)
+    else:
+        # Weighted Poissonized arrivals: each sender's copies survive
+        # with its own probability, each receiver sums the reachable
+        # weight (partition-adjusted via per-segment sums — one scalar
+        # reduction per segment, no scatters).
+        k_sus, k_dead, k_ref = jax.random.split(k_gossip, 3)
+
+        def rx_era(kcls, tx_left, era):
+            send = can_send & (tx_left > 0)
+            w = send.astype(jnp.float32) * send_ok * (1.0 - loss_t)
+            if cfg.faults.partitions:
+                part = cfg.faults.partitions[0]
+                seg = segment_ids(part, n)
+                sev = partition_severity_at(part, t)
+                seg_sum = jnp.zeros(
+                    (part.segments,), jnp.float32
+                ).at[seg].add(w)
+                same = seg_sum[seg]
+                reach = (same - w) + (1.0 - sev) * (jnp.sum(w) - same)
+            else:
+                reach = jnp.sum(w) - w
+            lam = jnp.where(
+                participates,
+                cfg.fanout * reach / max(n - 1, 1),
+                0.0,
+            )
+            got = poissonized_arrivals(kcls, lam) & participates
+            newest = jnp.max(jnp.where(send, era, NO_MSG))
+            return jnp.where(got, newest, NO_MSG)
+
+        sus_rx = rx_era(k_sus, state.tx_suspect, state.sus_era)
+        dead_rx = rx_era(k_dead, state.tx_dead, state.dead_era)
+        ref_rx = rx_era(k_ref, state.tx_refute, state.ref_era)
+
+    def spend(tx_left):
+        send = can_send & (tx_left > 0)
+        return jnp.maximum(tx_left - jnp.where(send, cfg.fanout, 0), 0)
+
+    tx_suspect = spend(state.tx_suspect)
+    tx_dead = spend(state.tx_dead)
+    tx_refute = spend(state.tx_refute)
+
+    # ------------------------------------------------------------------
+    # 2. Incarnation-ordered merge rules — shared with the SWIM model.
+    # ------------------------------------------------------------------
+    (
+        view, inc_seen, suspect_since, confirmations,
+        tx_suspect, sus_era, tx_dead, dead_era, tx_refute, ref_era,
+        subject_inc, refute_now,
+    ) = _merge_deliveries(
+        cfg, t, state, sus_rx, dead_rx, ref_rx,
+        tx_suspect, tx_dead, tx_refute, not_subject,
+    )
+
+    # ------------------------------------------------------------------
+    # 3. Probe plane with NHM accounting.
+    # ------------------------------------------------------------------
+    is_probe_tick = (t % cfg.probe_interval_ticks) == 0
+    probe_target = sample_probe_targets(k_probe, n)
+    probed_f = (
+        (probe_target == f) & can_send & not_subject & (view != VIEW_DEAD)
+    )
+
+    ok1 = 1.0 - loss_t                       # one generic wire leg
+    mean_ok = jnp.mean(send_ok)              # relay-population quality
+    send_ok_f = send_ok[f]
+    block_if = edge_block_prob(
+        cfg.faults, t, jnp.arange(n, dtype=jnp.int32), jnp.int32(f), n
+    )                                        # f32[n], prober<->subject cut
+    # Direct round trip: i's ping leg, f's ack leg, each crossing the
+    # cut once (state.go:326-380).
+    leg_out = ok1 * send_ok * (1.0 - block_if)
+    leg_back = ok1 * send_ok_f * (1.0 - block_if)
+    p_direct_fail = 1.0 - leg_out * leg_back
+    # Indirect 4-leg path i->r->f->r->i (state.go:397-426): relay legs
+    # at population-mean quality; the path crosses the cut twice.
+    ind_ok = (
+        (ok1 * send_ok) * (ok1 * mean_ok)
+        * (ok1 * send_ok_f) * (ok1 * mean_ok)
+        * (1.0 - block_if) ** 2
+    )
+    p_fail_subject = p_direct_fail * (
+        (1.0 - ind_ok) ** cfg.profile.indirect_checks
+    )
+    subject_gone = subject_dead_now | jnp.logical_not(online[f])
+    p_fail_subject = jnp.where(subject_gone, 1.0, p_fail_subject)
+
+    # Late acks: the ack exists but lands past the unscaled probe
+    # window (slow local processing / tail latency).  To an observer
+    # whose NHM already stretched its window (score >= 1) the late ack
+    # still counts — this rescue is the accuracy Lifeguard buys; to a
+    # score-0 observer (and always with lifeguard off) it is a failure.
+    k_hard, k_late = jax.random.split(k_pfail)
+    p_late = combine_loss(
+        jnp.float32(cfg.ack_late), degraded_late(cfg.faults, n)
+    )
+    ack_is_late = jax.random.uniform(k_late, (n,)) < p_late
+    rescued = jnp.bool_(cfg.lifeguard) & (state.awareness >= 1)
+    late_fail = ack_is_late & jnp.logical_not(rescued)
+
+    hard_fail_subject = (
+        jax.random.uniform(k_hard, (n,)) < p_fail_subject
+    )
+    probe_failed = (
+        probed_f
+        & (hard_fail_subject | (late_fail & jnp.logical_not(subject_gone)))
+        & is_probe_tick
+    )
+
+    # Failed probes mature into suspicion at the end of a probe cycle
+    # whose whole deadline scales with the prober's health going INTO
+    # the probe: probeNode starts with
+    # ``probeInterval = awareness.ScaleTimeout(config.ProbeInterval)``
+    # (state.go:283-300), i.e. a degraded observer gives the target
+    # (score + 1) full intervals to answer before accusing it.
+    cycle = jnp.where(
+        jnp.bool_(cfg.lifeguard),
+        awareness_scaled_timeout(
+            jnp.int32(cfg.probe_interval_ticks), state.awareness
+        ),
+        cfg.probe_interval_ticks,
+    )
+    matures_at = t + cycle
+    probe_pending_at = jnp.where(
+        probe_failed & (state.probe_pending_at == NEVER),
+        matures_at,
+        state.probe_pending_at,
+    )
+
+    # Probes of OTHER (generic live) targets drive awareness too: the
+    # target's send quality enters at the population mean.
+    probing_any = is_probe_tick & can_send & not_subject
+    p_fail_other = (1.0 - (ok1 * send_ok) * (ok1 * mean_ok)) * (
+        1.0 - (ok1 * send_ok) * (ok1 * mean_ok) ** 3
+    ) ** cfg.profile.indirect_checks
+    other_failed = (
+        probing_any
+        & ~probed_f
+        & ((jax.random.uniform(k_aware, (n,)) < p_fail_other) | late_fail)
+    )
+    any_failed = probe_failed | other_failed
+
+    # NACK accounting (awareness_probe_delta, vectorized): each of the
+    # k relays' NACK comes back iff the request leg i->r and the nack
+    # leg r->i both survive — independent of the target entirely.  A
+    # node in a late-processing episode misses its nacks exactly like
+    # its ack (the slowness is local), so a late failure is charged the
+    # full k missing nacks — the "we might be the problem" signal NHM
+    # is built on.
+    k_ind = cfg.profile.indirect_checks
+    p_nack = (ok1 * send_ok) * (ok1 * mean_ok)
+    nacks = jnp.sum(
+        jax.random.uniform(k_nack, (n, max(k_ind, 1))) < p_nack[:, None],
+        axis=1,
+        dtype=jnp.int32,
+    )
+    nacks = jnp.where(ack_is_late, 0, nacks)
+    if k_ind > 0:
+        fail_delta = jnp.maximum(k_ind - nacks, 0)
+    else:
+        fail_delta = jnp.ones((n,), jnp.int32)
+    delta = jnp.where(
+        any_failed,
+        fail_delta,
+        jnp.where(probing_any, -1, 0),
+    )
+    # Being refuted costs the accused-but-alive subject a health point
+    # (state.go:880-915 refute -> ApplyDelta(1)).
+    delta = delta.at[f].add(jnp.where(refute_now, 1, 0))
+    awareness = jnp.clip(
+        state.awareness + delta, 0, cfg.profile.awareness_max_multiplier - 1
+    )
+    if not cfg.lifeguard:
+        awareness = jnp.zeros_like(awareness)
+
+    # Mature pending probes -> suspicion at the current incarnation.
+    maturing = (probe_pending_at <= t) & (view == VIEW_ALIVE)
+    view = jnp.where(maturing, VIEW_SUSPECT, view)
+    suspect_since = jnp.where(maturing, t, suspect_since)
+    tx_suspect = jnp.where(maturing, cfg.tx_limit, tx_suspect)
+    sus_era = jnp.where(maturing, inc_seen, sus_era)
+    probe_pending_at = jnp.where(
+        probe_pending_at <= t, NEVER, probe_pending_at
+    )
+
+    # ------------------------------------------------------------------
+    # 4. Suspicion expiry with the LHA-scaled minimum: a degraded
+    #    observer's floor rises to min * (score + 1) (shared formula).
+    # ------------------------------------------------------------------
+    timeout_ticks = _lifeguard_timeout_ticks(cfg, confirmations)
+    if cfg.lifeguard:
+        lo, _hi = cfg.suspicion_bounds_ticks
+        timeout_ticks = jnp.maximum(
+            timeout_ticks,
+            awareness_scaled_timeout(
+                jnp.float32(lo), awareness.astype(jnp.float32)
+            ),
+        )
+    elapsed = (t - suspect_since).astype(jnp.float32)
+    expire = (view == VIEW_SUSPECT) & (suspect_since != NEVER) & (
+        elapsed >= timeout_ticks
+    )
+    view = jnp.where(expire, VIEW_DEAD, view)
+    suspect_since = jnp.where(expire, NEVER, suspect_since)
+    tx_dead = jnp.where(expire, cfg.tx_limit, tx_dead)
+    dead_era = jnp.where(expire, inc_seen, dead_era)
+    tx_suspect = jnp.where(expire, 0, tx_suspect)  # queue invalidation
+
+    return LifeguardState(
+        view=view,
+        inc_seen=inc_seen,
+        suspect_since=suspect_since,
+        confirmations=confirmations,
+        tx_suspect=tx_suspect,
+        sus_era=sus_era,
+        tx_dead=tx_dead,
+        dead_era=dead_era,
+        tx_refute=tx_refute,
+        ref_era=ref_era,
+        probe_pending_at=probe_pending_at,
+        awareness=awareness,
+        subject_inc=subject_inc,
+        tick=t + 1,
+    )

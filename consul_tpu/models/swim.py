@@ -203,6 +203,118 @@ def _lifeguard_timeout_ticks(cfg: SwimConfig, confirmations: jax.Array) -> jax.A
     return jnp.maximum(jnp.ceil(raw), lo)
 
 
+def _merge_deliveries(
+    cfg: SwimConfig,
+    t: jax.Array,
+    state: SwimState,
+    sus_rx: jax.Array,
+    dead_rx: jax.Array,
+    ref_rx: jax.Array,
+    tx_suspect: jax.Array,
+    tx_dead: jax.Array,
+    tx_refute: jax.Array,
+    not_subject: jax.Array,
+):
+    """Apply one tick's deliveries under the incarnation-ordered merge
+    rules (the state-machine core shared verbatim by the SWIM and
+    Lifeguard models; rule sources in the module docstring).
+
+    Returns (view, inc_seen, suspect_since, confirmations, tx_suspect,
+    sus_era, tx_dead, dead_era, tx_refute, ref_era, subject_inc,
+    refute_now).
+    """
+    f = cfg.subject
+    view, inc_seen = state.view, state.inc_seen
+    suspect_since, confirmations = state.suspect_since, state.confirmations
+    sus_era, dead_era, ref_era = state.sus_era, state.dead_era, state.ref_era
+
+    # Suspect msgs: ignored below the receiver's incarnation
+    # (state.go:1145-1148).  New-to-us while ALIVE -> SUSPECT at the
+    # message's incarnation, start Lifeguard timer, re-gossip
+    # (state.go:1134-1217).  The subject itself never becomes suspect of
+    # itself — it refutes instead (state.go:1166-1170).
+    got_suspect = sus_rx >= jnp.maximum(inc_seen, 0)
+    fresh_suspect = got_suspect & (view == VIEW_ALIVE) & not_subject
+    # Already-suspect: confirmations accumulate toward k, and new
+    # confirmations are re-gossiped (suspicion.go Confirm -> broadcast).
+    # Lifeguard counts *distinct* confirmers (suspicion.go:40-44 keys by
+    # From, and re-gossiped suspect msgs keep their original From); we
+    # approximate distinctness by counting at most one confirmation per
+    # tick — a given origin suspector transmits to any one receiver at
+    # most ~once per tick, and with many circulating origins a repeat
+    # from the same origin across ticks is O(1/origins) likely.
+    confirming = got_suspect & (view == VIEW_SUSPECT)
+    new_conf = jnp.minimum(
+        confirmations + confirming.astype(jnp.int32), cfg.confirmations_k
+    )
+    gained_conf = confirming & (new_conf > confirmations)
+    confirmations = new_conf
+
+    view = jnp.where(fresh_suspect, VIEW_SUSPECT, view)
+    inc_seen = jnp.where(fresh_suspect, sus_rx, inc_seen)
+    suspect_since = jnp.where(fresh_suspect, t, suspect_since)
+    rebroadcast_sus = fresh_suspect | gained_conf
+    tx_suspect = jnp.where(rebroadcast_sus, cfg.tx_limit, tx_suspect)
+    sus_era = jnp.where(rebroadcast_sus, jnp.maximum(sus_era, sus_rx), sus_era)
+
+    # The subject refutes every suspect/dead message about itself while
+    # alive with incarnation accused+1 (state.go:880-915 refute;
+    # 1166-1170, 1246-1251) — per message, not once, which is what
+    # guarantees eventual recovery of false-DEAD views and produces the
+    # recurring-suspicion "flapping" the reference exhibits under loss.
+    # "While alive" is dynamic: a crash-study subject refutes false
+    # accusations right up to its fail tick (with fail_at_tick=0 this
+    # reduces to the static flag).
+    subject_live_now = jnp.logical_or(
+        jnp.bool_(cfg.subject_alive), t < cfg.fail_at_tick
+    )
+    accused = jnp.maximum(sus_rx[f], dead_rx[f])
+    refute_now = subject_live_now & (accused >= state.subject_inc)
+    subject_inc = jnp.where(refute_now, accused + 1, state.subject_inc)
+    tx_refute = tx_refute.at[f].set(
+        jnp.where(refute_now, cfg.tx_limit, tx_refute[f])
+    )
+    ref_era = ref_era.at[f].set(
+        jnp.where(refute_now, subject_inc, ref_era[f])
+    )
+
+    # Refute (alive) deliveries: an alive message with a strictly higher
+    # incarnation overrides any view — including DEAD (aliveNode
+    # resurrects when a.Incarnation > state.Incarnation, state.go:917+).
+    accept_refute = ref_rx > inc_seen
+    view = jnp.where(accept_refute, VIEW_ALIVE, view)
+    inc_seen = jnp.where(accept_refute, ref_rx, inc_seen)
+    suspect_since = jnp.where(accept_refute, NEVER, suspect_since)
+    confirmations = jnp.where(accept_refute, 0, confirmations)
+    tx_refute = jnp.where(accept_refute, cfg.tx_limit, tx_refute)
+    ref_era = jnp.where(accept_refute, ref_rx, ref_era)
+    # Queueing the alive rebroadcast invalidates queued suspect/dead
+    # broadcasts for the same node (TransmitLimitedQueue name-keyed
+    # replacement, memberlist/queue.go).
+    tx_suspect = jnp.where(accept_refute, 0, tx_suspect)
+    tx_dead = jnp.where(accept_refute, 0, tx_dead)
+
+    # Dead deliveries: dead overrides suspect/alive at >= the receiver's
+    # incarnation (deadNode ignores lower incarnations, state.go:1228-1232),
+    # so a stale dead loses to a higher-incarnation refuted-alive view.
+    accept_dead = (dead_rx >= inc_seen) & (view != VIEW_DEAD)
+    # A live subject refutes its own obituary instead of accepting it.
+    accept_dead = accept_dead & (not_subject | ~subject_live_now)
+    view = jnp.where(accept_dead, VIEW_DEAD, view)
+    inc_seen = jnp.where(accept_dead, dead_rx, inc_seen)
+    suspect_since = jnp.where(accept_dead, NEVER, suspect_since)
+    tx_dead = jnp.where(accept_dead, cfg.tx_limit, tx_dead)
+    dead_era = jnp.where(accept_dead, dead_rx, dead_era)
+    # Dead supersedes the queued suspect broadcast (queue invalidation).
+    tx_suspect = jnp.where(accept_dead, 0, tx_suspect)
+
+    return (
+        view, inc_seen, suspect_since, confirmations,
+        tx_suspect, sus_era, tx_dead, dead_era, tx_refute, ref_era,
+        subject_inc, refute_now,
+    )
+
+
 def swim_round(state: SwimState, key: jax.Array, cfg: SwimConfig) -> SwimState:
     n, f = cfg.n, cfg.subject
     t = state.tick
@@ -270,90 +382,19 @@ def swim_round(state: SwimState, key: jax.Array, cfg: SwimConfig) -> SwimState:
     tx_suspect = spend(state.tx_suspect)
     tx_dead = spend(state.tx_dead)
     tx_refute = spend(state.tx_refute)
-    sus_era, dead_era, ref_era = state.sus_era, state.dead_era, state.ref_era
 
     # ------------------------------------------------------------------
-    # 2. Apply deliveries (incarnation-ordered merge rules).
+    # 2. Apply deliveries (incarnation-ordered merge rules, shared with
+    #    the Lifeguard model — see _merge_deliveries).
     # ------------------------------------------------------------------
-    view, inc_seen = state.view, state.inc_seen
-    suspect_since, confirmations = state.suspect_since, state.confirmations
-
-    # Suspect msgs: ignored below the receiver's incarnation
-    # (state.go:1145-1148).  New-to-us while ALIVE -> SUSPECT at the
-    # message's incarnation, start Lifeguard timer, re-gossip
-    # (state.go:1134-1217).  The subject itself never becomes suspect of
-    # itself — it refutes instead (state.go:1166-1170).
-    got_suspect = sus_rx >= jnp.maximum(inc_seen, 0)
-    fresh_suspect = got_suspect & (view == VIEW_ALIVE) & not_subject
-    # Already-suspect: confirmations accumulate toward k, and new
-    # confirmations are re-gossiped (suspicion.go Confirm -> broadcast).
-    # Lifeguard counts *distinct* confirmers (suspicion.go:40-44 keys by
-    # From, and re-gossiped suspect msgs keep their original From); we
-    # approximate distinctness by counting at most one confirmation per
-    # tick — a given origin suspector transmits to any one receiver at
-    # most ~once per tick, and with many circulating origins a repeat
-    # from the same origin across ticks is O(1/origins) likely.
-    confirming = got_suspect & (view == VIEW_SUSPECT)
-    new_conf = jnp.minimum(
-        confirmations + confirming.astype(jnp.int32), cfg.confirmations_k
+    (
+        view, inc_seen, suspect_since, confirmations,
+        tx_suspect, sus_era, tx_dead, dead_era, tx_refute, ref_era,
+        subject_inc, _refute_now,
+    ) = _merge_deliveries(
+        cfg, t, state, sus_rx, dead_rx, ref_rx,
+        tx_suspect, tx_dead, tx_refute, not_subject,
     )
-    gained_conf = confirming & (new_conf > confirmations)
-    confirmations = new_conf
-
-    view = jnp.where(fresh_suspect, VIEW_SUSPECT, view)
-    inc_seen = jnp.where(fresh_suspect, sus_rx, inc_seen)
-    suspect_since = jnp.where(fresh_suspect, t, suspect_since)
-    rebroadcast_sus = fresh_suspect | gained_conf
-    tx_suspect = jnp.where(rebroadcast_sus, cfg.tx_limit, tx_suspect)
-    sus_era = jnp.where(rebroadcast_sus, jnp.maximum(sus_era, sus_rx), sus_era)
-
-    # The subject refutes every suspect/dead message about itself while
-    # alive with incarnation accused+1 (state.go:880-915 refute;
-    # 1166-1170, 1246-1251) — per message, not once, which is what
-    # guarantees eventual recovery of false-DEAD views and produces the
-    # recurring-suspicion "flapping" the reference exhibits under loss.
-    accused = jnp.maximum(sus_rx[f], dead_rx[f])
-    refute_now = (
-        jnp.bool_(cfg.subject_alive) & (accused >= state.subject_inc)
-    )
-    subject_inc = jnp.where(refute_now, accused + 1, state.subject_inc)
-    tx_refute = tx_refute.at[f].set(
-        jnp.where(refute_now, cfg.tx_limit, tx_refute[f])
-    )
-    ref_era = ref_era.at[f].set(
-        jnp.where(refute_now, subject_inc, ref_era[f])
-    )
-
-    # Refute (alive) deliveries: an alive message with a strictly higher
-    # incarnation overrides any view — including DEAD (aliveNode
-    # resurrects when a.Incarnation > state.Incarnation, state.go:917+).
-    accept_refute = ref_rx > inc_seen
-    view = jnp.where(accept_refute, VIEW_ALIVE, view)
-    inc_seen = jnp.where(accept_refute, ref_rx, inc_seen)
-    suspect_since = jnp.where(accept_refute, NEVER, suspect_since)
-    confirmations = jnp.where(accept_refute, 0, confirmations)
-    tx_refute = jnp.where(accept_refute, cfg.tx_limit, tx_refute)
-    ref_era = jnp.where(accept_refute, ref_rx, ref_era)
-    # Queueing the alive rebroadcast invalidates queued suspect/dead
-    # broadcasts for the same node (TransmitLimitedQueue name-keyed
-    # replacement, memberlist/queue.go).
-    tx_suspect = jnp.where(accept_refute, 0, tx_suspect)
-    tx_dead = jnp.where(accept_refute, 0, tx_dead)
-
-    # Dead deliveries: dead overrides suspect/alive at >= the receiver's
-    # incarnation (deadNode ignores lower incarnations, state.go:1228-1232),
-    # so a stale dead loses to a higher-incarnation refuted-alive view.
-    accept_dead = (dead_rx >= inc_seen) & (view != VIEW_DEAD)
-    if cfg.subject_alive:
-        # A live subject refutes its own obituary instead of accepting it.
-        accept_dead = accept_dead & not_subject
-    view = jnp.where(accept_dead, VIEW_DEAD, view)
-    inc_seen = jnp.where(accept_dead, dead_rx, inc_seen)
-    suspect_since = jnp.where(accept_dead, NEVER, suspect_since)
-    tx_dead = jnp.where(accept_dead, cfg.tx_limit, tx_dead)
-    dead_era = jnp.where(accept_dead, dead_rx, dead_era)
-    # Dead supersedes the queued suspect broadcast (queue invalidation).
-    tx_suspect = jnp.where(accept_dead, 0, tx_suspect)
 
     # ------------------------------------------------------------------
     # 3. Probe plane (every ProbeInterval ticks).

@@ -44,6 +44,9 @@ from consul_tpu.net.transport import Stream, Transport
 from consul_tpu.protocol import (
     GossipProfile,
     LAN,
+    awareness_clamp,
+    awareness_probe_delta,
+    awareness_scaled_timeout,
     push_pull_scale,
     suspicion_timeout,
 )
@@ -112,20 +115,22 @@ class MemberlistConfig:
 
 class _Awareness:
     """Lifeguard node health score (awareness.go:14-69): 0 = healthy;
-    each missed ack raises it, each success lowers it; probe timeouts
-    scale by (score + 1)."""
+    each missed ack/nack raises it, each success lowers it; probe
+    timeouts scale by (score + 1).  The clamp and scaling math are the
+    shared ``consul_tpu.protocol`` formulas — the exact numbers the TPU
+    model (models/lifeguard.py) computes."""
 
     def __init__(self, max_mult: int):
         self._max = max_mult
         self.score = 0
 
     def apply_delta(self, delta: int) -> None:
-        self.score = min(max(self.score + delta, 0), self._max - 1)
+        self.score = awareness_clamp(self.score + delta, self._max)
         # awareness.go:50 emits the health score on every change.
         metrics().set_gauge("memberlist.health.score", self.score)
 
     def scale_timeout(self, timeout: float) -> float:
-        return timeout * (self.score + 1)
+        return awareness_scaled_timeout(timeout, self.score)
 
 
 class Memberlist:
@@ -140,6 +145,7 @@ class Memberlist:
         )
         self._suspicions: dict[str, Suspicion] = {}
         self._ack_waiters: dict[int, asyncio.Future] = {}
+        self._nack_counts: dict[int, int] = {}
         self._seq = 0
         self._probe_ring: list[str] = []
         self._tasks: list[asyncio.Task] = []
@@ -311,7 +317,7 @@ class Memberlist:
         elif msg_type == wire.MessageType.ACK_RESP:
             self._on_ack(body)
         elif msg_type == wire.MessageType.NACK_RESP:
-            pass  # only used for awareness on the sender side
+            self._on_nack(body)
         elif msg_type == wire.MessageType.SUSPECT:
             self._suspect_node(body)
         elif msg_type == wire.MessageType.ALIVE:
@@ -369,6 +375,15 @@ class Memberlist:
         fut = self._ack_waiters.get(body["seq"])
         if fut and not fut.done():
             fut.set_result((time.monotonic(), body))
+
+    def _on_nack(self, body) -> None:
+        """A relay answered our indirect probe with a NACK: the target
+        is unresponsive but OUR links work — counted so the failed
+        probe's health penalty only charges the missing nacks
+        (state.go probeNode awarenessDelta)."""
+        seq = body.get("seq")
+        if seq in self._nack_counts:
+            self._nack_counts[seq] += 1
 
     # ------------------------------------------------------------------
     # probe plane (state.go:214-497)
@@ -428,6 +443,7 @@ class Memberlist:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._ack_waiters[seq] = fut
         sent_at = time.monotonic()
+        indirect_seq = None
         try:
             await self._send_msg(
                 node.addr,
@@ -437,7 +453,7 @@ class Memberlist:
             try:
                 _ts, ack = await asyncio.wait_for(fut, timeout)
                 rtt = time.monotonic() - sent_at
-                self.awareness.apply_delta(-1)
+                self.awareness.apply_delta(awareness_probe_delta(True))
                 if self.config.notify_ping_complete:
                     self.config.notify_ping_complete(node, rtt, ack)
                 return
@@ -451,6 +467,7 @@ class Memberlist:
             indirect_seq = self._next_seq()
             ifut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._ack_waiters[indirect_seq] = ifut
+            self._nack_counts[indirect_seq] = 0
             for peer in peers:
                 await self._send_msg(
                     peer.addr,
@@ -472,7 +489,7 @@ class Memberlist:
             try:
                 await asyncio.wait_for(ifut, remaining)
                 fallback.cancel()
-                self.awareness.apply_delta(-1)
+                self.awareness.apply_delta(awareness_probe_delta(True))
                 return
             except asyncio.TimeoutError:
                 pass
@@ -484,8 +501,16 @@ class Memberlist:
             except Exception:
                 pass
 
-            # No ack by any path: suspect (state.go:495-496).
-            self.awareness.apply_delta(1)
+            # No ack by any path: suspect (state.go:495-496), charging
+            # our health score only the nacks that did NOT come back —
+            # each received NACK proves our own links work.
+            self.awareness.apply_delta(
+                awareness_probe_delta(
+                    False,
+                    expected_nacks=len(peers),
+                    nacks=self._nack_counts.get(indirect_seq, 0),
+                )
+            )
             self._suspect_node(
                 {
                     "inc": node.incarnation,
@@ -495,6 +520,8 @@ class Memberlist:
             )
         finally:
             self._ack_waiters.pop(seq, None)
+            if indirect_seq is not None:
+                self._nack_counts.pop(indirect_seq, None)
 
     async def _tcp_fallback_ping(self, node: Node) -> bool:
         try:
@@ -819,8 +846,12 @@ class Memberlist:
                     }
                 )
 
+        # LHA-Suspicion: the minimum timeout scales with OUR health
+        # score (shared awareness_scaled_timeout inside Suspicion) —
+        # same math as the TPU model's expiry floor.
         self._suspicions[name] = Suspicion(
-            s["from"], k, min_s, max_s, on_timeout
+            s["from"], k, min_s, max_s, on_timeout,
+            health_score=self.awareness.score,
         )
 
     def _dead_node(self, d: dict) -> None:

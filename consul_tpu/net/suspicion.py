@@ -12,11 +12,21 @@ import asyncio
 import time
 from typing import Callable
 
-from consul_tpu.protocol import remaining_suspicion_timeout
+from consul_tpu.protocol import (
+    awareness_scaled_timeout,
+    remaining_suspicion_timeout,
+)
 
 
 class Suspicion:
-    """suspicion.go:50-130 newSuspicion/Confirm."""
+    """suspicion.go:50-130 newSuspicion/Confirm.
+
+    ``health_score`` is the local node's Lifeguard NHM at suspicion
+    start: the minimum timeout scales by ``score + 1`` (the same shared
+    ``awareness_scaled_timeout`` the TPU model applies), so a degraded
+    observer waits longer before converting a suspicion into an
+    obituary — LHA-Suspicion, the accuracy half of Lifeguard.
+    """
 
     def __init__(
         self,
@@ -25,15 +35,16 @@ class Suspicion:
         min_s: float,
         max_s: float,
         timeout_fn: Callable[[int], None],
+        health_score: int = 0,
     ):
         self.k = k
-        self.min_s = min_s
-        self.max_s = max_s
+        self.min_s = awareness_scaled_timeout(min_s, health_score)
+        self.max_s = max(max_s, self.min_s)
         self.confirmations = {from_node}  # the accuser doesn't confirm
         self.n = 0
         self._timeout_fn = timeout_fn
         self._start = time.monotonic()
-        timeout = min_s if k < 1 else max_s
+        timeout = self.min_s if k < 1 else self.max_s
         self._handle = asyncio.get_running_loop().call_later(
             timeout, self._fire
         )

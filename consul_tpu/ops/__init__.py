@@ -11,6 +11,7 @@ from consul_tpu.ops.sampling import (
     sample_probe_targets,
     bernoulli_mask,
     aggregate_arrivals,
+    poissonized_arrivals,
 )
 from consul_tpu.ops.scatter import (
     deliver_or,
@@ -22,6 +23,7 @@ __all__ = [
     "sample_probe_targets",
     "bernoulli_mask",
     "aggregate_arrivals",
+    "poissonized_arrivals",
     "deliver_or",
     "deliver_max",
 ]

@@ -84,3 +84,17 @@ def aggregate_arrivals(
         / max(n - 1, 1)
     )
     return jax.random.uniform(key, (n,)) < -jnp.expm1(-lam)
+
+
+def poissonized_arrivals(key: jax.Array, lam: jax.Array) -> jax.Array:
+    """bool per receiver: >= 1 arrival under Poisson(``lam``).
+
+    The generalization of :func:`aggregate_arrivals` for heterogeneous
+    senders/receivers (fault-injected studies, sim/faults.py): the
+    caller computes the per-receiver arrival intensity — e.g.
+    ``lam_j = recv_ok_j * fanout * (sum_i w_i - w_j) / (n - 1)`` with
+    ``w_i`` each sender's per-copy survival probability — and this
+    applies only P(>=1) = 1 - exp(-lam).  With uniform weights it
+    reduces exactly to :func:`aggregate_arrivals`.
+    """
+    return jax.random.uniform(key, lam.shape) < -jnp.expm1(-lam)

@@ -20,6 +20,9 @@ from consul_tpu.protocol.formulas import (
     retransmit_limit,
     push_pull_scale,
     scale_with_cluster_size,
+    awareness_scaled_timeout,
+    awareness_clamp,
+    awareness_probe_delta,
 )
 
 __all__ = [
@@ -34,4 +37,7 @@ __all__ = [
     "retransmit_limit",
     "push_pull_scale",
     "scale_with_cluster_size",
+    "awareness_scaled_timeout",
+    "awareness_clamp",
+    "awareness_probe_delta",
 ]

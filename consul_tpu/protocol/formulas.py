@@ -10,6 +10,8 @@ Sources in the reference:
   - push_pull_scale:      vendor/memberlist/util.go:89-97
   - remaining_suspicion_timeout: vendor/memberlist/suspicion.go:86-97
   - scale_with_cluster_size (anti-entropy): agent/ae/ae.go:25-38
+  - awareness_scaled_timeout: vendor/memberlist/awareness.go:60-69
+  - awareness_probe_delta:    vendor/memberlist/state.go:283-497 probeNode
 """
 
 from __future__ import annotations
@@ -70,6 +72,45 @@ def remaining_suspicion_timeout(
     raw = max_ms - frac * (max_ms - min_ms)
     timeout = math.floor(raw)  # reference floors at ms precision
     return max(timeout, min_ms)
+
+
+def awareness_scaled_timeout(timeout, score):
+    """Lifeguard NHM timeout scaling (awareness.go:60-69 ScaleTimeout):
+    a node with local health ``score`` waits ``score + 1`` times longer
+    before blaming a peer for a missed ack.  Pure arithmetic so the
+    same function serves host-plane floats and sim-plane jnp arrays —
+    the no-duplicated-constants requirement of the Lifeguard subsystem.
+    """
+    return timeout * (score + 1)
+
+
+def awareness_clamp(score: int, max_multiplier: int) -> int:
+    """awareness.go:30-42 ApplyDelta clamp: score in
+    [0, max_multiplier - 1]."""
+    return min(max(score, 0), max_multiplier - 1)
+
+
+def awareness_probe_delta(
+    success: bool, expected_nacks: int = 0, nacks: int = 0
+) -> int:
+    """Health-score delta of one probe cycle (state.go probeNode
+    awarenessDelta accounting, Lifeguard §4):
+
+      * an acked probe is evidence we are healthy: -1;
+      * a failed probe with indirect relays in flight blames us only
+        for the *missing* nacks — a relay's NACK proves our own links
+        work even though the target is unresponsive:
+        +(expected_nacks - nacks);
+      * a failed probe with no relays available: +1.
+
+    Scalar host-plane reference; the vectorized twin in
+    models/lifeguard.py is pinned to this by tests/test_lifeguard.py.
+    """
+    if success:
+        return -1
+    if expected_nacks > 0:
+        return max(expected_nacks - nacks, 0)
+    return 1
 
 
 def retransmit_limit(retransmit_mult: int, n: int) -> int:

@@ -1,19 +1,32 @@
 """Scan-based simulation engine, convergence metrics, scenario presets."""
 
+# faults first: it is dependency-free and models.lifeguard pulls it in
+# through this package's __init__, so it must be bound before engine
+# (which imports the models) starts executing.
+from consul_tpu.sim.faults import (
+    ChurnWindow,
+    DegradedSet,
+    FaultSchedule,
+    LossRamp,
+    Partition,
+)
 from consul_tpu.sim.engine import (
     membership_scan,
     run_membership_sparse,
     sparse_membership_scan,
     multidc_scan,
     run_broadcast,
+    run_lifeguard,
     run_membership,
     run_multidc,
     run_swim,
     broadcast_scan,
+    lifeguard_scan,
     swim_scan,
 )
 from consul_tpu.sim.metrics import (
     time_to_fraction,
+    FalsePositiveReport,
     MembershipReport,
     MultiDCReport,
     BroadcastReport,
@@ -22,6 +35,14 @@ from consul_tpu.sim.metrics import (
 from consul_tpu.sim.scenarios import SCENARIOS, run_scenario
 
 __all__ = [
+    "ChurnWindow",
+    "DegradedSet",
+    "FaultSchedule",
+    "FalsePositiveReport",
+    "LossRamp",
+    "Partition",
+    "lifeguard_scan",
+    "run_lifeguard",
     "membership_scan",
     "run_membership_sparse",
     "sparse_membership_scan",

@@ -42,7 +42,11 @@ from consul_tpu.models.swim import (
     VIEW_SUSPECT,
 )
 from consul_tpu.parallel import make_mesh, shard_state
-from consul_tpu.sim.metrics import BroadcastReport, SwimReport
+from consul_tpu.sim.metrics import (
+    BroadcastReport,
+    FalsePositiveReport,
+    SwimReport,
+)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "steps"))
@@ -85,6 +89,44 @@ def swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int):
             jnp.sum(nxt.view == VIEW_SUSPECT, dtype=jnp.int32),
             jnp.sum(nxt.view == VIEW_DEAD, dtype=jnp.int32),
         )
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+def lifeguard_scan(state, key: jax.Array, cfg, steps: int):
+    """Run ``steps`` fault-injected ticks of the Lifeguard model;
+    returns (final_state, (suspecting, dead_known, fp_events, refutes,
+    mean_awareness)).
+
+    The false-positive counter is a carry-vs-next diff inside the scan
+    body (fresh ALIVE->SUSPECT transitions while the subject is
+    actually alive), so the accuracy metrics ride the same O(ticks)
+    host transfer as the counts — one jit trace for the whole study.
+    """
+    # Imported at call time: models.lifeguard depends on sim.faults, so
+    # a module-level import here would close an import cycle through
+    # the package __init__s.
+    from consul_tpu.models.lifeguard import lifeguard_round
+
+    def tick(carry, k):
+        nxt = lifeguard_round(carry, k, cfg)
+        newly_suspect = jnp.sum(
+            (nxt.view == VIEW_SUSPECT) & (carry.view != VIEW_SUSPECT),
+            dtype=jnp.int32,
+        )
+        subject_live = jnp.logical_or(
+            jnp.bool_(cfg.subject_alive), carry.tick < cfg.fail_at_tick
+        )
+        out = (
+            jnp.sum(nxt.view == VIEW_SUSPECT, dtype=jnp.int32),
+            jnp.sum(nxt.view == VIEW_DEAD, dtype=jnp.int32),
+            jnp.where(subject_live, newly_suspect, 0),
+            (nxt.subject_inc - carry.subject_inc).astype(jnp.int32),
+            jnp.mean(nxt.awareness.astype(jnp.float32)),
+        )
+        return nxt, out
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
@@ -314,6 +356,44 @@ def run_membership_sparse(
         wall_s=wall,
     )
     return report, int(np.asarray(final.overflow))
+
+
+def run_lifeguard(
+    cfg,
+    steps: int,
+    seed: int = 0,
+    sharded: bool = False,
+    mesh=None,
+    warmup: bool = True,
+) -> FalsePositiveReport:
+    """Fault-injected Lifeguard study (cfg: LifeguardConfig): the
+    accuracy (FP-rate) workload.  Same single-scan/one-trace contract
+    as :func:`run_swim`."""
+    from consul_tpu.models.lifeguard import lifeguard_init
+
+    def make_state():
+        st = lifeguard_init(cfg)
+        return shard_state(st, mesh or make_mesh()) if sharded else st
+
+    key = jax.random.PRNGKey(seed)
+    _, (sus, dead, fp, refutes, aware), wall = _timed(
+        make_state, lifeguard_scan, key, cfg, steps, warmup
+    )
+    return FalsePositiveReport(
+        n=cfg.n,
+        ticks=steps,
+        tick_ms=cfg.profile.gossip_interval_ms,
+        probe_interval_ms=cfg.profile.probe_interval_ms,
+        lifeguard=cfg.lifeguard,
+        subject_alive=cfg.subject_alive,
+        fail_at_tick=cfg.fail_at_tick,
+        suspecting=np.asarray(sus),
+        dead_known=np.asarray(dead),
+        fp_events=np.asarray(fp),
+        refutes=np.asarray(refutes),
+        mean_awareness=np.asarray(aware),
+        wall_s=wall,
+    )
 
 
 def run_swim(

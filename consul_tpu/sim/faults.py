@@ -1,0 +1,229 @@
+"""Fault-injection schedules: the tunable environment of a study.
+
+"Robust and Tuneable Family of Gossiping Algorithms" (PAPERS.md)
+motivates treating the fault environment as a *parameter family* rather
+than a fixed loss constant.  A :class:`FaultSchedule` is a static,
+hashable description of that environment; every query is a pure
+function of ``(schedule, tick[, key])`` built from ``jnp`` ops, so a
+whole study — schedule included — compiles into one ``lax.scan`` /
+XLA program with no host round-trips.
+
+Primitives (each optional, all composable):
+
+  LossRamp      piecewise-constant extra packet loss over time
+                (e.g. a WAN brownout ramping 0% -> 40% -> healed)
+  Partition     a DC/segment split: cross-segment edges drop with
+                ``severity`` between ``start`` and ``heal`` ticks
+  DegradedSet   a pseudo-random subset of nodes whose *sends* (and
+                therefore their acks/nacks) drop with elevated
+                probability — the slow-member population Lifeguard
+                exists for
+  ChurnWindow   a window during which each node is independently
+                offline (restarting) with per-tick probability
+
+``compose`` merges two schedules; independent drop processes combine as
+``1 - prod(1 - p_i)`` (evaluated in :func:`extra_loss_at` /
+:func:`degraded_send_ok`), so composition is associative and
+order-independent.  Parity of the combination math with scalar
+expectations is pinned by tests/test_faults.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LossRamp:
+    """Piecewise-constant extra loss: ``pieces`` is a sorted tuple of
+    (start_tick, loss); loss is 0 before the first piece and each piece
+    holds until the next one starts (the last piece holds forever)."""
+
+    pieces: tuple[tuple[int, float], ...]
+
+    def __post_init__(self):
+        starts = [s for s, _ in self.pieces]
+        if starts != sorted(starts):
+            raise ValueError(f"LossRamp pieces must be sorted, got {starts}")
+        for _, p in self.pieces:
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"loss {p} outside [0, 1]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """Cross-segment edges drop with ``severity`` in [start, heal).
+    Node i belongs to segment ``i * segments // n``."""
+
+    start: int
+    heal: int
+    segments: int = 2
+    severity: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradedSet:
+    """A pseudo-random ``frac`` of nodes that are persistently slow:
+    their sends drop with extra probability ``drop``, and the probes
+    THEY perform see the ack arrive late (past the unscaled probe
+    window) with probability ``late`` — the slow-member population
+    Lifeguard exists for (a late ack is only a failure to an observer
+    whose NHM hasn't stretched its window yet).  Membership is a pure
+    function of (seed, n): deterministic across runs, devices, and
+    delivery modes."""
+
+    frac: float
+    drop: float = 0.5
+    late: float = 0.0
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnWindow:
+    """During [start, end) each node is independently offline with
+    probability ``p_offline`` per tick (a restart storm, not a crash:
+    the node is back in the next draw)."""
+
+    start: int
+    end: int
+    p_offline: float
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSchedule:
+    ramps: tuple[LossRamp, ...] = ()
+    partitions: tuple[Partition, ...] = ()
+    degraded: tuple[DegradedSet, ...] = ()
+    churn: tuple[ChurnWindow, ...] = ()
+
+    def compose(self, other: "FaultSchedule") -> "FaultSchedule":
+        """Union of fault processes; independent drops multiply out at
+        evaluation time."""
+        return FaultSchedule(
+            ramps=self.ramps + other.ramps,
+            partitions=self.partitions + other.partitions,
+            degraded=self.degraded + other.degraded,
+            churn=self.churn + other.churn,
+        )
+
+    @property
+    def has_faults(self) -> bool:
+        return bool(self.ramps or self.partitions or self.degraded
+                    or self.churn)
+
+
+# ---------------------------------------------------------------------------
+# Pure evaluators.  ``tick`` may be a traced scalar; the schedule itself
+# is static, so all tuple-derived arrays fold into XLA constants.
+# ---------------------------------------------------------------------------
+
+
+def extra_loss_at(sched: FaultSchedule, tick: jax.Array) -> jax.Array:
+    """float32 scalar: extra loss from all ramps at ``tick``, combined
+    as independent drop processes."""
+    keep = jnp.float32(1.0)
+    for ramp in sched.ramps:
+        starts = jnp.asarray([s for s, _ in ramp.pieces], jnp.int32)
+        losses = jnp.asarray(
+            [0.0] + [p for _, p in ramp.pieces], jnp.float32
+        )
+        idx = jnp.searchsorted(starts, tick, side="right")
+        keep = keep * (1.0 - losses[idx])
+    return 1.0 - keep
+
+
+def combine_loss(a, b):
+    """Combined drop probability of two independent loss processes."""
+    return 1.0 - (1.0 - a) * (1.0 - b)
+
+
+def _members(d: DegradedSet, n: int) -> jax.Array:
+    """bool[n]: the set's membership — THE single definition all
+    degraded evaluators share, so send-drop, late-ack and the reporting
+    mask can never describe different node populations."""
+    return jax.random.bernoulli(jax.random.PRNGKey(d.seed), d.frac, (n,))
+
+
+def degraded_send_ok(sched: FaultSchedule, n: int) -> jax.Array:
+    """float32[n]: per-node send survival multiplier (1.0 = healthy).
+    A node in several DegradedSets drops independently per set."""
+    ok = jnp.ones((n,), jnp.float32)
+    for d in sched.degraded:
+        if d.frac <= 0.0:
+            continue
+        ok = ok * jnp.where(_members(d, n), 1.0 - d.drop, 1.0)
+    return ok
+
+
+def degraded_mask(sched: FaultSchedule, n: int) -> jax.Array:
+    """bool[n]: nodes degraded by ANY set (for reporting)."""
+    mask = jnp.zeros((n,), bool)
+    for d in sched.degraded:
+        if d.frac <= 0.0:
+            continue
+        mask = mask | _members(d, n)
+    return mask
+
+
+def degraded_late(sched: FaultSchedule, n: int) -> jax.Array:
+    """float32[n]: per-node probability that a probe performed by the
+    node sees its ack arrive late (slow local processing).  Independent
+    late processes across sets combine like drops."""
+    keep = jnp.ones((n,), jnp.float32)
+    for d in sched.degraded:
+        if d.frac <= 0.0 or d.late <= 0.0:
+            continue
+        keep = keep * jnp.where(_members(d, n), 1.0 - d.late, 1.0)
+    return 1.0 - keep
+
+
+def segment_ids(partition: Partition, n: int) -> jax.Array:
+    """int32[n]: which side of the split each node is on."""
+    return (
+        jnp.arange(n, dtype=jnp.int32) * partition.segments // n
+    ).astype(jnp.int32)
+
+
+def partition_severity_at(partition: Partition, tick: jax.Array) -> jax.Array:
+    """float32 scalar: the partition's drop severity at ``tick`` (0
+    outside its window — healed)."""
+    active = (tick >= partition.start) & (tick < partition.heal)
+    return jnp.where(active, jnp.float32(partition.severity), 0.0)
+
+
+def edge_block_prob(
+    sched: FaultSchedule, tick: jax.Array, src: jax.Array, dst: jax.Array,
+    n: int,
+) -> jax.Array:
+    """Per-edge drop probability from all partitions, for explicit
+    (src, dst) index arrays (edges-mode delivery).  Shapes broadcast."""
+    keep = jnp.ones(jnp.broadcast_shapes(src.shape, dst.shape), jnp.float32)
+    for part in sched.partitions:
+        seg = segment_ids(part, n)
+        cross = seg[src] != seg[dst]
+        sev = partition_severity_at(part, tick)
+        keep = keep * jnp.where(cross, 1.0 - sev, 1.0)
+    return 1.0 - keep
+
+
+def offline_prob_at(sched: FaultSchedule, tick: jax.Array) -> jax.Array:
+    """float32 scalar: per-node offline probability at ``tick``
+    (churn windows combine independently)."""
+    keep = jnp.float32(1.0)
+    for w in sched.churn:
+        active = (tick >= w.start) & (tick < w.end)
+        keep = keep * jnp.where(active, 1.0 - w.p_offline, 1.0)
+    return 1.0 - keep
+
+
+def online_mask(
+    sched: FaultSchedule, key: jax.Array, tick: jax.Array, n: int
+) -> jax.Array:
+    """bool[n]: nodes participating this tick (True = online)."""
+    if not sched.churn:
+        return jnp.ones((n,), bool)
+    p_off = offline_prob_at(sched, tick)
+    return jax.random.uniform(key, (n,)) >= p_off

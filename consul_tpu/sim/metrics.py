@@ -151,6 +151,98 @@ class MembershipReport:
 
 
 @dataclasses.dataclass
+class FalsePositiveReport:
+    """Accuracy summary of a Lifeguard study: how often does the
+    cluster wrongly suspect a live subject, how hard does it flap, and
+    what does the accuracy buy/cost in time-to-true-dead?
+
+    All per-tick columns come out of the single-scan trace (O(ticks)
+    host transfer):
+
+      suspecting[t]      observers currently viewing the subject SUSPECT
+      dead_known[t]      observers currently viewing the subject DEAD
+      fp_events[t]       fresh ALIVE->SUSPECT transitions while the
+                         subject was actually alive (the false-positive
+                         counter; memberlist.msg.suspect in telemetry
+                         terms)
+      refutes[t]         incarnation bumps by the subject this tick
+                         (each is one refute broadcast; their total is
+                         the incarnation *flap* count)
+      mean_awareness[t]  population-mean Lifeguard health score
+    """
+
+    n: int
+    ticks: int
+    tick_ms: float
+    probe_interval_ms: float
+    lifeguard: bool
+    subject_alive: bool
+    fail_at_tick: int
+    suspecting: np.ndarray       # int32[ticks]
+    dead_known: np.ndarray       # int32[ticks]
+    fp_events: np.ndarray        # int32[ticks]
+    refutes: np.ndarray          # int32[ticks]
+    mean_awareness: np.ndarray   # float32[ticks]
+    wall_s: float
+
+    @property
+    def rounds_per_sec(self) -> float:
+        return self.ticks / self.wall_s if self.wall_s > 0 else float("inf")
+
+    @property
+    def fp_total(self) -> int:
+        """Total false-positive suspicion events over the study."""
+        return int(np.sum(self.fp_events))
+
+    @property
+    def fp_rate(self) -> float:
+        """False-positive suspicions per simulated second (cluster-wide)."""
+        sim_s = self.ticks * self.tick_ms / 1000.0
+        return self.fp_total / sim_s if sim_s > 0 else 0.0
+
+    @property
+    def refute_total(self) -> int:
+        return int(np.sum(self.refutes))
+
+    @property
+    def flap_count(self) -> int:
+        """Incarnation flaps: each refute restarts the cycle one
+        incarnation higher (suspect@k -> refute@k+1 -> ...)."""
+        return self.refute_total
+
+    def first_tick(self, counts: np.ndarray) -> Optional[int]:
+        hit = np.nonzero(np.asarray(counts) > 0)[0]
+        return int(hit[0]) if hit.size else None
+
+    def time_to_true_dead_ms(self) -> Optional[float]:
+        """Simulated ms from the subject's actual crash to the first
+        observer viewing it DEAD (None for FP studies or if never)."""
+        if self.subject_alive:
+            return None
+        t = self.first_tick(self.dead_known)
+        if t is None:
+            return None
+        return (t + 1 - self.fail_at_tick) * self.tick_ms
+
+    def summary(self) -> dict:
+        return {
+            "n": self.n,
+            "ticks": self.ticks,
+            "tick_ms": self.tick_ms,
+            "lifeguard": self.lifeguard,
+            "fp_total": self.fp_total,
+            "fp_rate_per_s": round(self.fp_rate, 4),
+            "refute_total": self.refute_total,
+            "flap_count": self.flap_count,
+            "suspecting_final": int(self.suspecting[-1]),
+            "dead_known_final": int(self.dead_known[-1]),
+            "mean_awareness_final": float(self.mean_awareness[-1]),
+            "time_to_true_dead_ms": self.time_to_true_dead_ms(),
+            "sim_rounds_per_sec": self.rounds_per_sec,
+        }
+
+
+@dataclasses.dataclass
 class SwimReport:
     """Failure-detection summary for one subject."""
 

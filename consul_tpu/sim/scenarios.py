@@ -11,6 +11,10 @@ the host agent plane lands).
   suspect1m   1M-node suspicion/dead propagation, 30% loss, WAN profile
   multidc1m   1M-node 8-segment multi-DC epidemic broadcast, sharded
               across the device mesh
+  degraded1m  1M-node Lifeguard false-positive study, WAN profile, 2%
+              degraded members (dropped/late acks) — runs the same
+              faulted universe with Lifeguard on and off and reports
+              the FP-rate / flap deltas (the first accuracy workload)
 """
 
 from __future__ import annotations
@@ -112,12 +116,80 @@ def multidc1m(seed: int = 0) -> dict:
     return {"scenario": "multidc1m", **rep.summary()}
 
 
+# The degraded1m fault environment, importable so tests pin the SAME
+# knobs the scenario ships (2% slow members with dropped sends and late
+# acks; 10% ambient loss; 25% WAN ack tail).
+def degraded1m_environment():
+    """(FaultSchedule, loss, ack_late) of the degraded1m preset."""
+    from consul_tpu.sim.faults import DegradedSet, FaultSchedule
+
+    faults = FaultSchedule(
+        degraded=(DegradedSet(frac=0.02, drop=0.5, late=0.6, seed=1),)
+    )
+    return faults, 0.10, 0.25
+
+
+def degraded1m(seed: int = 0, n: int = 1_000_000, steps: int = 300) -> dict:
+    """Lifeguard A/B at the headline scale: 1M nodes on WAN timing, 2%
+    of members degraded (their sends drop, their acks run late), 10%
+    ambient loss and a 25% WAN ack-tail — the slow-member environment
+    of the Lifeguard paper.  Runs the SAME faulted universe twice (one
+    jit trace each), Lifeguard on and off, and reports the
+    false-positive suspicion rate, refute and incarnation-flap deltas:
+    the simulator's first accuracy question rather than a speed one.
+
+    ``n``/``steps`` scale down for CPU smoke runs (tests use n=256..1024).
+    """
+    import dataclasses as _dc
+
+    from consul_tpu.models import LifeguardConfig
+    from consul_tpu.sim.engine import run_lifeguard
+
+    faults, loss, ack_late = degraded1m_environment()
+    cfg = LifeguardConfig(
+        n=n,
+        subject=7 % n,
+        subject_alive=True,
+        loss=loss,
+        ack_late=ack_late,
+        profile=WAN,
+        delivery="aggregate",
+        lifeguard=True,
+        faults=faults,
+    )
+    on = run_lifeguard(cfg, steps=steps, seed=seed, warmup=False)
+    off = run_lifeguard(
+        _dc.replace(cfg, lifeguard=False), steps=steps, seed=seed,
+        warmup=False,
+    )
+    return {
+        "scenario": "degraded1m",
+        "n": n,
+        "ticks": steps,
+        "tick_ms": on.tick_ms,
+        "fp_total_on": on.fp_total,
+        "fp_total_off": off.fp_total,
+        "fp_rate_on": on.fp_rate,
+        "fp_rate_off": off.fp_rate,
+        "fp_reduction": (
+            1.0 - on.fp_total / off.fp_total if off.fp_total else None
+        ),
+        "flaps_on": on.flap_count,
+        "flaps_off": off.flap_count,
+        "refutes_on": on.refute_total,
+        "refutes_off": off.refute_total,
+        "mean_awareness_final": float(on.mean_awareness[-1]),
+        "sim_rounds_per_sec": on.rounds_per_sec,
+    }
+
+
 SCENARIOS: dict[str, Callable[..., dict]] = {
     "dev3": dev3,
     "probe1k": probe1k,
     "event100k": event100k,
     "suspect1m": suspect1m,
     "multidc1m": multidc1m,
+    "degraded1m": degraded1m,
 }
 
 

@@ -34,6 +34,11 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test via asyncio.run")
+    config.addinivalue_line(
+        "markers",
+        "slow: >30s-at-CPU simulations, excluded from tier-1 "
+        "(run with -m slow)",
+    )
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -1,0 +1,179 @@
+"""Fault-schedule unit tests: each primitive's evaluator against its
+scalar expectation, plus composition semantics (independent drop
+processes combine as 1 - prod(1 - p))."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.sim.faults import (
+    ChurnWindow,
+    DegradedSet,
+    FaultSchedule,
+    LossRamp,
+    Partition,
+    combine_loss,
+    degraded_late,
+    degraded_mask,
+    degraded_send_ok,
+    edge_block_prob,
+    extra_loss_at,
+    offline_prob_at,
+    online_mask,
+    partition_severity_at,
+    segment_ids,
+)
+
+import jax
+
+
+class TestLossRamp:
+    def test_piecewise_values_and_boundaries(self):
+        sched = FaultSchedule(
+            ramps=(LossRamp(pieces=((10, 0.3), (20, 0.1), (30, 0.0))),)
+        )
+        got = [float(extra_loss_at(sched, jnp.int32(t)))
+               for t in (0, 9, 10, 19, 20, 29, 30, 1000)]
+        assert np.allclose(got, [0.0, 0.0, 0.3, 0.3, 0.1, 0.1, 0.0, 0.0],
+                           atol=1e-6)
+
+    def test_unsorted_pieces_rejected(self):
+        with pytest.raises(ValueError, match="sorted"):
+            LossRamp(pieces=((20, 0.1), (10, 0.3)))
+
+    def test_out_of_range_loss_rejected(self):
+        with pytest.raises(ValueError, match="outside"):
+            LossRamp(pieces=((0, 1.5),))
+
+    def test_two_ramps_combine_independently(self):
+        sched = FaultSchedule(
+            ramps=(
+                LossRamp(pieces=((0, 0.2),)),
+                LossRamp(pieces=((0, 0.5),)),
+            )
+        )
+        got = float(extra_loss_at(sched, jnp.int32(5)))
+        assert abs(got - combine_loss(0.2, 0.5)) < 1e-6
+        assert abs(got - 0.6) < 1e-6  # 1 - 0.8*0.5
+
+    def test_empty_schedule_is_lossless(self):
+        assert float(extra_loss_at(FaultSchedule(), jnp.int32(0))) == 0.0
+
+
+class TestDegraded:
+    def test_frac_respected_and_deterministic(self):
+        sched = FaultSchedule(
+            degraded=(DegradedSet(frac=0.1, drop=0.5, seed=7),)
+        )
+        m1 = np.asarray(degraded_mask(sched, 10_000))
+        m2 = np.asarray(degraded_mask(sched, 10_000))
+        assert np.array_equal(m1, m2), "membership must be deterministic"
+        assert 0.07 < m1.mean() < 0.13
+        ok = np.asarray(degraded_send_ok(sched, 10_000))
+        assert np.allclose(ok[m1], 0.5) and np.allclose(ok[~m1], 1.0)
+
+    def test_zero_frac_is_healthy(self):
+        sched = FaultSchedule(degraded=(DegradedSet(frac=0.0),))
+        assert not np.asarray(degraded_mask(sched, 64)).any()
+        assert np.allclose(np.asarray(degraded_send_ok(sched, 64)), 1.0)
+
+    def test_late_only_set_counts_as_degraded(self):
+        sched = FaultSchedule(
+            degraded=(DegradedSet(frac=0.2, drop=0.0, late=0.5, seed=3),)
+        )
+        m = np.asarray(degraded_mask(sched, 4096))
+        late = np.asarray(degraded_late(sched, 4096))
+        assert m.any()
+        assert np.allclose(late[m], 0.5) and np.allclose(late[~m], 0.0)
+        # drop=0 -> sends unaffected
+        assert np.allclose(np.asarray(degraded_send_ok(sched, 4096)), 1.0)
+
+    def test_overlapping_sets_drop_independently(self):
+        # Same seed + frac -> same membership; drops should compose as
+        # independent processes: ok = (1-a)(1-b).
+        sched = FaultSchedule(
+            degraded=(
+                DegradedSet(frac=0.5, drop=0.4, seed=1),
+                DegradedSet(frac=0.5, drop=0.5, seed=1),
+            )
+        )
+        m = np.asarray(degraded_mask(sched, 1024))
+        ok = np.asarray(degraded_send_ok(sched, 1024))
+        assert np.allclose(ok[m], 0.6 * 0.5)
+
+
+class TestPartition:
+    def test_cross_segment_blocked_only_in_window(self):
+        part = Partition(start=10, heal=20, segments=2, severity=1.0)
+        sched = FaultSchedule(partitions=(part,))
+        n = 8
+        seg = np.asarray(segment_ids(part, n))
+        assert set(seg[:4]) == {0} and set(seg[4:]) == {1}
+        src = jnp.arange(n, dtype=jnp.int32)[:, None]
+        dst = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :],
+                               (n, n))
+        during = np.asarray(edge_block_prob(sched, jnp.int32(15), src, dst, n))
+        before = np.asarray(edge_block_prob(sched, jnp.int32(9), src, dst, n))
+        after = np.asarray(edge_block_prob(sched, jnp.int32(20), src, dst, n))
+        cross = seg[:, None] != seg[None, :]
+        assert np.allclose(during[cross], 1.0)
+        assert np.allclose(during[~cross], 0.0)
+        assert np.allclose(before, 0.0), "no blocking before start"
+        assert np.allclose(after, 0.0), "heal tick restores all edges"
+
+    def test_partial_severity(self):
+        part = Partition(start=0, heal=10, segments=2, severity=0.25)
+        assert abs(float(partition_severity_at(part, jnp.int32(5))) - 0.25) \
+            < 1e-6
+        assert float(partition_severity_at(part, jnp.int32(10))) == 0.0
+
+
+class TestChurn:
+    def test_offline_probability_windows(self):
+        sched = FaultSchedule(churn=(ChurnWindow(start=5, end=10,
+                                                 p_offline=0.3),))
+        assert float(offline_prob_at(sched, jnp.int32(4))) == 0.0
+        assert abs(float(offline_prob_at(sched, jnp.int32(5))) - 0.3) < 1e-6
+        assert float(offline_prob_at(sched, jnp.int32(10))) == 0.0
+
+    def test_online_mask_rate(self):
+        sched = FaultSchedule(churn=(ChurnWindow(start=0, end=100,
+                                                 p_offline=0.25),))
+        m = np.asarray(online_mask(sched, jax.random.PRNGKey(0),
+                                   jnp.int32(3), 20_000))
+        assert 0.71 < m.mean() < 0.79
+
+    def test_no_churn_everyone_online(self):
+        m = np.asarray(online_mask(FaultSchedule(), jax.random.PRNGKey(0),
+                                   jnp.int32(0), 64))
+        assert m.all()
+
+
+class TestCompose:
+    def test_compose_unions_every_primitive(self):
+        a = FaultSchedule(
+            ramps=(LossRamp(pieces=((0, 0.2),)),),
+            degraded=(DegradedSet(frac=0.1, seed=1),),
+        )
+        b = FaultSchedule(
+            ramps=(LossRamp(pieces=((0, 0.5),)),),
+            partitions=(Partition(start=0, heal=5),),
+            churn=(ChurnWindow(start=0, end=5, p_offline=0.1),),
+        )
+        c = a.compose(b)
+        assert len(c.ramps) == 2 and len(c.partitions) == 1
+        assert len(c.degraded) == 1 and len(c.churn) == 1
+        assert c.has_faults and not FaultSchedule().has_faults
+        # Loss combines as independent drops regardless of compose order.
+        lc = float(extra_loss_at(c, jnp.int32(0)))
+        lr = float(extra_loss_at(b.compose(a), jnp.int32(0)))
+        assert abs(lc - combine_loss(0.2, 0.5)) < 1e-6
+        assert abs(lc - lr) < 1e-6
+
+    def test_composed_schedule_is_hashable_static_arg(self):
+        # jit static args require hashability — the whole schedule must
+        # stay a pure-literal pytree of tuples.
+        a = FaultSchedule(ramps=(LossRamp(pieces=((0, 0.2),)),))
+        b = FaultSchedule(degraded=(DegradedSet(frac=0.1),))
+        assert hash(a.compose(b)) == hash(a.compose(b))
+        assert a.compose(b) == a.compose(b)
