@@ -64,15 +64,22 @@ def _verify_signature(alg: str, signing_input: bytes, sig: bytes,
         if not pub_keys:
             raise JWTError(
                 "auth method has no jwt_validation_pub_keys for " + alg)
-        from cryptography.exceptions import (
-            InvalidSignature,
-            UnsupportedAlgorithm,
-        )
-        from cryptography.hazmat.primitives import hashes, serialization
-        from cryptography.hazmat.primitives.asymmetric import ec, padding
-        from cryptography.hazmat.primitives.asymmetric.utils import (
-            encode_dss_signature,
-        )
+        try:
+            from cryptography.exceptions import (
+                InvalidSignature,
+                UnsupportedAlgorithm,
+            )
+            from cryptography.hazmat.primitives import hashes, serialization
+            from cryptography.hazmat.primitives.asymmetric import ec, padding
+            from cryptography.hazmat.primitives.asymmetric.utils import (
+                encode_dss_signature,
+            )
+        except ImportError as e:
+            raise RuntimeError(
+                f"{alg} JWT validation requires the optional "
+                "'cryptography' package (pip install cryptography); "
+                "HS256 works without it"
+            ) from e
         for pem in pub_keys:
             # A malformed PEM or a key of the wrong type (EC key for
             # RS256, RSA for ES256) must not abort the loop — other

@@ -1,0 +1,53 @@
+"""Correctness tooling for the simulation plane.
+
+Two complementary halves keep the "whole study = one XLA program"
+invariant true as the codebase grows:
+
+* :mod:`consul_tpu.analysis.tracelint` — an AST-based static pass (8
+  rules) that catches trace-breaking code shapes before they run:
+  Python branches on traced values, host syncs in scan bodies, dtype
+  indiscipline, impurity under jit.  CLI: ``python -m consul_tpu.cli
+  lint`` (or ``python -m consul_tpu.analysis.tracelint``).
+* :mod:`consul_tpu.analysis.guards` — runtime retrace counters for the
+  jitted study entrypoints, surfaced to tests as
+  ``@pytest.mark.single_trace``.
+
+Importable without JAX: linting stays accelerator-free (guards import
+JAX lazily, and only when asked to jit).  Re-exports resolve lazily so
+``python -m consul_tpu.analysis.tracelint`` runs without the package
+__init__ pre-importing the submodule (no runpy double-import warning).
+"""
+
+import importlib
+
+_EXPORTS = {
+    "ENGINE_ENTRYPOINTS": "guards",
+    "RetraceError": "guards",
+    "TraceGuard": "guards",
+    "check_all": "guards",
+    "guard_entrypoints": "guards",
+    "trace_guard": "guards",
+    "RULES": "tracelint",
+    "Violation": "tracelint",
+    "lint_file": "tracelint",
+    "lint_paths": "tracelint",
+    "lint_source": "tracelint",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    return getattr(
+        importlib.import_module(f"{__name__}.{module}"), name
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,137 @@
+"""Runtime retrace guards: the dynamic complement of tracelint.
+
+tracelint catches trace-breaking *code shapes* before they run; this
+module catches the regressions static analysis cannot see — a config
+field that stops being hashable, a shape that silently varies between
+calls, a Python scalar that flips weak dtype — by counting **compile
+events** on the jitted entrypoints.  The contract the paper's
+methodology depends on ("whole study = one XLA program") becomes a
+testable invariant: wrap an entrypoint in :func:`trace_guard` (or mark
+a test ``@pytest.mark.single_trace``, see ``tests/conftest.py``) and
+any retrace beyond the budget fails loudly with a
+:class:`RetraceError` instead of silently recompiling per call.
+
+Trace counting rides ``jit(f)._cache_size()`` — the executable-cache
+census JAX maintains per jitted callable — diffed against a baseline
+snapshot taken when the guard is created, so module-level entrypoints
+shared across tests are guarded incrementally, not cumulatively.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterable, Optional
+
+# The jitted study entrypoints of sim/engine.py, guarded by default.
+ENGINE_ENTRYPOINTS = (
+    "broadcast_scan",
+    "multidc_scan",
+    "swim_scan",
+    "lifeguard_scan",
+    "membership_scan",
+    "sparse_membership_scan",
+)
+
+
+class RetraceError(AssertionError):
+    """A guarded jitted function compiled more often than its budget."""
+
+
+def _cache_size_fn(fn: Any) -> Optional[Callable[[], int]]:
+    size = getattr(fn, "_cache_size", None)
+    return size if callable(size) else None
+
+
+class TraceGuard:
+    """Counts retraces of one jitted callable against a budget.
+
+    ``guard = TraceGuard(swim_scan)`` snapshots the entrypoint's compile
+    cache; every call through the guard (or a later ``guard.check()``)
+    asserts that at most ``max_traces`` new programs were compiled since
+    the snapshot.  ``max_traces=1`` is the single-program contract; use
+    2 for an intentional warmup+steady pair of shapes.
+    """
+
+    def __init__(self, fn: Callable, max_traces: int = 1,
+                 name: Optional[str] = None):
+        size = _cache_size_fn(fn)
+        if size is None:
+            raise TypeError(
+                f"{name or fn!r} is not a jitted callable (no "
+                "_cache_size); pass it through trace_guard() to jit it"
+            )
+        functools.update_wrapper(self, fn, updated=())
+        self._fn = fn
+        self._size = size
+        self.max_traces = max_traces
+        self.name = name or getattr(fn, "__name__", repr(fn))
+        self.calls = 0
+        self._base = size()
+
+    @property
+    def traces(self) -> int:
+        """Programs compiled since this guard was created."""
+        return self._size() - self._base
+
+    def check(self) -> None:
+        traces = self.traces
+        if traces > self.max_traces:
+            raise RetraceError(
+                f"{self.name} compiled {traces} programs in {self.calls} "
+                f"call(s) — budget is {self.max_traces}.  A retrace means "
+                "some argument changed its static signature between "
+                "calls (shape, dtype, weak type, or a config that "
+                "stopped hashing equal); the study is no longer one XLA "
+                "program."
+            )
+
+    def reset(self) -> None:
+        """Re-snapshot: subsequent checks count from now."""
+        self._base = self._size()
+        self.calls = 0
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        self.calls += 1
+        self.check()
+        return out
+
+
+def trace_guard(fn: Callable, max_traces: int = 1,
+                name: Optional[str] = None, **jit_kwargs) -> TraceGuard:
+    """Wrap ``fn`` in a :class:`TraceGuard`, jitting it first when it is
+    a plain Python function (``jit_kwargs`` pass through to ``jax.jit``,
+    e.g. ``static_argnames``)."""
+    if _cache_size_fn(fn) is None:
+        import jax
+
+        fn = jax.jit(fn, **jit_kwargs)
+    elif jit_kwargs:
+        raise TypeError(
+            "jit_kwargs only apply when trace_guard jits the function "
+            "itself; got an already-jitted callable"
+        )
+    return TraceGuard(fn, max_traces=max_traces, name=name)
+
+
+def guard_entrypoints(
+    entrypoints: Iterable[str] = ENGINE_ENTRYPOINTS,
+    max_traces: int = 1,
+) -> dict[str, TraceGuard]:
+    """Guards over the named ``sim.engine`` entrypoints — the hook the
+    ``single_trace`` pytest marker uses.  Snapshot now; ``check_all``
+    later."""
+    from consul_tpu.sim import engine
+
+    return {
+        name: TraceGuard(getattr(engine, name), max_traces=max_traces,
+                         name=name)
+        for name in entrypoints
+    }
+
+
+def check_all(guards: dict[str, TraceGuard]) -> None:
+    """Check every guard; raises :class:`RetraceError` on the first
+    over-budget entrypoint."""
+    for guard in guards.values():
+        guard.check()

@@ -1,0 +1,1017 @@
+"""tracelint: a JAX-aware static-analysis pass for the simulation plane.
+
+The north star compiles whole 1M-node studies into a *single* XLA
+program (``sim/engine.py`` pins "one jit trace per study" in its
+acceptance tests), and nothing but discipline keeps the classic JAX
+regressions — Python branching on traced values, host syncs inside
+``lax.scan`` bodies, silent dtype widening, impure ``time.time()``
+calls under ``@jit`` — from creeping into ``models/``, ``sim/`` and
+``ops/`` as they grow.  This module is that discipline, mechanized: an
+AST pass with eight rules tuned to this codebase's idioms.
+
+Which functions count as *traced code*:
+
+  * functions decorated with ``@jax.jit`` (directly or through
+    ``functools.partial(jax.jit, static_argnames=...)``);
+  * functions passed to a JAX transform (``lax.scan`` bodies,
+    ``lax.while_loop``/``fori_loop``/``cond`` branches, ``vmap``/
+    ``pmap``/``jax.jit(fn, ...)`` call forms);
+  * functions whose signature declares a traced parameter — an
+    annotation mentioning ``jax.Array``/``jnp.ndarray`` or a carry
+    type ending in ``State`` (the ``*_round`` convention of
+    ``models/*.py``);
+  * any function nested inside one of the above (closures execute
+    under the enclosing trace).
+
+Inside traced code a cheap forward taint pass marks every local
+derived from a traced parameter; *static* parameters (``static_
+argnames``, or annotations like ``int``/``float``/``*Config``/
+``*Profile``/``*Schedule``) stay untainted, so ``if cfg.delivery ==
+"edges"`` never fires while ``if state.tick > 0`` does.  Structural
+tests (``x is None``, ``isinstance``) are exempt by design — they
+inspect Python structure, not traced values.
+
+Rules (``--list-rules`` prints this table):
+
+  R1  python-branch-on-traced   ``if``/``while``/``assert``/ternary on
+                                a value derived from traced params
+  R2  host-sync                 ``float()``/``int()``/``bool()``/
+                                ``.item()``/``.tolist()``/
+                                ``np.asarray()`` on traced values
+  R3  dtype-discipline          ``jnp.zeros``/``ones``/``full``/
+                                ``empty``/``arange``/``eye`` without an
+                                explicit dtype, or any 64-bit dtype
+                                reference (``jnp.float64`` ...) —
+                                module-wide, traced or not
+  R4  impure-call               ``time.*``/``random.*``/
+                                ``np.random.*``/``datetime.*``/
+                                ``os.urandom``/``uuid.*`` inside traced
+                                code (``jax.random`` is of course fine)
+  R5  bad-static-args           ``static_argnames``/``static_argnums``
+                                not a literal, naming a missing
+                                parameter, or binding an unhashable one
+  R6  boolean-indexing          ``x[mask]`` with a data-dependent mask,
+                                or ``jnp.nonzero``/``argwhere``/
+                                one-arg ``jnp.where`` (data-dependent
+                                shapes) — use ``jnp.where(mask, a, b)``
+  R7  python-loop-over-traced   ``for`` over a traced value or
+                                ``range(traced)`` — use ``vmap``/
+                                ``scan``
+  R8  carry-mutation            in-place mutation of traced state
+                                (``state.x = ...``, ``x[i] = ...``) —
+                                use ``dataclasses.replace``/
+                                ``._replace``/``.at[].set``
+
+Suppression: append ``# tracelint: disable=R3`` (or a comma list, or
+bare ``disable`` for all rules) to the offending line, with a
+justification in the surrounding code.  The runtime complement — trace
+*count* guards for the jitted entrypoints — lives in
+:mod:`consul_tpu.analysis.guards`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import re
+import sys
+from pathlib import Path
+from typing import Iterable, Optional
+
+RULES: dict[str, str] = {
+    "R1": "python-branch-on-traced: `if`/`while`/`assert`/ternary on a "
+          "value derived from traced params (use jnp.where/lax.cond)",
+    "R2": "host-sync: float()/int()/bool()/.item()/.tolist()/np.asarray() "
+          "on a traced value forces a device round-trip inside traced code",
+    "R3": "dtype-discipline: array constructor without an explicit dtype, "
+          "or a 64-bit dtype reference (float64/int64/...)",
+    "R4": "impure-call: time.*/random.*/np.random.*/datetime.*/os.urandom "
+          "inside traced code bakes a constant into the compiled program",
+    "R5": "bad-static-args: static_argnames/static_argnums must be "
+          "literals that name hashable parameters",
+    "R6": "boolean-indexing: data-dependent boolean masks make shapes "
+          "dynamic — use jnp.where(mask, a, b) / masked reductions",
+    "R7": "python-loop-over-traced: `for` over a traced value unrolls or "
+          "fails under jit — use vmap/lax.scan",
+    "R8": "carry-mutation: traced state is immutable — use "
+          "dataclasses.replace/._replace/.at[].set functional updates",
+}
+
+# Array constructors that must pin a dtype, with the positional index at
+# which dtype may legally arrive (jnp.full((n,), NEVER, jnp.int32) is
+# fine: dtype is the third positional).
+_CTOR_DTYPE_POS = {
+    "jax.numpy.zeros": 1,
+    "jax.numpy.ones": 1,
+    "jax.numpy.empty": 1,
+    "jax.numpy.full": 2,
+    "jax.numpy.eye": 3,
+    "jax.numpy.arange": 3,
+}
+
+_WIDE_DTYPES = frozenset(
+    f"{mod}.{name}"
+    for mod in ("jax.numpy", "numpy")
+    for name in ("float64", "int64", "uint64", "complex128", "longdouble")
+)
+
+_HOST_SYNC_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+_HOST_SYNC_FUNCS = frozenset({
+    "numpy.asarray", "numpy.array", "jax.device_get",
+})
+_HOST_SYNC_METHODS = frozenset({"item", "tolist", "to_py", "block_until_ready"})
+
+_IMPURE_EXACT = frozenset({"os.urandom", "id", "input"})
+_IMPURE_PREFIXES = (
+    "time.", "random.", "numpy.random.", "datetime.", "uuid.", "secrets.",
+)
+
+# jnp calls whose *result shape* depends on data — poison under jit.
+_DYNAMIC_SHAPE_FUNCS = frozenset({
+    "jax.numpy.nonzero", "jax.numpy.flatnonzero", "jax.numpy.argwhere",
+})
+
+# Transform entry points whose function-valued argument positions become
+# traced code.
+_TRANSFORM_FN_ARGS: dict[str, tuple[int, ...]] = {
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.jit": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+}
+
+# Attribute reads that return static metadata, not traced data.
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tracelint:\s*disable(?:=(?P<rules>[A-Z0-9,\s]+))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col} {self.rule} {self.message}"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` as a string, or None for non-name expressions."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _ann_str(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - malformed annotation
+        return ""
+
+
+_TRACED_ANN_TOKENS = ("jax.Array", "jnp.ndarray", "jax.numpy.ndarray",
+                      "chex.Array", "ArrayLike")
+_STATIC_ANN_RE = re.compile(
+    r"^(Optional\[)?(int|float|bool|str|bytes|tuple|list|dict|frozenset|"
+    r"[A-Za-z_.]*(Config|Profile|Schedule|Callable))\b"
+)
+
+
+def _annotation_kind(ann: str) -> str:
+    """'traced' | 'static' | 'unknown' for a parameter annotation."""
+    if not ann:
+        return "unknown"
+    if any(tok in ann for tok in _TRACED_ANN_TOKENS):
+        return "traced"
+    # Carry types end in "State" (SwimState, Optional[LifeguardState]):
+    # no leading \b — the boundary sits inside the identifier.
+    if re.search(r"State\b", ann):
+        return "traced"
+    if "np.ndarray" in ann or "numpy.ndarray" in ann:
+        return "static"  # host array: report-plane code, not traced
+    if _STATIC_ANN_RE.match(ann):
+        return "static"
+    return "unknown"
+
+
+class _Imports:
+    """Alias resolution: ``jnp.zeros`` -> ``jax.numpy.zeros`` etc."""
+
+    def __init__(self, tree: ast.Module):
+        self.alias: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.alias[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:
+                    continue  # relative imports: out of scope
+                for a in node.names:
+                    self.alias[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def resolve(self, dotted: Optional[str]) -> Optional[str]:
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        base = self.alias.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+
+def _literal_str_names(node: ast.AST) -> Optional[tuple[str, ...]]:
+    """static_argnames literal -> names, or None when not a literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _literal_int_nums(node: ast.AST) -> Optional[tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+@dataclasses.dataclass
+class _JitSpec:
+    """A jit application site: decorator or jax.jit(fn, ...) call."""
+
+    node: ast.Call | ast.expr
+    static_names: Optional[tuple[str, ...]] = None   # None = unparseable
+    static_nums: Optional[tuple[int, ...]] = None
+    names_literal: bool = True
+    nums_literal: bool = True
+
+
+def _match_jit(node: ast.expr, imports: _Imports) -> Optional[_JitSpec]:
+    """Recognize ``jax.jit`` / ``partial(jax.jit, ...)`` expressions."""
+    resolved = imports.resolve(_dotted(node))
+    if resolved in ("jax.jit", "jit"):
+        return _JitSpec(node=node, static_names=(), static_nums=())
+    if not isinstance(node, ast.Call):
+        return None
+    fn = imports.resolve(_dotted(node.func))
+    inner_is_jit = (
+        node.args
+        and imports.resolve(_dotted(node.args[0])) in ("jax.jit", "jit")
+    )
+    if fn in ("functools.partial", "partial") and inner_is_jit:
+        spec = _JitSpec(node=node, static_names=(), static_nums=())
+    elif fn in ("jax.jit", "jit"):
+        spec = _JitSpec(node=node, static_names=(), static_nums=())
+    else:
+        return None
+    for kw in node.keywords:
+        if kw.arg == "static_argnames":
+            spec.static_names = _literal_str_names(kw.value)
+            spec.names_literal = spec.static_names is not None
+        elif kw.arg == "static_argnums":
+            spec.static_nums = _literal_int_nums(kw.value)
+            spec.nums_literal = spec.static_nums is not None
+    return spec
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _unhashable_param(fn: ast.FunctionDef, name: str) -> Optional[str]:
+    """Why binding ``name`` static would be unhashable, or None."""
+    a = fn.args
+    params = [*a.posonlyargs, *a.args, *a.kwonlyargs]
+    defaults: dict[str, ast.expr] = {}
+    pos_defaults = a.defaults
+    if pos_defaults:
+        for p, d in zip(params[len(params) - len(a.kwonlyargs)
+                               - len(pos_defaults):], pos_defaults):
+            defaults[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            defaults[p.arg] = d
+    for p in params:
+        if p.arg != name:
+            continue
+        ann = _ann_str(p.annotation)
+        if re.match(r"^(list|dict|set)\b", ann):
+            return f"annotated {ann!r} (unhashable)"
+        d = defaults.get(name)
+        if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return "has an unhashable default"
+        return None
+    return "missing"
+
+
+class _Reporter:
+    def __init__(self, path: str, rules: frozenset[str],
+                 suppressions: dict[int, Optional[set[str]]]):
+        self.path = path
+        self.rules = rules
+        self.suppressions = suppressions
+        self._seen: set[tuple[int, int, str]] = set()
+        self.violations: list[Violation] = []
+
+    def report(self, node: ast.AST, rule: str, message: str) -> None:
+        if rule not in self.rules:
+            return
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0) + 1
+        if line in self.suppressions:
+            suppressed = self.suppressions[line]
+            if suppressed is None or rule in suppressed:
+                return
+        key = (line, col, rule)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.violations.append(
+            Violation(self.path, line, col, rule, message)
+        )
+
+
+def _is_structural_test(node: ast.expr) -> bool:
+    """Tests that inspect Python structure, not traced values: ``x is
+    None``, ``isinstance(x, T)``, ``hasattr`` — legal in traced code."""
+    if isinstance(node, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+    if isinstance(node, ast.Call):
+        fn = _dotted(node.func)
+        return fn in ("isinstance", "hasattr", "callable", "len")
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _is_structural_test(node.operand)
+    if isinstance(node, ast.BoolOp):
+        return all(_is_structural_test(v) for v in node.values)
+    return False
+
+
+class _FunctionLinter:
+    """Forward taint pass + rule checks over one traced function."""
+
+    def __init__(self, fn: ast.FunctionDef, imports: _Imports,
+                 reporter: _Reporter, outer_taint: dict[str, bool],
+                 static_params: frozenset[str]):
+        self.fn = fn
+        self.imports = imports
+        self.reporter = reporter
+        self.outer = outer_taint
+        self.tainted: set[str] = set()
+        self.bool_masks: set[str] = set()
+        # Names bound to Python list/tuple literals: static-length
+        # containers — iterating them is pytree manipulation, not a
+        # loop over a traced axis, even when the elements are traced.
+        self.static_containers: set[str] = set()
+        self.reporting = False
+        for p in _param_names(fn):
+            if p in static_params:
+                continue
+            arg = next(
+                a for a in (*fn.args.posonlyargs, *fn.args.args,
+                            *fn.args.kwonlyargs) if a.arg == p
+            )
+            kind = _annotation_kind(_ann_str(arg.annotation))
+            # Unannotated params are conservatively traced: in a traced
+            # function every non-static input flows from the trace.
+            if kind in ("traced", "unknown"):
+                self.tainted.add(p)
+        self.static_params = static_params
+
+    # -- taint -----------------------------------------------------------
+
+    def _name_tainted(self, name: str) -> bool:
+        if name in self.tainted:
+            return True
+        if name in self.static_params:
+            return False
+        return self.outer.get(name, False)
+
+    def taint(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return self._name_tainted(node.id)
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.taint(node.value)
+        if isinstance(node, ast.Call):
+            return self._taint_call(node)
+        if isinstance(node, ast.Subscript):
+            t = self.taint(node.value) or self.taint(node.slice)
+            if self.reporting:
+                self._check_bool_index(node)
+            return t
+        if isinstance(node, ast.IfExp):
+            if self.reporting and self.taint(node.test) and not (
+                _is_structural_test(node.test)
+            ):
+                self.reporter.report(
+                    node, "R1",
+                    "ternary on a traced value — use jnp.where/lax.select",
+                )
+            return (self.taint(node.test) or self.taint(node.body)
+                    or self.taint(node.orelse))
+        if isinstance(node, (ast.Lambda,)):
+            # Closures execute under the enclosing trace: lint the body
+            # with the lambda params tainted.
+            sub = _FunctionLinter.__new__(_FunctionLinter)
+            sub.fn = self.fn
+            sub.imports = self.imports
+            sub.reporter = self.reporter
+            sub.outer = self._env()
+            sub.tainted = {a.arg for a in node.args.args}
+            sub.bool_masks = set()
+            sub.static_containers = set()
+            sub.static_params = frozenset()
+            sub.reporting = self.reporting
+            sub.taint(node.body)
+            # The lambda OBJECT is a host-level value, not traced data
+            # (calls through it taint via their arguments as usual).
+            return False
+        # Generic: union over child expressions.
+        t = False
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                t = self.taint(child) or t
+            elif isinstance(child, ast.comprehension):
+                it = self.taint(child.iter)
+                if (self.reporting and it
+                        and not self._is_static_container(child.iter)):
+                    # The comprehension spelling of the R7 loop.
+                    self.reporter.report(
+                        child.iter, "R7",
+                        "comprehension over a traced value — each "
+                        "element becomes a trace-time unroll step (use "
+                        "vmap or lax.scan)",
+                    )
+                t = it or t
+        return t
+
+    def _env(self) -> dict[str, bool]:
+        env = dict(self.outer)
+        for name in self.static_params:
+            env[name] = False
+        for name in self.tainted:
+            env[name] = True
+        return env
+
+    def _taint_call(self, node: ast.Call) -> bool:
+        resolved = self.imports.resolve(_dotted(node.func))
+        if resolved == "len":
+            # len(tracer) is the static leading dim — not traced data.
+            for a in node.args:
+                self.taint(a)
+            return False
+        arg_taints = [self.taint(a) for a in node.args]
+        kw_taints = [self.taint(k.value) for k in node.keywords]
+        any_arg = any(arg_taints) or any(kw_taints)
+        func_taint = (
+            isinstance(node.func, ast.Attribute)
+            and self.taint(node.func.value)
+        ) or (
+            isinstance(node.func, ast.Name)
+            and self._name_tainted(node.func.id)
+        )
+        if self.reporting:
+            self._check_call(node, resolved, arg_taints, any_arg)
+        return any_arg or func_taint
+
+    # -- rule checks -----------------------------------------------------
+
+    def _check_call(self, node: ast.Call, resolved: Optional[str],
+                    arg_taints: list[bool], any_arg: bool) -> None:
+        fn_name = _dotted(node.func)
+        # R2: host syncs on traced values.
+        if fn_name in _HOST_SYNC_BUILTINS and any_arg:
+            self.reporter.report(
+                node, "R2",
+                f"{fn_name}() on a traced value forces a host sync — "
+                "keep it on-device (astype/jnp ops) or return it from "
+                "the scan",
+            )
+        elif resolved in _HOST_SYNC_FUNCS and any_arg:
+            self.reporter.report(
+                node, "R2",
+                f"{resolved}() on a traced value pulls it to the host — "
+                "use jnp.asarray / return the value from the jitted fn",
+            )
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+                and self.taint(node.func.value)):
+            self.reporter.report(
+                node, "R2",
+                f".{node.func.attr}() on a traced value forces a host "
+                "sync inside traced code",
+            )
+        # R4: impurity.
+        if resolved is not None:
+            if resolved in _IMPURE_EXACT or resolved.startswith(
+                _IMPURE_PREFIXES
+            ):
+                self.reporter.report(
+                    node, "R4",
+                    f"{resolved}() inside traced code runs once at trace "
+                    "time and bakes a constant into the program — pass "
+                    "the value in, or use jax.random with a threaded key",
+                )
+        # R6: data-dependent output shapes.
+        if resolved in _DYNAMIC_SHAPE_FUNCS:
+            self.reporter.report(
+                node, "R6",
+                f"{resolved}() has a data-dependent output shape — "
+                "use jnp.where(mask, a, b) or masked reductions",
+            )
+        elif (resolved == "jax.numpy.where" and len(node.args) == 1):
+            self.reporter.report(
+                node, "R6",
+                "one-argument jnp.where is nonzero() in disguise "
+                "(data-dependent shape) — use the three-argument form",
+            )
+
+    def _check_bool_index(self, node: ast.Subscript) -> None:
+        if not self.taint(node.value):
+            return
+        idx = node.slice
+        boolish = (
+            (isinstance(idx, ast.Compare)
+             and not _is_structural_test(idx)
+             and self.taint(idx))
+            or (isinstance(idx, ast.UnaryOp)
+                and isinstance(idx.op, ast.Not) and self.taint(idx))
+            or (isinstance(idx, ast.BoolOp) and self.taint(idx))
+            or (isinstance(idx, ast.Name) and idx.id in self.bool_masks)
+        )
+        if boolish:
+            self.reporter.report(
+                node, "R6",
+                "boolean-mask indexing produces a data-dependent shape "
+                "under jit — use jnp.where(mask, a, b)",
+            )
+
+    # -- statement walk --------------------------------------------------
+
+    def run(self) -> None:
+        # Pass 1 settles taint (handles use-before-redef in loops);
+        # pass 2 reports with the settled environment.
+        self.reporting = False
+        self._visit_body(self.fn.body)
+        self.reporting = True
+        self._visit_body(self.fn.body)
+
+    def _visit_body(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._visit_stmt(stmt)
+
+    def _bind(self, target: ast.expr, tainted: bool, boolish: bool,
+              container: bool = False) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+            if boolish and tainted:
+                self.bool_masks.add(target.id)
+            else:
+                self.bool_masks.discard(target.id)
+            if container:
+                self.static_containers.add(target.id)
+            else:
+                self.static_containers.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, tainted, boolish)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted, boolish)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            base = target.value
+            if self.reporting and self.taint(base):
+                kind = ("attribute" if isinstance(target, ast.Attribute)
+                        else "subscript")
+                self.reporter.report(
+                    target, "R8",
+                    f"in-place {kind} assignment mutates traced state — "
+                    "use dataclasses.replace/._replace or .at[].set",
+                )
+
+    @staticmethod
+    def _is_bool_expr(node: ast.expr) -> bool:
+        return isinstance(node, (ast.Compare, ast.BoolOp)) or (
+            isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not)
+        )
+
+    def _is_static_container(self, node: ast.expr) -> bool:
+        """Python list/tuple structure with a trace-time-static length
+        (literal, or a name bound to one) — iterating it is fine."""
+        if isinstance(node, (ast.List, ast.Tuple, ast.ListComp,
+                             ast.SetComp, ast.DictComp)):
+            return True
+        return (isinstance(node, ast.Name)
+                and node.id in self.static_containers)
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            t = self.taint(stmt.value)
+            boolish = self._is_bool_expr(stmt.value)
+            if (isinstance(stmt.value, ast.Tuple)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], (ast.Tuple, ast.List))
+                    and len(stmt.targets[0].elts)
+                    == len(stmt.value.elts)):
+                for tgt, val in zip(stmt.targets[0].elts, stmt.value.elts):
+                    self._bind(tgt, self.taint(val),
+                               self._is_bool_expr(val))
+            else:
+                container = self._is_static_container(stmt.value)
+                for tgt in stmt.targets:
+                    self._bind(tgt, t, boolish, container=container)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._bind(stmt.target, self.taint(stmt.value),
+                           self._is_bool_expr(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.taint(stmt.value) or self.taint(stmt.target)
+            self._bind(stmt.target, t, False)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if (self.reporting and self.taint(stmt.test)
+                    and not _is_structural_test(stmt.test)):
+                kw = "if" if isinstance(stmt, ast.If) else "while"
+                self.reporter.report(
+                    stmt, "R1",
+                    f"`{kw}` on a value derived from traced params — "
+                    "the branch is decided at trace time (use "
+                    "jnp.where/lax.cond/lax.while_loop)",
+                )
+            else:
+                self.taint(stmt.test)
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            if (self.reporting and self.taint(stmt.test)
+                    and not _is_structural_test(stmt.test)):
+                self.reporter.report(
+                    stmt, "R1",
+                    "`assert` on a traced value — it checks the tracer, "
+                    "not the data (use checkify or a returned flag)",
+                )
+            else:
+                self.taint(stmt.test)
+        elif isinstance(stmt, ast.For):
+            iter_taint = self.taint(stmt.iter)
+            # A static container of traced arrays is legal to iterate
+            # (pytree plumbing) — but its ELEMENTS are still traced, so
+            # the exemption applies to the R7 report, not the binding.
+            report_iter = (
+                iter_taint and not self._is_static_container(stmt.iter)
+            )
+            range_taint = (
+                isinstance(stmt.iter, ast.Call)
+                and _dotted(stmt.iter.func) in ("range", "enumerate", "zip")
+                and any(self.taint(a) for a in stmt.iter.args
+                        if not self._is_static_container(a))
+            )
+            if self.reporting and (report_iter or range_taint):
+                self.reporter.report(
+                    stmt, "R7",
+                    "`for` over a traced value — each element becomes a "
+                    "trace-time unroll step (use vmap or lax.scan)",
+                )
+            self._bind(stmt.target, iter_taint, False)
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs execute under the enclosing trace.  Settle
+            # their taint with a silent pass before reporting, same as
+            # run() does for the outer function.
+            sub = _FunctionLinter(
+                stmt, self.imports, self.reporter, self._env(),
+                static_params=frozenset(),
+            )
+            sub.reporting = False
+            sub._visit_body(stmt.body)
+            if self.reporting:
+                sub.reporting = True
+                sub._visit_body(stmt.body)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.taint(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self.taint(stmt.value)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.taint(item.context_expr)
+            self._visit_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._visit_body(stmt.body)
+            for handler in stmt.handlers:
+                self._visit_body(handler.body)
+            self._visit_body(stmt.orelse)
+            self._visit_body(stmt.finalbody)
+        # Import/Pass/Raise/Global/...: no taint flow.
+
+
+class _ModuleLinter:
+    def __init__(self, tree: ast.Module, source: str, path: str,
+                 rules: frozenset[str]):
+        self.tree = tree
+        self.path = path
+        self.imports = _Imports(tree)
+        self.reporter = _Reporter(path, rules,
+                                  self._suppressions(source))
+        self.transform_bodies: dict[str, _JitSpec] = {}
+
+    @staticmethod
+    def _suppressions(source: str) -> dict[int, Optional[set[str]]]:
+        out: dict[int, Optional[set[str]]] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            spec = m.group("rules")
+            out[i] = (None if spec is None else
+                      {r.strip() for r in spec.split(",") if r.strip()})
+        return out
+
+    def run(self) -> list[Violation]:
+        self._collect_transform_bodies()
+        self._check_module_wide()
+        for node in self.tree.body:
+            self._lint_scope(node, outer_taint={})
+        return self.reporter.violations
+
+    # -- traced-function discovery --------------------------------------
+
+    def _collect_transform_bodies(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = self.imports.resolve(_dotted(node.func))
+            positions = _TRANSFORM_FN_ARGS.get(resolved or "")
+            if positions is None:
+                continue
+            spec = (_match_jit(node, self.imports)
+                    if resolved in ("jax.jit", "jit") else None)
+            for pos in positions:
+                if pos < len(node.args) and isinstance(
+                    node.args[pos], ast.Name
+                ):
+                    name = node.args[pos].id
+                    self.transform_bodies[name] = (
+                        spec or _JitSpec(node=node, static_names=(),
+                                         static_nums=())
+                    )
+
+    def _jit_spec_for(self, fn: ast.FunctionDef) -> Optional[_JitSpec]:
+        for dec in fn.decorator_list:
+            spec = _match_jit(dec, self.imports)
+            if spec is not None:
+                return spec
+        return self.transform_bodies.get(fn.name)
+
+    def _static_params(self, fn: ast.FunctionDef,
+                       spec: Optional[_JitSpec]) -> frozenset[str]:
+        names = set()
+        params = _param_names(fn)
+        if spec is not None:
+            for n in spec.static_names or ():
+                names.add(n)
+            for i in spec.static_nums or ():
+                if 0 <= i < len(params):
+                    names.add(params[i])
+        for arg in (*fn.args.posonlyargs, *fn.args.args,
+                    *fn.args.kwonlyargs):
+            if _annotation_kind(_ann_str(arg.annotation)) == "static":
+                names.add(arg.arg)
+        return frozenset(names)
+
+    def _is_traced(self, fn: ast.FunctionDef,
+                   spec: Optional[_JitSpec]) -> bool:
+        if spec is not None:
+            return True
+        for arg in (*fn.args.posonlyargs, *fn.args.args,
+                    *fn.args.kwonlyargs):
+            if _annotation_kind(_ann_str(arg.annotation)) == "traced":
+                return True
+        return False
+
+    def _lint_scope(self, node: ast.stmt, outer_taint: dict[str, bool]) -> None:
+        """Walk top-level/class scopes, linting traced functions (their
+        nested defs are handled by _FunctionLinter itself)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spec = self._jit_spec_for(node)
+            if spec is not None:
+                self._check_static_args(node, spec)
+            if isinstance(node, ast.FunctionDef) and self._is_traced(
+                node, spec
+            ):
+                statics = self._static_params(node, spec)
+                linter = _FunctionLinter(
+                    node, self.imports, self.reporter, outer_taint,
+                    static_params=statics,
+                )
+                linter.run()
+            else:
+                # Untraced function: still descend — it may define
+                # traced (annotated/jitted) functions inside.
+                for inner in node.body:
+                    self._lint_scope(inner, outer_taint)
+        elif isinstance(node, ast.ClassDef):
+            for inner in node.body:
+                self._lint_scope(inner, outer_taint)
+
+    # -- module-wide checks (R3 + R5 call sites) ------------------------
+
+    def _check_module_wide(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                resolved = self.imports.resolve(_dotted(node.func))
+                pos = _CTOR_DTYPE_POS.get(resolved or "")
+                if pos is not None:
+                    has_dtype = (
+                        len(node.args) > pos
+                        or any(kw.arg == "dtype" for kw in node.keywords)
+                    )
+                    if not has_dtype:
+                        short = resolved.replace("jax.numpy", "jnp")
+                        self.reporter.report(
+                            node, "R3",
+                            f"{short}() without an explicit dtype — the "
+                            "float32/int32 discipline requires dtype= "
+                            "(or the positional dtype argument)",
+                        )
+            elif isinstance(node, ast.Attribute):
+                resolved = self.imports.resolve(_dotted(node))
+                if resolved in _WIDE_DTYPES:
+                    self.reporter.report(
+                        node, "R3",
+                        f"64-bit dtype {resolved} — the simulation plane "
+                        "is float32/int32 (x64 stays disabled)",
+                    )
+
+    def _check_static_args(self, fn: ast.FunctionDef,
+                           spec: _JitSpec) -> None:
+        if not spec.names_literal:
+            self.reporter.report(
+                spec.node, "R5",
+                "static_argnames must be a literal string or tuple of "
+                "strings (computed values defeat the cache key)",
+            )
+        if not spec.nums_literal:
+            self.reporter.report(
+                spec.node, "R5",
+                "static_argnums must be a literal int or tuple of ints",
+            )
+        params = _param_names(fn)
+        for name in spec.static_names or ():
+            if name not in params:
+                self.reporter.report(
+                    spec.node, "R5",
+                    f"static_argnames names {name!r}, which is not a "
+                    f"parameter of {fn.name}()",
+                )
+                continue
+            why = _unhashable_param(fn, name)
+            if why:
+                self.reporter.report(
+                    spec.node, "R5",
+                    f"static arg {name!r} of {fn.name}() {why} — static "
+                    "args are cache keys and must be hashable",
+                )
+        for i in spec.static_nums or ():
+            if not 0 <= i < len(params):
+                self.reporter.report(
+                    spec.node, "R5",
+                    f"static_argnums index {i} is out of range for "
+                    f"{fn.name}() with {len(params)} parameters",
+                )
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Iterable[str]] = None) -> list[Violation]:
+    """Lint Python source text; returns violations sorted by position."""
+    active = frozenset(rules) if rules is not None else frozenset(RULES)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {sorted(unknown)}; known: {sorted(RULES)}"
+        )
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, (e.offset or 0), "E0",
+                          f"syntax error: {e.msg}")]
+    out = _ModuleLinter(tree, source, path, active).run()
+    return sorted(out, key=lambda v: (v.line, v.col, v.rule))
+
+
+def lint_file(path: str | Path,
+              rules: Optional[Iterable[str]] = None) -> list[Violation]:
+    p = Path(path)
+    return lint_source(p.read_text(), str(p), rules)
+
+
+def lint_paths(paths: Iterable[str | Path],
+               rules: Optional[Iterable[str]] = None) -> list[Violation]:
+    """Lint files and/or directory trees (``*.py``, recursively)."""
+    out: list[Violation] = []
+    for path in paths:
+        p = Path(path)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                out.extend(lint_file(f, rules))
+        else:
+            out.extend(lint_file(p, rules))
+    return out
+
+
+def default_paths() -> list[Path]:
+    """The simulation plane: models/, sim/, ops/ of this package."""
+    root = Path(__file__).resolve().parent.parent
+    return [root / "models", root / "sim", root / "ops"]
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tracelint",
+        description="JAX-aware static analysis for the simulation plane",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the "
+                             "package's models/ sim/ ops/)")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        dest="list_rules")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    files: list[Path] = []
+    for p in (args.paths or default_paths()):
+        p = Path(p)
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    try:
+        violations = lint_paths(files, rules)
+    except (ValueError, OSError) as e:
+        print(f"tracelint: {e}", file=sys.stderr)
+        return 2
+    for v in violations:
+        print(v.format())
+    if violations:
+        print(f"tracelint: {len(violations)} violation(s) in "
+              f"{len(files)} file(s)", file=sys.stderr)
+        return 1
+    print(f"tracelint: clean ({len(files)} file(s))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,6 +194,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("dst", nargs="?", default="")
     sp.add_argument("-deny", action="store_true")
 
+    # static analysis -----------------------------------------------------
+    sp = sub.add_parser(
+        "lint", help="tracelint: JAX-aware static analysis of the "
+                     "simulation plane"
+    )
+    sp.set_defaults(fn=cmd_lint)
+    sp.add_argument("paths", nargs="*",
+                    help="files or directories (default: the package's "
+                         "models/ sim/ ops/)")
+    sp.add_argument("--rules", default="",
+                    help="comma-separated rule ids, e.g. R1,R3 "
+                         "(default: all)")
+    sp.add_argument("--list-rules", action="store_true",
+                    dest="list_rules", help="enumerate rules and exit")
+
     # simulator -----------------------------------------------------------
     sp = sub.add_parser(
         "sim", help="run a TPU-simulator scenario preset"
@@ -205,7 +220,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="enumerate scenario presets and exit")
     sp.add_argument("-seed", type=int, default=0)
 
-    sub.add_parser("version").set_defaults(fn=cmd_version)
+    # Like the reference, version tolerates (and ignores) the global
+    # client flags so scripted `cli ... -http-addr X` loops can include
+    # it (sdk/testutil TestServer drives every command the same way).
+    cmd("version", cmd_version, "print the CLI version")
     return p
 
 
@@ -946,6 +964,21 @@ async def cmd_intention(args) -> int:
             return 0
     print("Error: no such intention", file=sys.stderr)
     return 1
+
+
+async def cmd_lint(args) -> int:
+    """tracelint over the simulation plane (consul_tpu.analysis): exits
+    nonzero on violations, printing clickable ``file:line:col rule
+    message`` lines.  Pure AST work — no JAX import, so the command
+    runs in accelerator-free containers."""
+    from consul_tpu.analysis.tracelint import main as tracelint_main
+
+    argv = list(args.paths)
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    return tracelint_main(argv)
 
 
 async def cmd_sim(args) -> int:

@@ -19,16 +19,31 @@ keeps verifying new leaves until its root set refreshes.
 from __future__ import annotations
 
 import datetime
+import types
 import uuid
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
-
 LEAF_TTL = datetime.timedelta(hours=72)   # ca config LeafCertTTL default
 ROOT_TTL = datetime.timedelta(days=10 * 365)
+
+
+def _crypto() -> types.SimpleNamespace:
+    """The optional ``cryptography`` toolkit, imported on first use so
+    agents that never touch Connect TLS run in minimal containers."""
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError as e:
+        raise RuntimeError(
+            "Connect CA operations require the optional 'cryptography' "
+            "package (pip install cryptography)"
+        ) from e
+    return types.SimpleNamespace(
+        x509=x509, hashes=hashes, serialization=serialization, ec=ec,
+        NameOID=NameOID,
+    )
 
 
 def spiffe_service(trust_domain: str, dc: str, service: str) -> str:
@@ -65,20 +80,21 @@ class BuiltinCA:
     def generate_root(self) -> dict:
         """A fresh self-signed root (provider_consul.go GenerateRoot);
         returns the store record for connect_ca_roots."""
-        self._key = ec.generate_private_key(ec.SECP256R1())
+        c = _crypto()
+        self._key = c.ec.generate_private_key(c.ec.SECP256R1())
         self.root_id = str(uuid.uuid4())
-        name = x509.Name([
-            x509.NameAttribute(
-                NameOID.COMMON_NAME, f"Consul CA {self.root_id[:8]}"
+        name = c.x509.Name([
+            c.x509.NameAttribute(
+                c.NameOID.COMMON_NAME, f"Consul CA {self.root_id[:8]}"
             ),
         ])
         now = _now()
         self._cert = (
-            x509.CertificateBuilder()
+            c.x509.CertificateBuilder()
             .subject_name(name)
             .issuer_name(name)
             .public_key(self._key.public_key())
-            .serial_number(x509.random_serial_number())
+            .serial_number(c.x509.random_serial_number())
             .not_valid_before(now - datetime.timedelta(minutes=1))
             .not_valid_after(now + ROOT_TTL)
             .add_extension(
@@ -87,17 +103,18 @@ class BuiltinCA:
                 # rotation (RFC 5280 pathLenConstraint; pathlen=0 would
                 # make every leaf->cross->old-root chain invalid to
                 # standards-compliant verifiers like OpenSSL).
-                x509.BasicConstraints(ca=True, path_length=1), critical=True
+                c.x509.BasicConstraints(ca=True, path_length=1),
+                critical=True,
             )
             .add_extension(
-                x509.SubjectAlternativeName([
-                    x509.UniformResourceIdentifier(
+                c.x509.SubjectAlternativeName([
+                    c.x509.UniformResourceIdentifier(
                         f"spiffe://{self.trust_domain}"
                     )
                 ]),
                 critical=False,
             )
-            .sign(self._key, hashes.SHA256())
+            .sign(self._key, c.hashes.SHA256())
         )
         return {
             "id": self.root_id,
@@ -109,7 +126,9 @@ class BuiltinCA:
 
     def root_pem(self) -> str:
         assert self._cert is not None
-        return self._cert.public_bytes(serialization.Encoding.PEM).decode()
+        return self._cert.public_bytes(
+            _crypto().serialization.Encoding.PEM
+        ).decode()
 
     def rotate(self) -> dict:
         """New active root; the caller stores it (old roots retained).
@@ -121,23 +140,24 @@ class BuiltinCA:
         rec = self.generate_root()
         self._cross_pem = None
         if old_key is not None and old_cert is not None:
+            c = _crypto()
             now = _now()
             cross = (
-                x509.CertificateBuilder()
+                c.x509.CertificateBuilder()
                 .subject_name(self._cert.subject)      # NEW root's name
                 .issuer_name(old_cert.subject)         # signed by OLD
                 .public_key(self._key.public_key())    # NEW root's key
-                .serial_number(x509.random_serial_number())
+                .serial_number(c.x509.random_serial_number())
                 .not_valid_before(now - datetime.timedelta(minutes=1))
                 .not_valid_after(now + ROOT_TTL)
                 .add_extension(
-                    x509.BasicConstraints(ca=True, path_length=0),
+                    c.x509.BasicConstraints(ca=True, path_length=0),
                     critical=True,
                 )
-                .sign(old_key, hashes.SHA256())
+                .sign(old_key, c.hashes.SHA256())
             )
             self._cross_pem = cross.public_bytes(
-                serialization.Encoding.PEM).decode()
+                c.serialization.Encoding.PEM).decode()
             rec["cross_signed_cert"] = self._cross_pem
         return rec
 
@@ -151,44 +171,45 @@ class BuiltinCA:
         the identity shape: a service, or an AGENT (auto-encrypt's
         client TLS bootstrap, auto_encrypt_endpoint.go Sign)."""
         assert self._cert is not None and self._key is not None
-        key = ec.generate_private_key(ec.SECP256R1())
+        c = _crypto()
+        key = c.ec.generate_private_key(c.ec.SECP256R1())
         if kind == "agent":
             uri = spiffe_agent(self.trust_domain, self.dc, service)
         else:
             uri = spiffe_service(self.trust_domain, self.dc, service)
         now = _now()
         cert = (
-            x509.CertificateBuilder()
-            .subject_name(x509.Name([
-                x509.NameAttribute(NameOID.COMMON_NAME, service),
+            c.x509.CertificateBuilder()
+            .subject_name(c.x509.Name([
+                c.x509.NameAttribute(c.NameOID.COMMON_NAME, service),
             ]))
             .issuer_name(self._cert.subject)
             .public_key(key.public_key())
-            .serial_number(x509.random_serial_number())
+            .serial_number(c.x509.random_serial_number())
             .not_valid_before(now - datetime.timedelta(minutes=1))
             .not_valid_after(now + LEAF_TTL)
             .add_extension(
-                x509.SubjectAlternativeName(
-                    [x509.UniformResourceIdentifier(uri)]
+                c.x509.SubjectAlternativeName(
+                    [c.x509.UniformResourceIdentifier(uri)]
                 ),
                 critical=False,
             )
             .add_extension(
-                x509.BasicConstraints(ca=False, path_length=None),
+                c.x509.BasicConstraints(ca=False, path_length=None),
                 critical=True,
             )
-            .sign(self._key, hashes.SHA256())
+            .sign(self._key, c.hashes.SHA256())
         )
         return {
             "service": service,
             "uri": uri,
             "cert_pem": cert.public_bytes(
-                serialization.Encoding.PEM
+                c.serialization.Encoding.PEM
             ).decode(),
             "key_pem": key.private_bytes(
-                serialization.Encoding.PEM,
-                serialization.PrivateFormat.PKCS8,
-                serialization.NoEncryption(),
+                c.serialization.Encoding.PEM,
+                c.serialization.PrivateFormat.PKCS8,
+                c.serialization.NoEncryption(),
             ).decode(),
             "root_id": self.root_id,
             # Chain material for old-root-pinned verifiers (empty when
@@ -210,10 +231,11 @@ def verify_leaf_chain(
     direct = verify_leaf(leaf_pem, root_pem)
     if direct is not None:
         return direct
+    c = _crypto()
     for inter_pem in intermediate_pems or []:
         try:
-            inter = x509.load_pem_x509_certificate(inter_pem.encode())
-            root = x509.load_pem_x509_certificate(root_pem.encode())
+            inter = c.x509.load_pem_x509_certificate(inter_pem.encode())
+            root = c.x509.load_pem_x509_certificate(root_pem.encode())
             inter.verify_directly_issued_by(root)
         except Exception:  # noqa: BLE001 - try the next intermediate
             continue
@@ -226,9 +248,10 @@ def verify_leaf_chain(
 def verify_leaf(leaf_pem: str, root_pem: str) -> Optional[str]:
     """Verify a leaf against a root; returns its SPIFFE URI when valid,
     None otherwise (connect/tls.go verification core)."""
+    c = _crypto()
     try:
-        leaf = x509.load_pem_x509_certificate(leaf_pem.encode())
-        root = x509.load_pem_x509_certificate(root_pem.encode())
+        leaf = c.x509.load_pem_x509_certificate(leaf_pem.encode())
+        root = c.x509.load_pem_x509_certificate(root_pem.encode())
         leaf.verify_directly_issued_by(root)
     except Exception:  # noqa: BLE001 - any failure = invalid
         return None
@@ -237,11 +260,11 @@ def verify_leaf(leaf_pem: str, root_pem: str) -> Optional[str]:
         return None
     try:
         san = leaf.extensions.get_extension_for_class(
-            x509.SubjectAlternativeName
+            c.x509.SubjectAlternativeName
         )
         uris = san.value.get_values_for_type(
-            x509.UniformResourceIdentifier
+            c.x509.UniformResourceIdentifier
         )
         return uris[0] if uris else None
-    except x509.ExtensionNotFound:
+    except c.x509.ExtensionNotFound:
         return None

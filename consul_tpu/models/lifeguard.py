@@ -162,7 +162,7 @@ def lifeguard_round(
         wire_ok = jax.random.uniform(k_loss, (n, cfg.fanout)) < p_edge
         wire_ok = wire_ok & jnp.take(participates, targets)
 
-        def rx_era(kcls, tx_left, era):
+        def rx_era(tx_left, era):
             send = can_send & (tx_left > 0)
             delivered = send[:, None] & wire_ok
             vals = jnp.broadcast_to(era[:, None], (n, cfg.fanout))
@@ -170,9 +170,9 @@ def lifeguard_round(
                 jnp.full((n,), NO_MSG, jnp.int32), targets, vals, delivered
             )
 
-        sus_rx = rx_era(None, state.tx_suspect, state.sus_era)
-        dead_rx = rx_era(None, state.tx_dead, state.dead_era)
-        ref_rx = rx_era(None, state.tx_refute, state.ref_era)
+        sus_rx = rx_era(state.tx_suspect, state.sus_era)
+        dead_rx = rx_era(state.tx_dead, state.dead_era)
+        ref_rx = rx_era(state.tx_refute, state.ref_era)
     else:
         # Weighted Poissonized arrivals: each sender's copies survive
         # with its own probability, each receiver sums the reachable

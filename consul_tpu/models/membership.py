@@ -194,7 +194,8 @@ def membership_init(cfg: MembershipConfig) -> MembershipState:
     key = jnp.zeros((n, n), jnp.int32)
     key = jnp.where(joiner[None, :], -1, key)   # nobody knows a joiner
     key = jnp.where(joiner[:, None], -1, key)   # a joiner knows nobody
-    key = key.at[jnp.arange(n), jnp.arange(n)].set(0)  # ...but itself
+    diag = jnp.arange(n, dtype=jnp.int32)
+    key = key.at[diag, diag].set(0)  # ...but itself
     return MembershipState(
         key=key,
         suspect_since=jnp.full((n, n), NEVER, jnp.int32),

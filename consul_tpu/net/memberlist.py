@@ -433,8 +433,18 @@ class Memberlist:
 
     async def _probe_node(self, node: Node) -> None:
         profile = self.config.profile
-        cycle_deadline = asyncio.get_running_loop().time() + self.config.s(
-            profile.probe_interval_ms
+        # The WHOLE probe cycle scales with local health, not just the
+        # direct-ack wait (probeNode: `probeInterval = awareness.
+        # ScaleTimeout(m.config.ProbeInterval)`, state.go:283-300) —
+        # otherwise at score >= 1 the scaled direct wait eats the
+        # cycle, the indirect/NACK phase is starved of its window, and
+        # the missing NACKs ratchet the score to max (the opposite of
+        # the Lifeguard rescue).  Same formula as the sim model
+        # (models/lifeguard.py cycle = awareness_scaled_timeout(...)).
+        cycle_deadline = asyncio.get_running_loop().time() + (
+            self.awareness.scale_timeout(
+                self.config.s(profile.probe_interval_ms)
+            )
         )
         timeout = self.awareness.scale_timeout(
             self.config.s(profile.probe_timeout_ms)

@@ -22,8 +22,6 @@ import base64
 import os
 from typing import Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
 ENCRYPTION_VERSION = 1
 NONCE_SIZE = 12
 KEY_SIZES = (16, 24, 32)
@@ -31,6 +29,20 @@ KEY_SIZES = (16, 24, 32)
 
 class SecurityError(Exception):
     """Undecryptable or malformed sealed payload."""
+
+
+def _aesgcm(key: bytes):
+    """The optional ``cryptography`` AEAD, imported on first seal/open —
+    keyring bookkeeping and keygen stay usable without the package."""
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError as e:
+        raise RuntimeError(
+            "gossip encryption requires the optional 'cryptography' "
+            "package (pip install cryptography), or run with "
+            "encryption disabled"
+        ) from e
+    return AESGCM(key)
 
 
 def generate_key(size: int = 32) -> str:
@@ -95,7 +107,7 @@ class Keyring:
     def encrypt(self, payload: bytes) -> bytes:
         nonce = os.urandom(NONCE_SIZE)
         version = bytes([ENCRYPTION_VERSION])
-        ct = AESGCM(self._primary).encrypt(nonce, payload, version)
+        ct = _aesgcm(self._primary).encrypt(nonce, payload, version)
         return version + nonce + ct
 
     def decrypt(self, blob: bytes) -> bytes:
@@ -107,8 +119,9 @@ class Keyring:
         # Try every key: mid-rotation peers may still seal with an older
         # primary (security.go decryptPayload loops the keyring).
         for key in self._keys:
+            aead = _aesgcm(key)  # missing-lib RuntimeError must escape
             try:
-                return AESGCM(key).decrypt(nonce, ct, version)
+                return aead.decrypt(nonce, ct, version)
             except Exception:  # noqa: BLE001 - wrong key, try next
                 continue
         raise SecurityError("no installed key decrypts the payload")

@@ -83,7 +83,7 @@ def aggregate_arrivals(
         * (1.0 - loss)
         / max(n - 1, 1)
     )
-    return jax.random.uniform(key, (n,)) < -jnp.expm1(-lam)
+    return poissonized_arrivals(key, jnp.broadcast_to(lam, (n,)))
 
 
 def poissonized_arrivals(key: jax.Array, lam: jax.Array) -> jax.Array:

@@ -216,13 +216,19 @@ class FalsePositiveReport:
 
     def time_to_true_dead_ms(self) -> Optional[float]:
         """Simulated ms from the subject's actual crash to the first
-        observer viewing it DEAD (None for FP studies or if never)."""
+        observer viewing it DEAD (None for FP studies or if never).
+
+        Only ticks at/after the crash count: a false-DEAD view that a
+        refute later repairs (the race the model permits under FP
+        pressure) must not produce a negative or pre-crash time.
+        """
         if self.subject_alive:
             return None
-        t = self.first_tick(self.dead_known)
+        since_fail = np.asarray(self.dead_known)[self.fail_at_tick:]
+        t = self.first_tick(since_fail)
         if t is None:
             return None
-        return (t + 1 - self.fail_at_tick) * self.tick_ms
+        return (t + 1) * self.tick_ms
 
     def summary(self) -> dict:
         return {

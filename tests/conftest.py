@@ -28,6 +28,7 @@ jax.config.update("jax_platforms", "cpu")
 # ---------------------------------------------------------------------------
 
 import inspect  # noqa: E402
+import pathlib  # noqa: E402
 
 import pytest  # noqa: E402
 
@@ -38,6 +39,62 @@ def pytest_configure(config):
         "markers",
         "slow: >30s-at-CPU simulations, excluded from tier-1 "
         "(run with -m slow)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "single_trace(max_traces=1, entrypoints=...): fail the test if "
+        "the jitted sim.engine scan entrypoints compile more than "
+        "max_traces new programs during the test "
+        "(consul_tpu.analysis.guards retrace counters)",
+    )
+
+
+@pytest.fixture(autouse=True)
+def retrace_guard(request):
+    """Retrace-count guard over the jitted study entrypoints.
+
+    Opt in with ``@pytest.mark.single_trace`` (optionally
+    ``max_traces=N`` / ``entrypoints=("swim_scan", ...)``); the fixture
+    snapshots each entrypoint's compile cache before the test and fails
+    it afterwards if any entrypoint compiled more than the budget —
+    the "whole study = one XLA program" contract as a one-line marker.
+    Request the fixture by name for mid-test ``.check()`` /
+    ``.traces`` access (a dict of name -> TraceGuard, or None when the
+    marker is absent).
+    """
+    marker = request.node.get_closest_marker("single_trace")
+    if marker is None:
+        yield None
+        return
+    from consul_tpu.analysis.guards import check_all, guard_entrypoints
+
+    guards = guard_entrypoints(**marker.kwargs)
+    yield guards
+    check_all(guards)
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 budget ordering.  These host-plane suites used to error at
+# collection in minimal containers (module-level `cryptography` imports)
+# and only recently became collectable; they run AFTER the long-
+# established tier so a fixed wall-clock budget cuts the newest coverage
+# first, never the baseline.
+# ---------------------------------------------------------------------------
+
+_LATE_MODULES = frozenset({
+    "test_acl", "test_agent", "test_autoconfig", "test_cache",
+    "test_cli_api", "test_cluster_agents", "test_config", "test_connect",
+    "test_discoverychain", "test_eventing", "test_federation", "test_fsm",
+    "test_http_dns", "test_memberlist", "test_multidc_host", "test_proxy",
+    "test_realsocket_agent", "test_replication", "test_resilience",
+    "test_sim_transport", "test_stream", "test_surface", "test_xds",
+})
+
+
+def pytest_collection_modifyitems(config, items):
+    items.sort(  # stable: preserves order within each half
+        key=lambda item: pathlib.Path(str(item.fspath)).stem
+        in _LATE_MODULES
     )
 
 

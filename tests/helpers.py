@@ -1,6 +1,18 @@
 """Shared async test helpers (one canonical copy for all suites)."""
 
 import asyncio
+import importlib.util
+
+import pytest
+
+# The optional crypto toolkit: gossip encryption, Connect CA and
+# RS256/ES256 JWT tests need it; everything else runs without it
+# (connect/ca.py, net/security.py, acl/jwt.py import it lazily).
+HAVE_CRYPTO = importlib.util.find_spec("cryptography") is not None
+requires_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTO,
+    reason="needs the optional 'cryptography' package",
+)
 
 
 async def wait_until(pred, timeout=30.0, step=0.02):

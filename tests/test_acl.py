@@ -13,6 +13,7 @@ import json
 import pytest
 
 from helpers import wait_for as wait_until
+from helpers import requires_crypto
 
 from consul_tpu.acl.engine import (
     ACLError,
@@ -313,6 +314,7 @@ class TestHardenedSurfaces:
                 headers={"X-Consul-Token": MASTER})
             assert st == 404  # gate passed; no such failed member
 
+    @requires_crypto
     async def test_auto_encrypt_sign_requires_node_write(self):
         from consul_tpu.agent.rpc import RPCError
 

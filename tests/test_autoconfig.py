@@ -11,6 +11,11 @@ import asyncio
 
 import pytest
 
+# Every bootstrap response carries gossip keys AND a signed TLS leaf
+# (auto_encrypt shape): without the optional crypto toolkit the server
+# cannot answer and the client retries forever.
+pytest.importorskip("cryptography")
+
 from helpers import wait_for as wait_until
 
 from consul_tpu.acl.jwt import encode_hs256

@@ -12,6 +12,7 @@ import json
 import pytest
 
 from helpers import wait_for as wait_until
+from helpers import requires_crypto
 
 from consul_tpu.connect import BuiltinCA, spiffe_service, verify_leaf
 
@@ -25,6 +26,7 @@ def run(coro, timeout=90):
 # ---------------------------------------------------------------------------
 
 
+@requires_crypto
 def test_ca_root_and_leaf_lifecycle():
     ca = BuiltinCA("dc1")
     root = ca.generate_root()
@@ -45,6 +47,7 @@ def test_ca_root_and_leaf_lifecycle():
     assert verify_leaf(leaf["cert_pem"], other_root["root_cert"]) is None
 
 
+@requires_crypto
 def test_ca_rotation_keeps_old_root_verifiable():
     ca = BuiltinCA("dc1")
     root1 = ca.generate_root()
@@ -64,6 +67,7 @@ def test_ca_rotation_keeps_old_root_verifiable():
 # ---------------------------------------------------------------------------
 
 
+@requires_crypto
 def test_connect_http_leaf_and_intentions():
     async def main():
         import sys
@@ -134,6 +138,7 @@ def test_connect_http_leaf_and_intentions():
     run(main())
 
 
+@requires_crypto
 def test_mtls_service_to_service():
     """Full Connect data path (connect/service.go): two services get
     SPIFFE leaves from the agent, speak mutual TLS, and the server side
@@ -329,6 +334,7 @@ def test_member_event_coalescing():
     run(main())
 
 
+@requires_crypto
 def test_auto_encrypt_client_bootstrap():
     """auto_encrypt_endpoint.go Sign: a client agent fetches an
     agent-kind SPIFFE leaf + CA roots from the servers at startup."""
@@ -376,6 +382,7 @@ def test_auto_encrypt_client_bootstrap():
     run(main())
 
 
+@requires_crypto
 def test_rotation_cross_signs_for_old_root_verifiers():
     """provider_consul.go CrossSignCA: after rotation, leaves signed by
     the NEW root must verify for a peer still pinned to the OLD root,

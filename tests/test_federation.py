@@ -13,6 +13,7 @@ import asyncio
 import pytest
 
 from helpers import wait_for as wait_until
+from helpers import requires_crypto
 from helpers import wait_for_leader
 
 from consul_tpu.agent.server import Server, ServerConfig
@@ -219,6 +220,7 @@ class TestFederationHTTP:
 
 
 class TestGatewayRoutedUpstreams:
+    @requires_crypto
     async def test_proxycfg_routes_remote_target_through_gateways(self):
         from test_http_dns import dev_stack
 

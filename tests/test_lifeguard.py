@@ -144,17 +144,21 @@ class TestDegradedAccuracy:
         assert on.fp_rate < off.fp_rate, (on.fp_rate, off.fp_rate)
         assert on.flap_count <= off.flap_count
 
-    def test_single_jit_trace_per_study(self):
+    @pytest.mark.single_trace(entrypoints=("lifeguard_scan",))
+    def test_single_jit_trace_per_study(self, retrace_guard):
         # The whole study must compile as ONE lax.scan program: a second
-        # run with the same static config may not retrace.
+        # run with the same static config may not retrace (the marker
+        # also re-checks at teardown via analysis.guards).
         cfg = degraded_cfg(128)
-        before = lifeguard_scan._cache_size()
         run_lifeguard(cfg, steps=20, seed=0, warmup=False)
-        mid = lifeguard_scan._cache_size()
+        guard = retrace_guard["lifeguard_scan"]
+        # Exactly one: the study really compiled through the jitted
+        # entrypoint (0 would mean it bypassed lifeguard_scan).
+        assert guard.traces == 1
         run_lifeguard(cfg, steps=20, seed=1, warmup=False)
-        after = lifeguard_scan._cache_size()
-        assert mid == before + 1
-        assert after == mid, "same config retraced — not a single program"
+        assert guard.traces == 1, (
+            "same config retraced — not a single program"
+        )
 
     def test_report_shapes_are_o_ticks(self):
         # Same (cfg, steps) as the trace-count test above — reuses its

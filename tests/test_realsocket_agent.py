@@ -12,6 +12,7 @@ import asyncio
 import pytest
 
 from helpers import wait_for as wait_until
+from helpers import requires_crypto
 
 from consul_tpu.agent.agent import Agent, AgentConfig
 from consul_tpu.agent.dns import DNSServer
@@ -156,6 +157,7 @@ class TestMaintenanceMode:
 
 
 class TestNewWatchTypes:
+    @requires_crypto
     async def test_connect_roots_leaf_and_agent_service_watches(self):
         from test_http_dns import dev_stack
 

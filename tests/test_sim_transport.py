@@ -207,6 +207,7 @@ def test_push_pull_backstop_syncs_host():
     run(main())
 
 
+@pytest.mark.slow  # ~105s at CPU: the 10k pool compiles big scans
 def test_ten_thousand_member_pool():
     """The VERDICT acceptance bar: a real Memberlist joins a 10k+-member
     simulated pool, hears about a simulated failure, and a user event

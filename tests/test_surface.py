@@ -12,6 +12,7 @@ import json
 import pytest
 
 from helpers import wait_for as wait_until
+from helpers import requires_crypto
 
 from consul_tpu.telemetry import Metrics
 from consul_tpu.agent.bexpr import FilterError, create_filter
@@ -155,6 +156,7 @@ def test_http_filter_param():
 # ---------------------------------------------------------------------------
 
 
+@requires_crypto
 def test_keyring_seal_open_and_rotation():
     k1, k2 = generate_key(), generate_key()
     ring = Keyring.from_b64(k1)
@@ -179,6 +181,7 @@ def test_keyring_seal_open_and_rotation():
         stranger.decrypt(ring.encrypt(b"secret"))
 
 
+@requires_crypto
 def test_encrypted_cluster_forms_and_rejects_plaintext():
     async def main():
         from consul_tpu.eventing.cluster import Cluster, ClusterConfig
@@ -214,6 +217,7 @@ def test_encrypted_cluster_forms_and_rejects_plaintext():
     run(main())
 
 
+@requires_crypto
 def test_cluster_wide_key_rotation_via_queries():
     async def main():
         from consul_tpu.eventing.cluster import Cluster, ClusterConfig

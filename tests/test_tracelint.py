@@ -1,0 +1,542 @@
+"""tracelint + retrace guards: the correctness tooling of the
+simulation plane (consul_tpu.analysis).
+
+Per rule: a bad-snippet fixture the rule must fire on and a clean twin
+it must stay silent on.  Then the gate itself: the repo's own models/
+sim/ ops/ trees lint clean, and the jitted study entrypoints hold the
+single-trace contract under the runtime guards.
+"""
+
+import asyncio
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+import consul_tpu
+from consul_tpu.analysis import (
+    RULES,
+    RetraceError,
+    lint_paths,
+    lint_source,
+    trace_guard,
+)
+
+PKG_ROOT = pathlib.Path(consul_tpu.__file__).resolve().parent
+LINT_TREES = [PKG_ROOT / "models", PKG_ROOT / "sim", PKG_ROOT / "ops"]
+
+
+def rules_at(src: str, rule: str = None):
+    vs = lint_source(src)
+    return [v.rule for v in vs if rule is None or v.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# Rule fixtures: each fires on its bad snippet, stays silent on the twin.
+# ---------------------------------------------------------------------------
+
+# (rule, bad snippet, clean twin)
+SNIPPETS = [
+    ("R1", """
+import jax
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+""", """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    return jnp.where(x > 0, x, -x)
+"""),
+    ("R1", """
+import jax
+@jax.jit
+def f(x):
+    assert x > 0
+    return x
+""", """
+import jax
+from typing import Optional
+@jax.jit
+def f(x, alive: Optional[jax.Array] = None):
+    if alive is not None:
+        x = x * alive
+    assert isinstance(x, object)
+    return x
+"""),
+    ("R2", """
+import jax
+@jax.jit
+def f(x):
+    return float(x)
+""", """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    return x.astype(jnp.float32)
+"""),
+    ("R2", """
+import jax
+import numpy as np
+@jax.jit
+def f(x):
+    return np.asarray(x).sum()
+""", """
+import numpy as np
+def report(counts: np.ndarray):
+    # Host report plane: np.asarray on host data is fine.
+    return int(np.asarray(counts).sum())
+"""),
+    ("R3", """
+import jax.numpy as jnp
+def init(n: int):
+    return jnp.zeros((n,))
+""", """
+import jax.numpy as jnp
+def init(n: int):
+    return jnp.zeros((n,), jnp.int32), jnp.ones((n,), dtype=jnp.float32)
+"""),
+    ("R3", """
+import jax.numpy as jnp
+def widen(x):
+    return x.astype(jnp.float64)
+""", """
+import jax.numpy as jnp
+def keep(x):
+    return x.astype(jnp.float32)
+"""),
+    ("R4", """
+import jax, time
+@jax.jit
+def f(x):
+    return x + time.time()
+""", """
+import jax, time
+def run(scan_fn, state):
+    t0 = time.time()  # host timing around the jitted call: fine
+    out = scan_fn(state)
+    return out, time.time() - t0
+"""),
+    ("R4", """
+import jax
+import numpy as np
+@jax.jit
+def f(x):
+    return x + np.random.rand()
+""", """
+import jax
+@jax.jit
+def f(x, key: jax.Array):
+    return x + jax.random.uniform(key)
+"""),
+    ("R5", """
+import functools, jax
+@functools.partial(jax.jit, static_argnames=("missing",))
+def f(x, cfg):
+    return x
+""", """
+import functools, jax
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+def f(state, key, cfg, steps: int):
+    return state
+"""),
+    ("R5", """
+import functools, jax
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg: list):
+    return x
+""", """
+import functools, jax
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg: tuple = ()):
+    return x
+"""),
+    ("R6", """
+import jax
+@jax.jit
+def f(x):
+    return x[x > 0]
+""", """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    return jnp.where(x > 0, x, 0).sum()
+"""),
+    ("R6", """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    return jnp.where(x > 0)
+""", """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x, idx: jax.Array):
+    return x[idx]  # integer gather keeps shapes static
+"""),
+    ("R7", """
+import jax
+@jax.jit
+def f(x):
+    return [v + 1 for v in x]
+""", """
+import jax
+def init(cfg: FaultSchedule):
+    return [s for s, _ in cfg.pieces]  # static tuple: fine
+"""),
+    ("R7", """
+import jax
+@jax.jit
+def f(x):
+    total = 0.0
+    for v in x:
+        total = total + v
+    return total
+""", """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x, cfg: SwimConfig):
+    for ramp in cfg.ramps:  # static config tuple: unrolls by design
+        x = x + ramp
+    return jnp.sum(x)
+"""),
+    ("R8", """
+import jax
+@jax.jit
+def f(state):
+    state.count = state.count + 1
+    return state
+""", """
+import jax
+@jax.jit
+def f(state):
+    return state._replace(count=state.count + 1)
+"""),
+    ("R8", """
+import jax
+@jax.jit
+def f(x):
+    x[0] = 1.0
+    return x
+""", """
+import jax
+@jax.jit
+def f(x):
+    return x.at[0].set(1.0)
+"""),
+]
+
+
+class TestRules:
+    @pytest.mark.parametrize(
+        "rule,bad,clean",
+        SNIPPETS,
+        ids=[f"{r}-{i}" for i, (r, _, _) in enumerate(SNIPPETS)],
+    )
+    def test_fires_on_bad_silent_on_clean(self, rule, bad, clean):
+        assert rule in rules_at(bad), f"{rule} must fire on its fixture"
+        assert rules_at(clean, rule) == [], (
+            f"{rule} must stay silent on the clean twin: "
+            f"{lint_source(clean)}"
+        )
+
+    def test_every_rule_has_a_fixture(self):
+        covered = {r for r, _, _ in SNIPPETS}
+        assert covered == set(RULES), (
+            f"rules without fixtures: {set(RULES) - covered}"
+        )
+
+
+class TestTracedFunctionDiscovery:
+    def test_scan_body_is_traced(self):
+        src = """
+import jax
+def body(carry, x):
+    if carry > 0:
+        carry = carry - 1
+    return carry, x
+def run(xs):
+    return jax.lax.scan(body, 0, xs)
+"""
+        assert "R1" in rules_at(src)
+
+    def test_annotation_seeds_tracing(self):
+        src = """
+import jax
+def round_fn(state, key: jax.Array, cfg: SwimConfig):
+    if state.tick > 0:
+        return state
+    return state
+"""
+        assert "R1" in rules_at(src)
+
+    def test_state_annotation_alone_seeds_tracing(self):
+        # Carry types end in "State" (SwimState, MembershipState...) —
+        # a function with ONLY a state param is still traced code.
+        src = """
+def densify(state: SparseMembershipState, n: int):
+    if state.tick > 0:
+        return state
+    return state
+"""
+        assert "R1" in rules_at(src)
+
+    def test_static_config_branch_is_silent(self):
+        src = """
+import jax
+def round_fn(state, key: jax.Array, cfg: SwimConfig):
+    if cfg.delivery == "edges":
+        return state
+    return state
+"""
+        assert rules_at(src) == []
+
+    def test_nested_function_inherits_trace(self):
+        src = """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def outer(x):
+    def rx(era):
+        if era > 0:
+            return era
+        return -era
+    return rx(x)
+"""
+        assert "R1" in rules_at(src)
+
+    def test_static_container_of_traced_values_iterates_clean(self):
+        # A Python list literal holding traced arrays has a
+        # trace-time-static length: iterating it is pytree plumbing
+        # (membership_sparse.py's arrs pattern), not an R7 loop.
+        src = """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x, y):
+    arrs = [(x, y)]
+    arrs.append((y, x))
+    return jnp.concatenate([a[0] for a in arrs])
+"""
+        assert rules_at(src) == []
+
+    def test_static_container_elements_stay_traced(self):
+        # Iterating the container is fine (no R7), but the loop
+        # variable holds tracers — branching on it still fires R1.
+        src = """
+import jax
+@jax.jit
+def f(x, y):
+    arrs = [x, y]
+    for a in arrs:
+        if a > 0:
+            return a
+    return x
+"""
+        rules = rules_at(src)
+        assert "R1" in rules and "R7" not in rules
+
+    def test_lambda_object_is_not_traced_data(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    g = lambda v: v + 1
+    if g:
+        return g(x)
+    return x
+"""
+        assert rules_at(src) == []
+
+    def test_plain_host_function_is_untraced(self):
+        src = """
+import time
+def timed(fn, state):
+    t0 = time.perf_counter()
+    if state:
+        fn(state)
+    return time.perf_counter() - t0
+"""
+        assert rules_at(src) == []
+
+
+class TestSuppression:
+    def test_line_comment_suppresses_named_rule(self):
+        src = """
+import jax.numpy as jnp
+def init(n: int):
+    return jnp.zeros((n,))  # tracelint: disable=R3
+"""
+        assert rules_at(src) == []
+
+    def test_bare_disable_suppresses_all(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    if x > 0:  # tracelint: disable
+        return float(x)  # tracelint: disable
+    return x
+"""
+        assert rules_at(src) == []
+
+    def test_other_rule_not_suppressed(self):
+        src = """
+import jax.numpy as jnp
+def init(n: int):
+    return jnp.zeros((n,))  # tracelint: disable=R1
+"""
+        assert rules_at(src) == ["R3"]
+
+    def test_rules_filter(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    if x > 0:
+        return float(x)
+    return x
+"""
+        assert {v.rule for v in lint_source(src)} == {"R1", "R2"}
+        assert {v.rule for v in lint_source(src, rules={"R2"})} == {"R2"}
+        with pytest.raises(ValueError):
+            lint_source(src, rules={"R99"})
+
+
+class TestRepoGate:
+    """The gate the CI story rides on: the simulation plane lints clean."""
+
+    def test_models_sim_ops_are_clean(self):
+        violations = lint_paths(LINT_TREES)
+        assert violations == [], "\n".join(v.format() for v in violations)
+
+    def test_cli_lint_clean_exits_zero(self):
+        from consul_tpu.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["lint", *[str(p) for p in LINT_TREES]]
+        )
+        assert asyncio.run(args.fn(args)) == 0
+
+    def test_cli_lint_violations_exit_nonzero(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        from consul_tpu.cli import build_parser
+
+        args = build_parser().parse_args(["lint", str(bad)])
+        assert asyncio.run(args.fn(args)) == 1
+        out = capsys.readouterr().out
+        assert f"{bad}:4:" in out and "R1" in out
+
+    def test_cli_lint_list_rules(self, capsys):
+        from consul_tpu.cli import build_parser
+
+        args = build_parser().parse_args(["lint", "--list-rules"])
+        assert asyncio.run(args.fn(args)) == 0
+        out = capsys.readouterr().out
+        for rule in RULES:
+            assert rule in out
+
+    def test_module_entrypoint(self):
+        # python -m consul_tpu.analysis.tracelint defaults to the
+        # simulation plane and needs no JAX (accelerator-free lint).
+        proc = subprocess.run(
+            [sys.executable, "-m", "consul_tpu.analysis.tracelint"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Runtime retrace guards.
+# ---------------------------------------------------------------------------
+
+
+class TestTraceGuard:
+    def test_guard_counts_and_passes_single_trace(self):
+        import jax.numpy as jnp
+
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        g = trace_guard(f)
+        g(jnp.ones((4,), jnp.float32))
+        g(jnp.zeros((4,), jnp.float32))
+        assert g.traces == 1 and g.calls == 2
+        assert len(calls) == 1, "second call must reuse the program"
+
+    def test_guard_fails_deliberate_retrace(self):
+        import jax.numpy as jnp
+
+        g = trace_guard(lambda x: x + 1, name="retracer")
+        g(jnp.ones((4,), jnp.float32))
+        with pytest.raises(RetraceError, match="retracer"):
+            # New shape -> new static signature -> second program.
+            g(jnp.ones((5,), jnp.float32))
+
+    def test_guard_budget_two_allows_warmup_pair(self):
+        import jax.numpy as jnp
+
+        g = trace_guard(lambda x: x + 1, max_traces=2)
+        g(jnp.ones((4,), jnp.float32))
+        g(jnp.ones((5,), jnp.float32))
+        assert g.traces == 2
+
+    def test_reset_resnapshots(self):
+        import jax.numpy as jnp
+
+        g = trace_guard(lambda x: x * 3)
+        g(jnp.ones((4,), jnp.float32))
+        g.reset()
+        assert g.traces == 0
+        g(jnp.ones((4,), jnp.float32))
+        g.check()
+
+    def test_rejects_unjittable_wrapper(self):
+        with pytest.raises(TypeError):
+            from consul_tpu.analysis.guards import TraceGuard
+
+            TraceGuard(print)
+
+    @pytest.mark.single_trace(
+        entrypoints=("broadcast_scan", "swim_scan", "lifeguard_scan")
+    )
+    def test_engine_entrypoints_hold_single_trace(self, retrace_guard):
+        # The named scans must run a study end to end on ONE program
+        # each — the marker re-verifies at teardown.
+        from consul_tpu.models import LifeguardConfig
+        from consul_tpu.models.broadcast import BroadcastConfig
+        from consul_tpu.models.swim import SwimConfig
+        from consul_tpu.sim.engine import (
+            run_broadcast,
+            run_lifeguard,
+            run_swim,
+        )
+
+        bcfg = BroadcastConfig(n=64)
+        scfg = SwimConfig(n=64, subject=1, loss=0.05)
+        lcfg = LifeguardConfig(n=64, subject=1, subject_alive=True)
+        for seed in (0, 1):
+            run_broadcast(bcfg, steps=8, seed=seed, warmup=False)
+            run_swim(scfg, steps=8, seed=seed, warmup=False)
+            run_lifeguard(lcfg, steps=8, seed=seed, warmup=False)
+        for name in ("broadcast_scan", "swim_scan", "lifeguard_scan"):
+            assert retrace_guard[name].traces <= 1

@@ -13,6 +13,8 @@ import pathlib
 
 import pytest
 
+from helpers import requires_crypto
+
 from consul_tpu.connect.discoverychain import compile_chain
 from consul_tpu.connect.xds import (
     CLUSTER_TYPE,
@@ -228,6 +230,7 @@ class TestRBAC:
 
 
 class TestHTTPSurface:
+    @requires_crypto
     async def test_xds_feed_over_http(self):
         from test_http_dns import dev_stack, http_call
 
