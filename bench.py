@@ -35,6 +35,7 @@ running (the serf.io simulator is not in-repo).  vs_baseline = value/2.
 from __future__ import annotations
 
 import json
+import time
 
 from consul_tpu.models import SwimConfig
 from consul_tpu.models.broadcast import BroadcastConfig
@@ -48,6 +49,78 @@ N = 1_000_000
 STEPS = 450
 STEPS_EDGES = 100  # exact path: rate measurement only
 REALTIME_ROUNDS_PER_SEC = 1000.0 / WAN.gossip_interval_ms  # 2.0
+
+
+def _available_memory_gb():
+    """MemAvailable from /proc/meminfo, or None when unreadable."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) / 1e6
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def _sparse_arrival_count(mcfg) -> int:
+    """Arrival-stream length of one sparse tick (gossip + compacted
+    push/pull) — the model's own static-shape accounting."""
+    from consul_tpu.models.membership_sparse import arrival_count
+
+    return arrival_count(mcfg)
+
+
+def _sparse_phase_times(mcfg, rounds_per_sec: float) -> dict:
+    """Per-phase wall split of a sparse round: the jitted sort-merge
+    delivery kernel timed alone on a synthetic stream of the round's
+    exact shapes, vs everything else (gossip emit + probe/suspicion
+    planes) as the remainder of the measured round time."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consul_tpu.models.membership_sparse import (
+        _merge_arrivals,
+        sparse_membership_init,
+    )
+
+    base = mcfg.base
+    n, K = base.n, min(mcfg.k_slots, base.n)
+    A = _sparse_arrival_count(mcfg)
+    st = sparse_membership_init(mcfg)
+    rng = np.random.default_rng(0)
+    stream = (
+        jnp.asarray(rng.integers(0, n, A), jnp.int32),   # recv
+        jnp.asarray(rng.integers(0, n, A), jnp.int32),   # subj
+        jnp.asarray(rng.integers(0, 8, A), jnp.int32),   # val
+        jnp.full((A,), -1, jnp.int32),                   # sus
+        jnp.asarray(rng.random(A) < 0.5),                # ok
+        jnp.ones((A,), bool),                            # alloc
+    )
+
+    @jax.jit
+    def merge_once(slots, recv, subj, val, sus, ok, alloc):
+        slots_t, key_rx, sus_rx, ov, fg = _merge_arrivals(
+            slots, recv, subj, val, sus, ok, alloc, n, K,
+            jnp.int32(0), jnp.int32(0),
+        )
+        return slots_t, key_rx, sus_rx, ov, fg
+
+    slots = (st.slot_subj, st.key, st.suspect_since, st.confirms, st.tx)
+    out = merge_once(slots, *stream)                     # compile once
+    jax.tree_util.tree_map(np.asarray, out)
+    iters = 2
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = merge_once(slots, *stream)
+    jax.tree_util.tree_map(np.asarray, out)
+    merge_s = (time.perf_counter() - t0) / iters
+    total_s = 1.0 / rounds_per_sec if rounds_per_sec > 0 else float("inf")
+    return {
+        "sparse_phase_merge_s": round(merge_s, 4),
+        "sparse_phase_other_s": round(max(total_s - merge_s, 0.0), 4),
+    }
 
 
 def main() -> None:
@@ -98,8 +171,60 @@ def main() -> None:
                 mreport.rounds_per_sec, 2),
             "membership_sparse_overflow": int(moverflow),
         }
+        try:
+            # Merge-kernel vs emit/probe split of one round (the
+            # sort-merge kernel timed standalone at identical shapes).
+            # Own guard: a diagnostic failure must not discard the
+            # headline sparse metric measured above.
+            membership.update(
+                _sparse_phase_times(mcfg, mreport.rounds_per_sec)
+            )
+        except Exception as e:  # noqa: BLE001 - keep the primary datapoint
+            membership["sparse_phase_error"] = str(e)[:200]
+
     except Exception as e:  # noqa: BLE001 - report the miss, keep headline
         membership = {"membership_sparse_error": str(e)[:200]}
+
+    # The configuration the sparse representation exists for: one
+    # MILLION observers (dense state would need ~20 TB).  The arrival
+    # sort peaks well past small-host RAM, so CPU containers without
+    # headroom skip cleanly instead of OOMing; accelerators (device
+    # memory, not MemAvailable) always try, with their own guard.
+    try:
+        import jax as _jax
+
+        from consul_tpu.models import SparseMembershipConfig
+        from consul_tpu.models.membership import MembershipConfig
+        from consul_tpu.sim import run_membership_sparse
+
+        mcfg1m = SparseMembershipConfig(
+            base=MembershipConfig(n=1_000_000, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),)),
+            k_slots=64,
+        )
+        need_gb = (
+            _sparse_arrival_count(mcfg1m) * 4 * 24
+            + 5 * 1_000_000 * 64 * 4 * 3
+        ) / 1e9
+        avail_gb = _available_memory_gb()
+        if _jax.default_backend() == "cpu" and (
+            avail_gb is None or avail_gb < need_gb
+        ):
+            membership["membership_sparse_1m_skipped"] = (
+                f"cpu backend: ~{need_gb:.0f}GB needed, "
+                f"{'unknown' if avail_gb is None else round(avail_gb, 1)}"
+                "GB available"
+            )
+        else:
+            r1m, ov1m = run_membership_sparse(
+                mcfg1m, steps=3, track=(42,), warmup=False
+            )
+            membership["membership_sparse_1m_rounds_per_sec"] = round(
+                r1m.rounds_per_sec, 3
+            )
+            membership["membership_sparse_1m_overflow"] = int(ov1m)
+    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
+        membership["membership_sparse_1m_error"] = str(e)[:200]
 
     # Lifeguard accuracy A/B at the headline scale: degraded1m (2%
     # degraded members, WAN ack tail) at a reduced tick count so bench

@@ -17,9 +17,14 @@ Exactness ladder (each level counted, nothing silent):
         remembered incarnation, which the next push/pull or gossip
         about the subject re-teaches.  Active state — suspicions,
         queued retransmits, confirmations — is never evicted.
-  overflow > 0    genuinely urgent news found no slot and was dropped;
-        the sender's remaining retransmit budget is the retry.  A study
-        whose overflow grows materially needs a bigger K.
+  overflow > 0    something countable was dropped — two causes with
+        DISTINCT remedies: (a) urgent news found no claimable slot
+        (the sender's remaining retransmit budget is the retry; a
+        study whose overflow grows this way needs a bigger K), or
+        (b) more push/pull initiators fired in one tick than the
+        compacted exchange's static budget (``pp_initiator_budget``,
+        8x the Poissonized mean — a function of n and push_pull_ticks,
+        NOT of K; the Poissonized schedule retries next interval).
 With K == n and the identity slot layout the per-tick computation
 consumes the SAME random draws in the SAME shapes as
 ``membership_round``, so tests/test_membership_sparse.py pins
@@ -29,17 +34,28 @@ Redesign notes (no reference counterpart — the reference's per-process
 hashmap IS sparse; this is its SPMD analogue):
   slots         slot_subj[i, k] names the subject of (i, k); -1 empty.
                 Empty slots hold default contents as an invariant, so
-                eviction = overwriting slot_subj.
+                eviction = overwriting slot_subj.  Every row stays
+                SORTED ascending by subject id (empties last) — the
+                sorted-row invariant ``ops/sortmerge.py`` locates
+                against; claims land out of place and each round
+                re-sorts the touched planes to restore it.
   deliveries    all inbound news (gossip scatters + push/pull row
-                merges) becomes one flat (receiver, subject, value)
-                arrival stream, located into slot indices by a chunked
-                compare-scan (bounded temp memory), then scatter-max'd
-                — the sparse form of the dense model's one-max() merge.
-  allocation    arrivals for subjects without a slot first stage into a
-                hash-indexed [n, P] buffer, then claim evictable slots
-                (empty first, then default-content slots); failures
-                count into ``overflow`` and the sender's retransmit
-                budget provides the retry.
+                merges, the latter compacted to a static initiator
+                budget so the stream tracks real traffic, not n·K
+                masked slots) becomes one flat (receiver, subject,
+                value) arrival stream, lex-sorted by (receiver,
+                subject) and
+                segment-maxed so each pair survives once, then located
+                by per-row binary search — O(A log K) instead of the
+                old chunked compare-scan's O(A·K) — and scatter-max'd:
+                the sparse form of the dense model's one-max() merge.
+  allocation    arrivals for subjects without a slot take a prefix-sum
+                rank within their receiver's segment and claim that
+                rank's entry in the row's claim order (empty slots
+                first, then settled ones), one distinct slot per new
+                subject in a single pass; failures count into
+                ``overflow`` and the sender's retransmit budget
+                provides the retry.
 """
 
 from __future__ import annotations
@@ -63,11 +79,18 @@ from consul_tpu.models.membership import (
     key_rank,
     make_key,
 )
-from consul_tpu.ops import bernoulli_mask, sample_peers, sample_probe_targets
+from consul_tpu.ops import (
+    bernoulli_mask,
+    merge_deliveries,
+    row_locate,
+    sample_peers,
+    sample_probe_targets,
+    sort_slot_rows,
+)
 
 DEFAULT_KEY = 0  # make_key(0, RANK_ALIVE): the steady-state cell
 
-_CHUNK = 1 << 18  # arrival-locate chunk: bounds the [chunk, K] temp
+_CHUNK = 1 << 18  # chunk for _scan_chunks: bounds per-chunk temps
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,7 +103,10 @@ class SparseMembershipConfig:
 
     base: MembershipConfig
     k_slots: int = 64
-    stage_width: int = 8  # P: new-subject allocations per node per tick
+    # Legacy knob of the staged-hash allocator: the sort-merge kernel
+    # allocates every claimable slot in one ranked pass, so allocation
+    # is no longer width-limited.  Kept so existing study configs load.
+    stage_width: int = 8
 
     def __post_init__(self):
         if self.base.join_at:
@@ -106,8 +132,39 @@ class SparseMembershipState(NamedTuple):
     tick: jax.Array             # int32 scalar
 
 
+def pp_initiator_budget(n: int, push_pull_ticks: int) -> int:
+    """Static initiator-slot budget of the compacted push/pull
+    exchange: 8x the Poissonized mean initiation rate, floor 64.  The
+    full-width exchange materializes 2·n·K arrival slots with ~all of
+    them masked out (only ~n/push_pull_ticks nodes initiate per tick);
+    compaction keeps the sort-merge stream proportional to the traffic
+    that exists.  Budget misses drop that tick's exchange for the
+    overflowing initiators and are counted into ``overflow`` — the
+    Poissonized schedule retries them."""
+    return min(n, max(64, (8 * n) // max(1, push_pull_ticks)))
+
+
+def arrival_count(cfg: SparseMembershipConfig) -> int:
+    """Flat arrival-stream length of one tick (static under jit):
+    gossip fan-out plus the push/pull exchange — compacted at K < n,
+    full-width in the K == n parity mode."""
+    base = cfg.base
+    n = base.n
+    K = min(cfg.k_slots, n)
+    M = min(base.piggyback, K)
+    A = n * base.fanout * M
+    if base.push_pull_enabled:
+        if K < n:
+            A += 2 * pp_initiator_budget(n, base.push_pull_ticks) * K
+        else:
+            A += 2 * n * K
+    return A
+
+
 def sparse_membership_init(cfg: SparseMembershipConfig) -> SparseMembershipState:
     n, K = cfg.base.n, cfg.k_slots
+    # Both layouts satisfy the sorted-row invariant (subjects ascending,
+    # empties last) that ops/sortmerge.py binary-searches against.
     if K >= n:
         # Identity layout: slot j == subject j (the exact-parity mode).
         slot_subj = jnp.broadcast_to(
@@ -141,25 +198,30 @@ def sparse_membership_init(cfg: SparseMembershipConfig) -> SparseMembershipState
 
 def _locate_rows(slot_subj: jax.Array, recv: jax.Array, subj: jax.Array):
     """Slot index of ``subj`` in receiver ``recv``'s table, -1 when
-    absent.  [A] → [A]; the [A, K] compare is the caller's chunk."""
-    rows = slot_subj[recv]                              # [A, K]
-    eq = rows == subj[:, None]
-    found = jnp.any(eq, axis=1)
-    idx = jnp.argmax(eq, axis=1).astype(jnp.int32)
-    return jnp.where(found, idx, -1)
+    absent — a per-row binary search against the sorted-row invariant
+    (O(log K) flat gathers per query, ops/sortmerge.py)."""
+    return row_locate(slot_subj, recv, subj)
+
+
+def _pad_neutral(a: jax.Array, pad: int) -> jax.Array:
+    """Extend ``a`` with values that read as invalid arrivals.  The
+    neutral value is per-dtype: ``False`` for bool masks —
+    ``jnp.full((pad,), -1, bool)`` is ``True``, which would VALIDATE
+    the padding — and -1 for index/value dtypes."""
+    fill = False if a.dtype == jnp.bool_ else -1
+    return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
 
 
 def _scan_chunks(fn, carry, arrays, chunk: int):
     """lax.scan ``fn`` over equal chunks of flat arrival arrays (padded
-    with invalid arrivals) so locate temps stay bounded."""
+    with invalid arrivals) so per-chunk temps stay bounded.  Retained
+    as the bounded-memory fallback path; the delivery pipeline itself
+    now rides the sort-merge kernel (ops/sortmerge.py)."""
     a0 = arrays[0]
     total = a0.shape[0]
     nchunk = max(1, -(-total // chunk))
     pad = nchunk * chunk - total
-    padded = [
-        jnp.concatenate([a, jnp.full((pad,), -1, a.dtype)]) if pad else a
-        for a in arrays
-    ]
+    padded = [_pad_neutral(a, pad) if pad else a for a in arrays]
     stacked = [a.reshape(nchunk, chunk) for a in padded]
     carry, _ = jax.lax.scan(
         lambda c, xs: (fn(c, *xs), None), carry, tuple(stacked)
@@ -216,108 +278,52 @@ def _claim_slot(slots: tuple, settled: jax.Array, want: jax.Array,
 def _merge_arrivals(
     slots: tuple,
     recv: jax.Array, subj: jax.Array, val: jax.Array, sus: jax.Array,
-    ok: jax.Array, alloc: jax.Array, n: int, K: int, P: int,
+    ok: jax.Array, alloc: jax.Array, n: int, K: int,
     overflow: jax.Array, forgotten: jax.Array,
 ):
-    """The delivery pipeline: allocate slots for new subjects, then
-    scatter-max arrival values into per-slot staging planes.
+    """The delivery pipeline on the sort-merge kernel: one lex-sort of
+    the stream locates, allocates, and scatter-maxes in a single pass
+    (ops/sortmerge.py).  Eviction policy: only SETTLED cells may be
+    claimed, and evicting one whose key differs from the default loses
+    a remembered incarnation (``forgotten``); allocation-worthy news
+    that finds no slot counts into ``overflow``.
 
-    Returns (slots, key_rx[n,K], sus_rx[n,K], overflow, forgotten)."""
-    recv = jnp.where(ok, recv, -1)
-    alloc_i = alloc.astype(jnp.int32)
-    slot_subj = slots[0]
-
-    if K < n:
-        # -- pass A: stage arrivals whose subject has no slot.  One
-        # chunked scan carries (val, subj) together: scatter-max the
-        # value, then attach the subject wherever this arrival's value
-        # IS the current max (ties pick one arbitrarily — losers are
-        # counted as dropped in pass B and retry off retransmits).
-        def stage(carry, r, s, v, su, al):
-            stage_val, stage_subj = carry
-            valid = (r >= 0) & (al > 0)
-            slot = _locate_rows(slot_subj, jnp.maximum(r, 0), s)
-            need = valid & (slot < 0) & (v > DEFAULT_KEY)
-            h = jnp.where(need, s % P, P)
-            flat = jnp.where(need, r * P + h, n * P)
-            stage_val = stage_val.at[flat].max(v, mode="drop")
-            win = need & (stage_val[jnp.minimum(flat, n * P - 1)] == v)
-            stage_subj = stage_subj.at[
-                jnp.where(win, flat, n * P)
-            ].set(s, mode="drop")
-            return stage_val, stage_subj
-
-        stage_val, stage_subj = _scan_chunks(
-            stage,
-            (jnp.full((n * P,), -1, jnp.int32),
-             jnp.full((n * P,), -1, jnp.int32)),
-            (recv, subj, val, sus, alloc_i), _CHUNK,
-        )
-        stage_val = stage_val.reshape(n, P)
-        stage_subj = stage_subj.reshape(n, P)
-
-        # -- allocation: one claim round per stage column.  Slots
-        # claimed THIS tick are protected from later columns (their
-        # reset-to-default contents would otherwise read as settled).
-        fresh = jnp.zeros((n, K), bool)
-        rows_n = jnp.arange(n, dtype=jnp.int32)
-        for p in range(P):
-            want = (stage_val[:, p] > DEFAULT_KEY) & (stage_subj[:, p] >= 0)
-            # The hash partitions subjects across columns, but re-check
-            # presence to keep the invariant obvious and cheap.
-            present = jnp.any(
-                slots[0] == stage_subj[:, p][:, None], axis=1
-            )
-            want = want & ~present
-            settled_now = settled_of(slots) & ~fresh
-            slots, can, choice, forgot = _claim_slot(
-                slots, settled_now, want, stage_subj[:, p], n, K,
-            )
-            fresh = fresh.at[
-                rows_n, jnp.where(can, choice, K)
-            ].set(True, mode="drop")
-            forgotten = forgotten + forgot
-        slot_subj = slots[0]
-
-    # -- pass B: locate (post-allocation) and scatter-max --------------
-    def scatter(carry, r, s, v, su, al):
-        key_rx, sus_rx, dropped = carry
-        valid = r >= 0
-        slot = _locate_rows(slot_subj, jnp.maximum(r, 0), s)
-        hit = valid & (slot >= 0)
-        flat = jnp.where(hit, r * K + slot, n * K)
-        key_rx = key_rx.at[flat].max(v, mode="drop")
-        sus_rx = sus_rx.at[flat].max(su, mode="drop")
-        # Allocation-eligible news that STILL has no slot was dropped —
-        # whether its claim failed or it lost a stage-hash collision.
-        dropped = dropped + jnp.sum(
-            (valid & (al > 0) & (slot < 0)
-             & (v > DEFAULT_KEY)).astype(jnp.int32)
-        )
-        return key_rx, sus_rx, dropped
-
-    key_rx, sus_rx, dropped = _scan_chunks(
-        scatter,
-        (jnp.full((n * K,), -1, jnp.int32),
-         jnp.full((n * K,), -1, jnp.int32),
-         jnp.int32(0)),
-        (recv, subj, val, sus, alloc_i), _CHUNK,
+    Returns (slots, key_rx[n,K], sus_rx[n,K], overflow, forgotten);
+    the returned slot planes and rx planes are row-sorted together, so
+    positional state carried across the call must be re-derived (the
+    round re-locates the self slot)."""
+    slot_subj, key_m, since, conf, tx = slots
+    allocate = K < n
+    new_subj, claimed, key_rx, sus_rx, dropped, forgot = merge_deliveries(
+        slot_subj, recv, subj, val, sus, ok, alloc,
+        evictable=settled_of(slots),
+        remembers=(slot_subj >= 0) & (key_m != DEFAULT_KEY),
+        default_val=DEFAULT_KEY, allocate=allocate,
     )
-    return (slots, key_rx.reshape(n, K), sus_rx.reshape(n, K),
-            overflow + dropped, forgotten)
+    if allocate:
+        # Claimed slots reset to default contents, then every touched
+        # plane re-sorts together to restore the sorted-row invariant
+        # (claims land at whatever column the claim order yielded).
+        key_m = jnp.where(claimed, DEFAULT_KEY, key_m)
+        since = jnp.where(claimed, NEVER, since)
+        conf = jnp.where(claimed, 0, conf)
+        tx = jnp.where(claimed, 0, tx)
+        new_subj, key_m, since, conf, tx, key_rx, sus_rx = sort_slot_rows(
+            new_subj, key_m, since, conf, tx, key_rx, sus_rx
+        )
+    return ((new_subj, key_m, since, conf, tx), key_rx, sus_rx,
+            overflow + dropped, forgotten + forgot)
 
 
 def _view_of(slot_subj, slot_key, who: jax.Array, subj: jax.Array):
     """who's view key of subj, defaulting absent cells to alive@0.
-    Shapes: who [..,], subj [..,] → [..,]."""
-    rows = slot_subj[who]                       # [.., K]
-    eq = rows == subj[..., None]
-    found = jnp.any(eq, axis=-1)
-    idx = jnp.argmax(eq, axis=-1)
-    got = jnp.take_along_axis(
-        slot_key[who], idx[..., None], axis=-1
-    )[..., 0]
-    return jnp.where(found, got, DEFAULT_KEY)
+    Shapes: who [..,], subj [..,] → [..,] (broadcast together); each
+    query is an O(log K) binary search, not an [.., K] compare."""
+    who_b, subj_b = jnp.broadcast_arrays(who, subj)
+    K = slot_subj.shape[1]
+    slot = row_locate(slot_subj, who_b, subj_b)
+    got = slot_key.ravel()[who_b * K + jnp.maximum(slot, 0)]
+    return jnp.where(slot >= 0, got, DEFAULT_KEY)
 
 
 def sparse_membership_round(
@@ -329,7 +335,6 @@ def sparse_membership_round(
     base = cfg.base
     n, F = base.n, base.fanout
     K = state.key.shape[1]
-    P = min(cfg.stage_width, K)
     M = min(base.piggyback, K)
     t = state.tick
     (k_tie, k_tgt, k_loss, k_pp, k_ppsel, k_probe, k_pfail) = jax.random.split(
@@ -359,8 +364,7 @@ def sparse_membership_round(
     overflow = state.overflow
 
     occupied = slot_subj >= 0
-    self_eq = slot_subj == rows[:, None]
-    self_slot = jnp.argmax(self_eq, axis=1).astype(jnp.int32)
+    self_slot = _locate_rows(slot_subj, rows, rows)  # pinned: always found
 
     # Self-view re-stamp (leave intent) — the self slot always exists.
     diag = key_m[rows, self_slot]
@@ -425,16 +429,43 @@ def sparse_membership_round(
         )
         partner = sample_probe_targets(k_ppsel, n)
         pp_ok = initiate & participates[partner]
-        # Pull: partner's occupied slots flow to the initiator...
-        recv_pull = jnp.repeat(rows, K)
-        subj_pull = slot_subj[partner].ravel()
-        val_pull = key_m[partner].ravel()
-        ok_pull = jnp.repeat(pp_ok, K) & (subj_pull >= 0)
-        # ...push: the initiator's slots flow to the partner.
-        recv_push = jnp.repeat(partner, K)
-        subj_push = slot_subj.ravel()
-        val_push = key_m.ravel()
-        ok_push = jnp.repeat(pp_ok, K) & (subj_push >= 0)
+        if K < n:
+            # Compacted exchange: only ~n/push_pull_ticks nodes
+            # initiate per tick, so select the initiators into a
+            # static budget of I slots (top_k is deterministic: ties
+            # resolve lowest-index-first) instead of materializing
+            # 2·n·K ~all-masked arrivals.  Initiators past the budget
+            # lose this tick's exchange — counted into overflow, never
+            # silent — and the Poissonized schedule retries them.
+            I = pp_initiator_budget(n, base.push_pull_ticks)
+            got, who = jax.lax.top_k(pp_ok.astype(jnp.int32), I)
+            who = who.astype(jnp.int32)
+            sel = got > 0
+            overflow = overflow + (
+                jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got)
+            )
+            pwho = partner[who]
+            # Pull: partner's occupied slots flow to the initiator...
+            recv_pull = jnp.repeat(who, K)
+            subj_pull = slot_subj[pwho].ravel()
+            val_pull = key_m[pwho].ravel()
+            ok_pull = jnp.repeat(sel, K) & (subj_pull >= 0)
+            # ...push: the initiator's slots flow to the partner.
+            recv_push = jnp.repeat(pwho, K)
+            subj_push = slot_subj[who].ravel()
+            val_push = key_m[who].ravel()
+            ok_push = jnp.repeat(sel, K) & (subj_push >= 0)
+        else:
+            # Full-width exchange — the K == n parity mode keeps the
+            # dense model's shapes exactly.
+            recv_pull = jnp.repeat(rows, K)
+            subj_pull = slot_subj[partner].ravel()
+            val_pull = key_m[partner].ravel()
+            ok_pull = jnp.repeat(pp_ok, K) & (subj_pull >= 0)
+            recv_push = jnp.repeat(partner, K)
+            subj_push = slot_subj.ravel()
+            val_push = key_m.ravel()
+            ok_push = jnp.repeat(pp_ok, K) & (subj_push >= 0)
         minus1 = jnp.full(recv_pull.shape, -1, jnp.int32)
         # Push/pull rows holding settled alive@inc values merge into
         # EXISTING slots but never allocate: reintroducing a remembered
@@ -458,10 +489,13 @@ def sparse_membership_round(
 
     slots_t, key_rx, sus_rx, overflow, forgotten = _merge_arrivals(
         (slot_subj, key_m, suspect_since, confirms, tx),
-        recv, subj, val, sus, ok, alloc, n, K, P,
+        recv, subj, val, sus, ok, alloc, n, K,
         overflow, state.forgotten,
     )
     slot_subj, key_m, suspect_since, confirms, tx = slots_t
+    # The merge re-sorts rows when it allocates: positional handles are
+    # stale past this point, so re-locate the self slot.
+    self_slot = _locate_rows(slot_subj, rows, rows)
 
     # -- 3. refutation --------------------------------------------------
     self_rx = key_rx[rows, self_slot]
@@ -583,6 +617,13 @@ def sparse_membership_round(
     suspect_since = jnp.where(expire, NEVER, suspect_since)
     tx = jnp.where(expire, base.tx_limit, tx)
 
+    if base.probe_enabled and K < n:
+        # Probe-path claims (step 5) land out of place; re-sort the
+        # slot planes so the next round's binary searches stay sound.
+        (slot_subj, key_m, suspect_since, confirms, tx) = sort_slot_rows(
+            slot_subj, key_m, suspect_since, confirms, tx
+        )
+
     return SparseMembershipState(
         slot_subj=slot_subj,
         key=key_m,
@@ -600,7 +641,12 @@ def sparse_membership_round(
 
 
 def densify(state: SparseMembershipState, n: int):
-    """Expand slots to the dense [n, n] arrays (parity checks)."""
+    """Expand slots to the dense [n, n] arrays (parity checks).
+
+    Layout-agnostic by construction — it scatters by subject id, so it
+    reads identically before and after a row permutation.  That makes
+    the K == n parity pin independent of WHERE the sorted-row invariant
+    placed each cell."""
     K = state.key.shape[1]
     key = jnp.full((n, n), DEFAULT_KEY, jnp.int32)
     since = jnp.full((n, n), NEVER, jnp.int32)

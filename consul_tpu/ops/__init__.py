@@ -17,8 +17,16 @@ from consul_tpu.ops.scatter import (
     deliver_or,
     deliver_max,
 )
+from consul_tpu.ops.sortmerge import (
+    merge_deliveries,
+    row_locate,
+    sort_slot_rows,
+)
 
 __all__ = [
+    "merge_deliveries",
+    "row_locate",
+    "sort_slot_rows",
     "sample_peers",
     "sample_probe_targets",
     "bernoulli_mask",

@@ -1,0 +1,237 @@
+"""Sort-merge delivery: the TPU scatter idiom for the sparse plane.
+
+The sparse membership model turns every tick's network into one flat
+(receiver, subject, value) arrival stream that must land in the
+receiver's top-K slot table.  The naive kernel locates each arrival by
+an [A, K] equality compare against the receiver's row — O(A·K) gather
+work, paid twice (staging + scatter) — and allocates new slots through
+a sequential per-column claim loop.  This module is the sort-based
+replacement ``ops/scatter.py``'s docstring reserves a seam for:
+
+  1. **Lex-sort** the stream by the composite key (receiver, subject)
+     (``lax.sort`` with ``num_keys=2`` — the two-key form of sorting
+     ``recv * n + subj``, which int32 cannot pack at n ≥ 10⁵).
+     Duplicates become adjacent, so one segmented max collapses every
+     (receiver, subject) group to a single representative.
+  2. **Binary-search locate** against the *sorted-row invariant*: each
+     row of ``slot_subj`` stays sorted ascending by subject id (empty
+     slots, -1, ordered last), so one arrival finds its slot in
+     ⌈log₂K⌉+1 flat gathers — O(A log K) total instead of O(A·K).
+  3. **Rank-matched allocation**: unseated subjects take a prefix-sum
+     rank within their receiver's segment and claim that rank's entry
+     in the row's claim order (empty slots first, then evictable ones).
+     Every new subject gets a *distinct* slot by construction, which
+     kills both the sequential claim rounds and the staging-hash
+     collision overflow class of the old kernel.
+
+The kernel is model-agnostic: eviction policy arrives as boolean masks
+(``evictable``: may be overwritten; ``remembers``: an eviction here
+loses remembered information) and the dropped/forgot counters come
+back for the caller's exactness ladder.  ``merge_deliveries`` consumes
+no RNG and, over a full table (every subject seated, nothing to
+allocate), reduces to exactly the per-arrival scatter-max it replaces
+— the property the sparse==dense bit-equality pin rides on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_SUBJ_MAX = jnp.iinfo(jnp.int32).max  # empty-slot sort sentinel
+
+
+def sort_slot_rows(slot_subj: jax.Array, *planes: jax.Array):
+    """Restore the sorted-row invariant after out-of-place claims.
+
+    Sorts each row of ``slot_subj`` ascending by subject id with empty
+    slots (-1) last, and applies the same permutation to every
+    companion plane.  Returns ``(slot_subj, *planes)`` sorted."""
+    order = jnp.argsort(
+        jnp.where(slot_subj < 0, _SUBJ_MAX, slot_subj), axis=-1
+    ).astype(jnp.int32)
+    return tuple(
+        jnp.take_along_axis(p, order, axis=-1)
+        for p in (slot_subj, *planes)
+    )
+
+
+def row_locate(slot_subj: jax.Array, recv: jax.Array, subj: jax.Array):
+    """Slot index of ``subj`` in receiver ``recv``'s sorted row, -1 when
+    absent.  Any broadcast-matching shapes; O(log K) flat gathers per
+    query (the rows must hold the sorted-row invariant)."""
+    n, K = slot_subj.shape
+    flat = jnp.where(slot_subj < 0, _SUBJ_MAX, slot_subj).ravel()
+    base = jnp.clip(recv.astype(jnp.int32), 0, n - 1) * K
+    q = subj.astype(jnp.int32)
+    lo = jnp.zeros(q.shape, jnp.int32)
+    hi = jnp.full(q.shape, K, jnp.int32)
+    for _ in range(max(1, (K - 1).bit_length() + 1)):
+        mid = (lo + hi) >> 1
+        v = flat[base + jnp.minimum(mid, K - 1)]
+        go_right = v < q
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    found = (lo < K) & (flat[base + jnp.minimum(lo, K - 1)] == q)
+    return jnp.where(found, lo, -1)
+
+
+def _segmented_sum(flags: jax.Array, x: jax.Array) -> jax.Array:
+    """Inclusive segmented sum: each position holds the sum over its
+    segment prefix (segments start where ``flags`` is True)."""
+
+    def combine(a, b):
+        fa, xa = a
+        fb, xb = b
+        return fa | fb, jnp.where(fb, xb, xa + xb)
+
+    return jax.lax.associative_scan(combine, (flags, x))[1]
+
+
+def _segmented_max3(flags: jax.Array, x: jax.Array, y: jax.Array,
+                    z: jax.Array):
+    """Inclusive segmented max over three arrays sharing one segment
+    structure (one scan pass instead of three)."""
+
+    def combine(a, b):
+        fa, xa, ya, za = a
+        fb, xb, yb, zb = b
+        return (
+            fa | fb,
+            jnp.where(fb, xb, jnp.maximum(xa, xb)),
+            jnp.where(fb, yb, jnp.maximum(ya, yb)),
+            jnp.where(fb, zb, jnp.maximum(za, zb)),
+        )
+
+    out = jax.lax.associative_scan(combine, (flags, x, y, z))
+    return out[1], out[2], out[3]
+
+
+def merge_deliveries(
+    slot_subj: jax.Array,
+    recv: jax.Array, subj: jax.Array, val: jax.Array, sus: jax.Array,
+    ok: jax.Array, alloc: jax.Array,
+    *,
+    evictable: jax.Array, remembers: jax.Array,
+    default_val: int, allocate: bool,
+):
+    """Sort-merge one arrival stream into the slot table.
+
+    Arguments (A = stream length, [n, K] = slot table):
+      recv/subj/val/sus  int32[A] — receiver, subject, precedence value,
+                         suspicion incarnation (-1 for none)
+      ok                 bool[A] — delivered (undelivered slots of the
+                         static stream are dropped here)
+      alloc              bool[A] — may claim a slot when the subject is
+                         unseated (anti-amplification gate)
+      evictable          bool[n, K] — slots a claim may overwrite
+      remembers          bool[n, K] — evicting this slot loses state the
+                         caller counts as ``forgot``
+      default_val        the value absent cells implicitly hold; only
+                         news above it justifies allocation
+      allocate           static: run the allocation stage at all (False
+                         for full tables, e.g. the K == n parity mode)
+
+    Returns ``(new_slot_subj, claimed, key_rx, sus_rx, dropped,
+    forgot)``: the post-claim table (rows NOT re-sorted — callers reset
+    claimed planes first, then :func:`sort_slot_rows`), the bool[n, K]
+    claim mask, the [n, K] per-slot maxima of delivered values and
+    suspicion incarnations (-1 where nothing landed), and the counts of
+    dropped allocation-worthy (receiver, subject) groups and of
+    remembered cells lost to eviction.
+    """
+    n, K = slot_subj.shape
+    A = recv.shape[0]
+    idx = jnp.arange(A, dtype=jnp.int32)
+
+    # Lex-sort by (receiver, subject); undelivered arrivals key as
+    # (n, n) so they sort past every real group.  The payload travels
+    # as a permutation index — 3 sorted operands instead of 5.
+    r = jnp.where(ok, recv.astype(jnp.int32), n)
+    s = jnp.where(ok, subj.astype(jnp.int32), n)
+    r, s, perm = jax.lax.sort((r, s, idx), num_keys=2)
+    v = jnp.where(r < n, val.astype(jnp.int32)[perm], -1)
+    su = jnp.where(r < n, sus.astype(jnp.int32)[perm], -1)
+    el = jnp.where(
+        r < n, (alloc[perm] & (val.astype(jnp.int32)[perm] > default_val)),
+        False,
+    )
+
+    # One segmented max collapses each (receiver, subject) group: the
+    # group's last position holds max value, max suspicion incarnation,
+    # and whether ANY member may allocate.
+    prev_r = jnp.roll(r, 1)
+    prev_s = jnp.roll(s, 1)
+    first = (idx == 0) | (r != prev_r) | (s != prev_s)
+    v_max, su_max, el_any = _segmented_max3(
+        first, v, su, el.astype(jnp.int32)
+    )
+    rep = (jnp.roll(first, -1) | (idx == A - 1)) & (r < n)
+
+    slot = row_locate(slot_subj, r, s)
+    located = rep & (slot >= 0)
+    rc = jnp.clip(r, 0, n - 1)
+
+    if allocate:
+        # Rank each unseated allocation-worthy group within its
+        # receiver's segment and match it against the row's claim
+        # order: empty slots first, then evictable ones, column-
+        # ascending — rank j takes claim j, so claims never collide.
+        needs = rep & (slot < 0) & (el_any > 0)
+        rstart = (idx == 0) | (r != prev_r)
+        rank = _segmented_sum(rstart, needs.astype(jnp.int32)) \
+            - needs.astype(jnp.int32)
+
+        cols = jnp.arange(K, dtype=jnp.int32)[None, :]
+        cls = jnp.where(
+            slot_subj < 0, 0, jnp.where(evictable, 1, 2)
+        ).astype(jnp.int32)
+        order = jnp.argsort(cls * K + cols, axis=1).astype(jnp.int32)
+        n_claim = jnp.sum(cls < 2, axis=1).astype(jnp.int32)
+
+        can = needs & (rank < n_claim[rc])
+        chosen = order.ravel()[rc * K + jnp.minimum(rank, K - 1)]
+        tgt = jnp.where(can, rc * K + chosen, n * K)
+        new_slot_subj = (
+            slot_subj.ravel().at[tgt].set(s, mode="drop").reshape(n, K)
+        )
+        claimed = (
+            jnp.zeros((n * K,), bool).at[tgt].set(True, mode="drop")
+            .reshape(n, K)
+        )
+        forgot = jnp.sum(
+            (can & remembers.ravel()[jnp.minimum(tgt, n * K - 1)])
+            .astype(jnp.int32)
+        )
+        # A seated subject whose slot was just claimed lost its cell
+        # this tick: its news drops (and counts, when it could have
+        # allocated) exactly as the old locate-after-allocate pass did.
+        evicted = located & claimed.ravel()[rc * K + jnp.maximum(slot, 0)]
+        dropped = (
+            jnp.sum((needs & ~can).astype(jnp.int32))
+            + jnp.sum((evicted & (el_any > 0)).astype(jnp.int32))
+        )
+        deliver = (located & ~evicted) | can
+        final_slot = jnp.where(can, chosen, slot)
+    else:
+        new_slot_subj = slot_subj
+        claimed = jnp.zeros((n, K), bool)
+        forgot = jnp.int32(0)
+        dropped = jnp.sum(
+            (rep & (slot < 0) & (el_any > 0)).astype(jnp.int32)
+        )
+        deliver = located
+        final_slot = slot
+
+    # Every delivered group owns a distinct slot, so the final scatter
+    # is collision-free; max keeps it idempotent regardless.
+    flat = jnp.where(deliver, rc * K + final_slot, n * K)
+    key_rx = (
+        jnp.full((n * K,), -1, jnp.int32)
+        .at[flat].max(v_max, mode="drop").reshape(n, K)
+    )
+    sus_rx = (
+        jnp.full((n * K,), -1, jnp.int32)
+        .at[flat].max(su_max, mode="drop").reshape(n, K)
+    )
+    return new_slot_subj, claimed, key_rx, sus_rx, dropped, forgot

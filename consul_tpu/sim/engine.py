@@ -281,7 +281,13 @@ def sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
                            track: tuple = ()):
     """Sparse-model twin of :func:`membership_scan`: per tracked subject
     j, how many observers hold a SUSPECT / DEAD slot for j, plus the
-    global suspect-slot count and mean known-membership size."""
+    global suspect-slot count and mean known-membership size.
+
+    The per-tick delivery rides the sort-merge kernel
+    (ops/sortmerge.py), which permutes slot columns as it allocates —
+    every per-slot reduction here is deliberately position-free
+    (subject-id matching), so the counters are invariant to the row
+    order the sorted-row invariant imposes."""
     from consul_tpu.models.membership_sparse import sparse_membership_round
     from consul_tpu.models.membership import RANK_SUSPECT as _SUS
     from consul_tpu.models.membership import RANK_DEAD as _DEAD
@@ -334,7 +340,8 @@ def run_membership_sparse(
     warmup: bool = True,
 ):
     """Top-K sparse membership study (models/membership_sparse.py): the
-    n ≥ 10⁵ regime the dense model's O(N²) state cannot reach."""
+    n ≥ 10⁵ regime the dense model's O(N²) state cannot reach, delivered
+    through the O(A log K) sort-merge kernel (ops/sortmerge.py)."""
     from consul_tpu.models.membership_sparse import sparse_membership_init
     from consul_tpu.sim.metrics import MembershipReport
 

@@ -12,6 +12,7 @@ import numpy as np
 
 from consul_tpu.models import BroadcastConfig, SwimConfig
 from consul_tpu.sim import run_broadcast, run_swim, time_to_fraction
+import pytest
 
 N = 4096
 SEEDS = range(3)
@@ -40,6 +41,7 @@ def test_broadcast_modes_agree_under_loss():
     assert abs(_mean_t(r_e, 0.99) - _mean_t(r_a, 0.99)) <= 3.0
 
 
+@pytest.mark.slow  # ~16s at CPU: multi-seed mode-agreement bands
 def test_swim_modes_agree_on_detection():
     cfg_e = SwimConfig(n=N, subject=3, delivery="edges")
     cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")
@@ -113,6 +115,7 @@ def test_broadcast_quantile_band_at_10k():
     _assert_quantile_band(r_e, r_a, n, (0.25, 0.5, 0.9, 0.99))
 
 
+@pytest.mark.slow  # ~11s at CPU: 100k bands (10k twin stays tier-1)
 def test_broadcast_quantile_band_at_100k():
     """The 10^5 regime the headline banks on."""
     n = 100_000

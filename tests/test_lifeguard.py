@@ -296,6 +296,7 @@ class TestDeliveryModesAgree:
         assert all(t is not None for t in ts), f"no run reached {frac}"
         return float(np.mean(ts))
 
+    @pytest.mark.slow  # ~18s at CPU: quantile bands over seeds
     def test_crash_detection_quantile_band(self):
         cfg_e = LifeguardConfig(
             n=self.N, subject=3, subject_alive=False, fail_at_tick=0,

@@ -90,7 +90,7 @@ class TestSparseRegime:
         """One crash, K far below n: every live observer still converges
         to DEAD for the subject, and no news is dropped (overflow 0 =
         the sparse run is exact, not approximate)."""
-        n, K = 256, 16
+        n, K = 192, 16
         # loss small enough that false-positive suspicion campaigns
         # don't dominate the working set — K must cover the ACTIVE news
         # per row (failures in flight + draining retransmits), and at
@@ -100,7 +100,7 @@ class TestSparseRegime:
         cfg = MembershipConfig(n=n, loss=0.02, profile=LAN,
                                fail_at=((42, 5),))
         scfg = SparseMembershipConfig(base=cfg, k_slots=K)
-        state = _run_sparse(scfg, 220, seed=1)
+        state = _run_sparse(scfg, 170, seed=1)
         # No urgent news dropped; settled-cell evictions (forgotten) are
         # allowed — that's the bounded-memory trade the model documents.
         assert int(state.overflow) == 0
@@ -117,7 +117,7 @@ class TestSparseRegime:
         detection-time curve must land inside the dense model's own
         seed-to-seed band."""
         n, K = 128, 32
-        steps = 200
+        steps = 150
 
         def dead_counts(run_state):
             if hasattr(run_state, "slot_subj"):
@@ -131,9 +131,9 @@ class TestSparseRegime:
                                fail_at=((9, 5),))
         scfg = SparseMembershipConfig(base=cfg, k_slots=K)
         dense_final = [dead_counts(_run_dense(cfg, steps, s))
-                       for s in range(3)]
+                       for s in range(2)]
         sparse_final = [dead_counts(_run_sparse(scfg, steps, s))
-                        for s in range(3)]
+                        for s in range(2)]
         # Both converge: nearly all live observers know the death.
         assert min(dense_final) > 0.95 * (n - 1)
         assert min(sparse_final) > 0.95 * (n - 1)
@@ -146,7 +146,7 @@ class TestSparseRegime:
         cfg = MembershipConfig(n=n, loss=0.0, profile=LAN,
                                fail_at=fails)
         scfg = SparseMembershipConfig(base=cfg, k_slots=K)
-        state = _run_sparse(scfg, 120, seed=0)
+        state = _run_sparse(scfg, 60, seed=0)
         assert int(state.overflow) > 0
 
     def test_large_n_memory_footprint(self):

@@ -14,6 +14,7 @@ from consul_tpu.models.multidc import (
 )
 from consul_tpu.parallel import make_mesh, shard_state
 from consul_tpu.sim.engine import multidc_scan, run_multidc
+import pytest
 
 
 def test_wan_disabled_confines_event_to_origin_segment():
@@ -48,6 +49,7 @@ def test_wan_hop_adds_latency():
     assert min(remote) > t_origin
 
 
+@pytest.mark.slow  # ~26s at CPU: comparative loss sweeps
 def test_wan_loss_slows_cross_segment_convergence():
     base = MultiDCConfig(n=4096, segments=8, bridges_per_segment=3)
     lossy = MultiDCConfig(
@@ -58,6 +60,7 @@ def test_wan_loss_slows_cross_segment_convergence():
     assert r1.time_to_ms(0.99) >= r0.time_to_ms(0.99)
 
 
+@pytest.mark.slow  # ~28s at CPU: multi-seed distribution bands
 def test_aggregate_matches_edges_distributionally():
     """Same convergence curve from the exact scatter path and the
     Poissonized path, averaged over seeds (the multidc analogue of

@@ -35,6 +35,7 @@ def test_unknown_scenario_raises():
         run_scenario("nope")
 
 
+@pytest.mark.slow  # ~35s at CPU: full probe1k scenario sims
 def test_probe1k_timing_pins():
     """Config 2: 1k nodes, 1% concurrent crashes, fanout 3.
 

@@ -18,6 +18,7 @@ from consul_tpu.models import (
 from consul_tpu.models.swim import _lifeguard_timeout_ticks, NEVER
 from consul_tpu.protocol import remaining_suspicion_timeout
 from consul_tpu.sim import run_swim
+import pytest
 
 
 def advance(st, cfg, steps, seed=0):
@@ -139,6 +140,7 @@ class TestRefutation:
             assert int(st.view[2]) != VIEW_SUSPECT
             assert int(st.view[2]) != VIEW_DEAD
 
+    @pytest.mark.slow  # ~16s at CPU: long flapping horizon
     def test_flapping_recurs_at_higher_incarnations(self):
         # Under heavy loss a live subject keeps getting falsely suspected;
         # each cycle must run at a higher incarnation (suspect@k ->

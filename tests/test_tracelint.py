@@ -418,6 +418,16 @@ class TestRepoGate:
         violations = lint_paths(LINT_TREES)
         assert violations == [], "\n".join(v.format() for v in violations)
 
+    def test_sortmerge_kernel_is_covered_and_clean(self):
+        # The sort-merge delivery kernel is traced code end to end; pin
+        # it into the zero-violations gate by name so a future tree
+        # reshuffle can't silently drop it from LINT_TREES.
+        target = PKG_ROOT / "ops" / "sortmerge.py"
+        assert any(
+            target.is_relative_to(tree) for tree in LINT_TREES
+        ), "ops/sortmerge.py left the linted trees"
+        assert lint_paths([target]) == []
+
     def test_cli_lint_clean_exits_zero(self):
         from consul_tpu.cli import build_parser
 
@@ -540,3 +550,19 @@ class TestTraceGuard:
             run_lifeguard(lcfg, steps=8, seed=seed, warmup=False)
         for name in ("broadcast_scan", "swim_scan", "lifeguard_scan"):
             assert retrace_guard[name].traces <= 1
+
+    @pytest.mark.single_trace(entrypoints=("sparse_membership_scan",))
+    def test_sparse_entrypoint_holds_single_trace(self, retrace_guard):
+        # The rewired sort-merge delivery path must still compile the
+        # whole sparse study to ONE program across seeds.
+        from consul_tpu.models import SparseMembershipConfig
+        from consul_tpu.models.membership import MembershipConfig
+        from consul_tpu.sim.engine import run_membership_sparse
+
+        cfg = SparseMembershipConfig(
+            base=MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),)),
+            k_slots=8,
+        )
+        for seed in (0, 1):
+            run_membership_sparse(cfg, steps=6, seed=seed, warmup=False)
+        assert retrace_guard["sparse_membership_scan"].traces <= 1
