@@ -30,11 +30,27 @@ WAN-profile cluster advances one gossip round per GossipInterval
 (500 ms) regardless of hardware (memberlist/config.go:322), i.e. 2
 rounds/sec; the reference has no faster way to study convergence than
 running (the serf.io simulator is not in-repo).  vs_baseline = value/2.
+
+Runtime guard: every section runs under per-section wall-clock
+accounting (``section_wall_s`` in the JSON).  Setting
+``BENCH_SECTION_BUDGET_S=<seconds>`` makes the run self-limiting: once
+the cumulative wall clock passes the budget, remaining sections are
+skipped cleanly — listed under ``"skipped"`` — instead of the whole
+process being killed mid-section by an outer ``timeout`` (which loses
+every datapoint already measured).
+
+The ``multichip`` block is the real multi-device datapoint (the
+sharded plane, consul_tpu/parallel/shard.py): on a multi-chip host the
+exact per-message broadcast runs in-process across all devices at 1M
+nodes/chip (8M aggregate on a v5e-8); on single-device CPU containers
+it validates the same plane in a subprocess over 8 forced host devices
+at small n (``python -m consul_tpu.parallel.shard``).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 from consul_tpu.models import SwimConfig
@@ -123,27 +139,100 @@ def _sparse_phase_times(mcfg, rounds_per_sec: float) -> dict:
     }
 
 
-def main() -> None:
-    # Headline: aggregate delivery (elementwise RNG, no scatters).
-    cfg = SwimConfig(
-        n=N, subject=42, loss=0.30, profile=WAN, delivery="aggregate"
+def _run_multichip() -> dict:
+    """The sharded-plane datapoint (consul_tpu/parallel/shard.py)."""
+    import subprocess
+    import sys
+
+    import jax
+
+    if jax.device_count() > 1 and jax.default_backend() != "cpu":
+        # Real multi-device host (accelerator backend): 1M nodes per
+        # chip, exact per-message path, in-process (8M aggregate on a
+        # v5e-8).  Forced host devices on a CPU container must NOT take
+        # this branch — 8M in-process edges would run for hours; they
+        # get the small-n subprocess validation below instead.
+        from consul_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        ndev = int(mesh.devices.size)
+        cfg = BroadcastConfig(
+            n=1_000_000 * ndev, fanout=4, profile=LAN, delivery="edges"
+        )
+        rep = run_broadcast(cfg, steps=30, seed=0, mesh=mesh, warmup=True)
+        return {"multichip": {
+            "devices": ndev,
+            "nodes_aggregate": cfg.n,
+            "nodes_per_device": cfg.n // ndev,
+            "rounds_per_sec": round(rep.rounds_per_sec, 2),
+            "overflow": rep.overflow,
+            "t99_ms": rep.summary()["t99_ms"],
+            "host_devices_forced": False,
+        }}
+    # Single-device container: validate the plane over 8 forced host
+    # devices at small n, in a subprocess (XLA_FLAGS must be set before
+    # the child's first backend use — impossible in THIS process).
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "consul_tpu.parallel.shard",
+         "--devices", "8", "--n", "4096", "--steps", "30"],
+        capture_output=True, text=True, timeout=600, check=True, env=env,
     )
-    report = run_swim(cfg, steps=STEPS, seed=0, warmup=True)
-    value = report.rounds_per_sec
-    summary = report.summary()
+    return {"multichip": json.loads(out.stdout.strip().splitlines()[-1])}
+
+
+def main() -> None:
+    budget_s = float(os.environ.get("BENCH_SECTION_BUDGET_S", "0") or 0)
+    t_start = time.monotonic()
+    section_wall: dict = {}
+    skipped: list = []
+
+    def section(name, fn, default=None):
+        """One bench section under the global wall-clock budget: runs
+        ``fn`` with its wall time recorded, or skips it (recorded in
+        ``skipped``) once the cumulative clock passes
+        BENCH_SECTION_BUDGET_S."""
+        if budget_s and (time.monotonic() - t_start) > budget_s:
+            skipped.append(name)
+            return default
+        t0 = time.monotonic()
+        try:
+            return fn()
+        finally:
+            section_wall[name] = round(time.monotonic() - t0, 1)
+
+    # Headline: aggregate delivery (elementwise RNG, no scatters).
+    # Always first — the budget can only cut the companions after it.
+    def _headline():
+        cfg = SwimConfig(
+            n=N, subject=42, loss=0.30, profile=WAN, delivery="aggregate"
+        )
+        return run_swim(cfg, steps=STEPS, seed=0, warmup=True)
+
+    report = section("swim_aggregate_1m", _headline)
+    value = report.rounds_per_sec if report else None
+    summary = report.summary() if report else {}
 
     # The exact path at the same config: every message a scatter.
-    edges_cfg = SwimConfig(
-        n=N, subject=42, loss=0.30, profile=WAN, delivery="edges"
-    )
-    edges_report = run_swim(edges_cfg, steps=STEPS_EDGES, seed=0, warmup=True)
+    def _edges():
+        edges_cfg = SwimConfig(
+            n=N, subject=42, loss=0.30, profile=WAN, delivery="edges"
+        )
+        return run_swim(edges_cfg, steps=STEPS_EDGES, seed=0, warmup=True)
+
+    edges_report = section("swim_edges_1m", _edges)
 
     # 1M-node event broadcast (BASELINE config 3 at 10x), LAN fanout 4.
-    bcast_cfg = BroadcastConfig(
-        n=N, fanout=4, profile=LAN, delivery="aggregate"
-    )
-    bcast_report = run_broadcast(bcast_cfg, steps=60, seed=0, warmup=True)
-    bcast_summary = bcast_report.summary()
+    def _bcast():
+        bcast_cfg = BroadcastConfig(
+            n=N, fanout=4, profile=LAN, delivery="aggregate"
+        )
+        return run_broadcast(bcast_cfg, steps=60, seed=0, warmup=True)
+
+    bcast_report = section("broadcast_1m", _bcast)
+    bcast_summary = bcast_report.summary() if bcast_report else {}
 
     # Full-membership study past the dense O(N²) wall: 100k observers ×
     # 100k subjects via the top-K sparse model (models/
@@ -151,145 +240,183 @@ def main() -> None:
     # ~200 GB; the slot representation fits one chip.  overflow == 0
     # certifies the run dropped nothing (exactness ladder in the module
     # docstring).
-    try:
-        from consul_tpu.models import SparseMembershipConfig
-        from consul_tpu.models.membership import MembershipConfig
-        from consul_tpu.sim import run_membership_sparse
-
-        mcfg = SparseMembershipConfig(
-            base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,
-                                  fail_at=((42, 5),)),
-            k_slots=64,
-        )
-        mreport, moverflow = run_membership_sparse(
-            mcfg, steps=30, track=(42,), warmup=False
-        )
-        membership = {
-            "membership_sparse_n": 100_000,
-            "membership_sparse_k": 64,
-            "membership_sparse_rounds_per_sec": round(
-                mreport.rounds_per_sec, 2),
-            "membership_sparse_overflow": int(moverflow),
-        }
+    def _sparse_100k():
         try:
-            # Merge-kernel vs emit/probe split of one round (the
-            # sort-merge kernel timed standalone at identical shapes).
-            # Own guard: a diagnostic failure must not discard the
-            # headline sparse metric measured above.
-            membership.update(
-                _sparse_phase_times(mcfg, mreport.rounds_per_sec)
-            )
-        except Exception as e:  # noqa: BLE001 - keep the primary datapoint
-            membership["sparse_phase_error"] = str(e)[:200]
+            from consul_tpu.models import SparseMembershipConfig
+            from consul_tpu.models.membership import MembershipConfig
+            from consul_tpu.sim import run_membership_sparse
 
-    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
-        membership = {"membership_sparse_error": str(e)[:200]}
+            mcfg = SparseMembershipConfig(
+                base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,
+                                      fail_at=((42, 5),)),
+                k_slots=64,
+            )
+            mreport, moverflow = run_membership_sparse(
+                mcfg, steps=30, track=(42,), warmup=False
+            )
+            out = {
+                "membership_sparse_n": 100_000,
+                "membership_sparse_k": 64,
+                "membership_sparse_rounds_per_sec": round(
+                    mreport.rounds_per_sec, 2),
+                "membership_sparse_overflow": int(moverflow),
+            }
+            try:
+                # Merge-kernel vs emit/probe split of one round (the
+                # sort-merge kernel timed standalone at identical
+                # shapes).  Own guard: a diagnostic failure must not
+                # discard the headline sparse metric measured above.
+                out.update(
+                    _sparse_phase_times(mcfg, mreport.rounds_per_sec)
+                )
+            except Exception as e:  # noqa: BLE001 - keep the datapoint
+                out["sparse_phase_error"] = str(e)[:200]
+            return out
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"membership_sparse_error": str(e)[:200]}
+
+    membership = section("membership_sparse_100k", _sparse_100k, {})
 
     # The configuration the sparse representation exists for: one
     # MILLION observers (dense state would need ~20 TB).  The arrival
     # sort peaks well past small-host RAM, so CPU containers without
     # headroom skip cleanly instead of OOMing; accelerators (device
     # memory, not MemAvailable) always try, with their own guard.
-    try:
-        import jax as _jax
+    def _sparse_1m():
+        out = {}
+        try:
+            import jax as _jax
 
-        from consul_tpu.models import SparseMembershipConfig
-        from consul_tpu.models.membership import MembershipConfig
-        from consul_tpu.sim import run_membership_sparse
+            from consul_tpu.models import SparseMembershipConfig
+            from consul_tpu.models.membership import MembershipConfig
+            from consul_tpu.sim import run_membership_sparse
 
-        mcfg1m = SparseMembershipConfig(
-            base=MembershipConfig(n=1_000_000, loss=0.01, profile=LAN,
-                                  fail_at=((42, 5),)),
-            k_slots=64,
-        )
-        need_gb = (
-            _sparse_arrival_count(mcfg1m) * 4 * 24
-            + 5 * 1_000_000 * 64 * 4 * 3
-        ) / 1e9
-        avail_gb = _available_memory_gb()
-        if _jax.default_backend() == "cpu" and (
-            avail_gb is None or avail_gb < need_gb
-        ):
-            membership["membership_sparse_1m_skipped"] = (
-                f"cpu backend: ~{need_gb:.0f}GB needed, "
-                f"{'unknown' if avail_gb is None else round(avail_gb, 1)}"
-                "GB available"
+            mcfg1m = SparseMembershipConfig(
+                base=MembershipConfig(n=1_000_000, loss=0.01, profile=LAN,
+                                      fail_at=((42, 5),)),
+                k_slots=64,
             )
-        else:
-            r1m, ov1m = run_membership_sparse(
-                mcfg1m, steps=3, track=(42,), warmup=False
-            )
-            membership["membership_sparse_1m_rounds_per_sec"] = round(
-                r1m.rounds_per_sec, 3
-            )
-            membership["membership_sparse_1m_overflow"] = int(ov1m)
-    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
-        membership["membership_sparse_1m_error"] = str(e)[:200]
+            need_gb = (
+                _sparse_arrival_count(mcfg1m) * 4 * 24
+                + 5 * 1_000_000 * 64 * 4 * 3
+            ) / 1e9
+            avail_gb = _available_memory_gb()
+            if _jax.default_backend() == "cpu" and (
+                avail_gb is None or avail_gb < need_gb
+            ):
+                out["membership_sparse_1m_skipped"] = (
+                    f"cpu backend: ~{need_gb:.0f}GB needed, "
+                    f"{'unknown' if avail_gb is None else round(avail_gb, 1)}"
+                    "GB available"
+                )
+            else:
+                r1m, ov1m = run_membership_sparse(
+                    mcfg1m, steps=3, track=(42,), warmup=False
+                )
+                out["membership_sparse_1m_rounds_per_sec"] = round(
+                    r1m.rounds_per_sec, 3
+                )
+                out["membership_sparse_1m_overflow"] = int(ov1m)
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            out["membership_sparse_1m_error"] = str(e)[:200]
+        return out
+
+    membership.update(section("membership_sparse_1m", _sparse_1m, {}))
 
     # Lifeguard accuracy A/B at the headline scale: degraded1m (2%
     # degraded members, WAN ack tail) at a reduced tick count so bench
     # wall time stays bounded — the FP-rate question only needs enough
     # probe cycles for the on/off split, not dead-propagation horizons.
-    try:
-        from consul_tpu.sim.scenarios import degraded1m
+    def _lifeguard():
+        try:
+            from consul_tpu.sim.scenarios import degraded1m
 
-        lg = degraded1m(seed=0, steps=160)
-        lifeguard = {
-            "fp_rate_1M": round(lg["fp_rate_on"], 4),
-            "fp_rate_1M_off": round(lg["fp_rate_off"], 4),
-            "fp_reduction_1M": (
-                round(lg["fp_reduction"], 4)
-                if lg["fp_reduction"] is not None else None
-            ),
-            "flaps_1M": lg["flaps_on"],
-            "flaps_1M_off": lg["flaps_off"],
-        }
-    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
-        lifeguard = {"lifeguard_error": str(e)[:200]}
+            lg = degraded1m(seed=0, steps=160)
+            return {
+                "fp_rate_1M": round(lg["fp_rate_on"], 4),
+                "fp_rate_1M_off": round(lg["fp_rate_off"], 4),
+                "fp_reduction_1M": (
+                    round(lg["fp_reduction"], 4)
+                    if lg["fp_reduction"] is not None else None
+                ),
+                "flaps_1M": lg["flaps_on"],
+                "flaps_1M_off": lg["flaps_off"],
+            }
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"lifeguard_error": str(e)[:200]}
+
+    lifeguard = section("lifeguard_1m", _lifeguard, {})
+
+    # The multichip datapoint: the sharded plane across real devices,
+    # or its forced-host-device validation on single-chip containers —
+    # replaces the dryrun-only multichip story.
+    def _multichip():
+        try:
+            return _run_multichip()
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"multichip_error": str(e)[:200]}
+
+    multichip = section("multichip", _multichip, {})
 
     # Host-plane KV/HTTP throughput vs the reference's published numbers
     # (bench/results-0.7.1.md: 3,780 PUT/s, 9,774 stale GET/s).  Run in
     # a clean subprocess: the host plane never touches JAX, and this
     # process's TPU-tunnel service threads would otherwise steal ~1/3
     # of the asyncio loop and understate the numbers.
-    import json as _json
-    import subprocess
-    import sys
+    def _kv():
+        import json as _json
+        import subprocess
+        import sys
 
-    try:
-        kv = _json.loads(
-            subprocess.run(
-                [sys.executable, "-m", "consul_tpu.bench_kv"],
-                capture_output=True, text=True, timeout=120, check=True,
-            ).stdout.strip().splitlines()[-1]
-        )
-    except Exception as e:  # noqa: BLE001 - report the miss, keep headline
-        kv = {"kv_bench_error": str(e)}
+        try:
+            return _json.loads(
+                subprocess.run(
+                    [sys.executable, "-m", "consul_tpu.bench_kv"],
+                    capture_output=True, text=True, timeout=120,
+                    check=True,
+                ).stdout.strip().splitlines()[-1]
+            )
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"kv_bench_error": str(e)}
+
+    kv = section("kv_host_plane", _kv, {})
 
     print(
         json.dumps(
             {
                 "metric": "sim_gossip_rounds_per_sec_1M",
-                "value": round(value, 2),
+                "value": round(value, 2) if value is not None else None,
                 "unit": "rounds/s",
-                "vs_baseline": round(value / REALTIME_ROUNDS_PER_SEC, 2),
+                "vs_baseline": (
+                    round(value / REALTIME_ROUNDS_PER_SEC, 2)
+                    if value is not None else None
+                ),
+                "skipped": skipped,
+                "section_wall_s": section_wall,
                 "extra": {
-                    "edges_1M_rounds_per_sec": round(
-                        edges_report.rounds_per_sec, 2
-                    ),
-                    "edges_vs_realtime": round(
-                        edges_report.rounds_per_sec / REALTIME_ROUNDS_PER_SEC,
-                        2,
-                    ),
-                    "t99_dead_known_ms": summary["t99_dead_known_ms"],
-                    "first_suspect_ms": summary["first_suspect_ms"],
-                    "bcast_1M_t99_ms": bcast_summary["t99_ms"],
-                    "bcast_1M_wall_s": round(bcast_report.wall_s, 3),
+                    **({
+                        "edges_1M_rounds_per_sec": round(
+                            edges_report.rounds_per_sec, 2
+                        ),
+                        "edges_vs_realtime": round(
+                            edges_report.rounds_per_sec
+                            / REALTIME_ROUNDS_PER_SEC,
+                            2,
+                        ),
+                    } if edges_report else {}),
+                    "t99_dead_known_ms": summary.get("t99_dead_known_ms"),
+                    "first_suspect_ms": summary.get("first_suspect_ms"),
+                    **({
+                        "bcast_1M_t99_ms": bcast_summary["t99_ms"],
+                        "bcast_1M_wall_s": round(bcast_report.wall_s, 3),
+                    } if bcast_report else {}),
                     # The headline scan is unsharded: the whole 1M-node
-                    # population lives and steps on ONE chip.
+                    # population lives and steps on ONE chip; the
+                    # multichip block is where the mesh earns its keep.
                     "nodes_per_chip": N,
                     **lifeguard,
                     **membership,
+                    **multichip,
                     **kv,
                 },
             }

@@ -22,7 +22,11 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Iterable, Optional
 
-# The jitted study entrypoints of sim/engine.py, guarded by default.
+# The jitted study entrypoints of sim/engine.py, guarded by default
+# (the sharded_* trio are the shard_map multi-chip twins from
+# consul_tpu/parallel/shard.py, re-exported through the engine; a
+# distinct mesh is a distinct static signature, so guard them with
+# max_traces = number of meshes exercised).
 ENGINE_ENTRYPOINTS = (
     "broadcast_scan",
     "multidc_scan",
@@ -30,6 +34,9 @@ ENGINE_ENTRYPOINTS = (
     "lifeguard_scan",
     "membership_scan",
     "sparse_membership_scan",
+    "sharded_broadcast_scan",
+    "sharded_membership_scan",
+    "sharded_sparse_membership_scan",
 )
 
 

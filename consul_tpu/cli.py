@@ -219,6 +219,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--list", action="store_true", dest="list_scenarios",
                     help="enumerate scenario presets and exit")
     sp.add_argument("-seed", type=int, default=0)
+    sp.add_argument("--devices", type=int, default=0,
+                    help="shard the scenario's node axis over the first "
+                         "D devices (consul_tpu/parallel/shard.py; on "
+                         "CPU containers force host devices with "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=D)")
 
     # Like the reference, version tolerates (and ignores) the global
     # client flags so scripted `cli ... -http-addr X` loops can include
@@ -996,7 +1002,8 @@ async def cmd_sim(args) -> int:
     if not args.scenario:
         print("Error: scenario name required (or --list)", file=sys.stderr)
         return 1
-    out = run_scenario(args.scenario, seed=args.seed)
+    out = run_scenario(args.scenario, seed=args.seed,
+                       devices=args.devices or None)
     print(json.dumps(out, indent=2, default=str))
     return 0
 

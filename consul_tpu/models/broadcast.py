@@ -31,6 +31,7 @@ from consul_tpu.ops import (
     aggregate_arrivals,
     bernoulli_mask,
     deliver_or,
+    sample_alive_peers,
     sample_peers,
 )
 from consul_tpu.protocol import retransmit_limit
@@ -104,9 +105,14 @@ def broadcast_round(
     alive: Optional[jax.Array] = None,
 ) -> BroadcastState:
     """One gossip tick.  ``alive`` (bool[n], optional) masks nodes that
-    neither send nor count as reachable targets (failed nodes still
-    receive in the reference until reaped; modeling them as deaf is the
-    conservative choice for convergence measurements)."""
+    neither send, relay, nor count as gossip targets: a DEAD node's
+    remaining ``tx_left`` budget is masked out of the sender set, and —
+    serf/delegate.go semantics, kRandomNodes filtering dead/left members
+    (memberlist/state.go:575-585) — live senders draw their fanout
+    targets from the ALIVE pool only, so no transmission budget is ever
+    spent on a node known to be gone.  (Failed nodes still receive in
+    the reference until reaped; modeling them as deaf is the
+    conservative choice for convergence measurements.)"""
     n, fanout = cfg.n, cfg.fanout
     k_sel, k_loss = jax.random.split(key)
 
@@ -117,7 +123,10 @@ def broadcast_round(
     if cfg.delivery == "edges":
         # Each node picks its gossip targets (memberlist/state.go:575-585
         # kRandomNodes over the member list, excluding self).
-        targets = sample_peers(k_sel, n, fanout)                   # [n, f]
+        if alive is None:
+            targets = sample_peers(k_sel, n, fanout)               # [n, f]
+        else:
+            targets = sample_alive_peers(k_sel, alive, fanout)
         delivered = senders[:, None] & bernoulli_mask(
             k_loss, (n, fanout), 1.0 - cfg.loss
         )
@@ -125,10 +134,12 @@ def broadcast_round(
             delivered = delivered & alive[targets]
         new_knows = deliver_or(state.knows, targets, delivered)
     else:
-        # Receiver-side Poissonized delivery (see BroadcastConfig).
-        got = aggregate_arrivals(k_loss, senders, fanout, cfg.loss, n)
-        if alive is not None:
-            got = got & alive
+        # Receiver-side Poissonized delivery (see BroadcastConfig);
+        # with ``alive`` the arrival intensity spreads over the alive
+        # pool only (aggregate_arrivals' alive mask).
+        got = aggregate_arrivals(
+            k_loss, senders, fanout, cfg.loss, n, alive
+        )
         new_knows = state.knows | got
 
     # Senders consumed one transmission per target packet this tick

@@ -229,17 +229,22 @@ def _scan_chunks(fn, carry, arrays, chunk: int):
     return carry
 
 
-def settled_of(slots: tuple) -> jax.Array:
+def settled_of(slots: tuple, row_ids: jax.Array = None) -> jax.Array:
     """Cells whose eviction loses only recoverable information: alive
     rank with no pending retransmit, suspicion timer, or confirmations.
     (A settled alive@inc>0 cell forgets the incarnation — the next
-    push/pull or gossip about the subject re-teaches it.)"""
+    push/pull or gossip about the subject re-teaches it.)
+
+    ``row_ids`` gives each row's GLOBAL node id (for the self-slot pin);
+    defaults to ``arange(rows)`` — the unsharded layout.  The sharded
+    plane (consul_tpu/parallel/shard.py) passes its block's global ids.
+    """
     slot_subj, key_m, since, conf, tx = slots
-    n = slot_subj.shape[0]
-    self_ids = jnp.arange(n, dtype=jnp.int32)
+    if row_ids is None:
+        row_ids = jnp.arange(slot_subj.shape[0], dtype=jnp.int32)
     return (
         (slot_subj >= 0)
-        & (slot_subj != self_ids[:, None])    # the self slot is pinned
+        & (slot_subj != row_ids[:, None])     # the self slot is pinned
         & (key_rank(key_m) == RANK_ALIVE)
         & (tx == 0) & (since == NEVER) & (conf == 0)
     )
@@ -280,6 +285,7 @@ def _merge_arrivals(
     recv: jax.Array, subj: jax.Array, val: jax.Array, sus: jax.Array,
     ok: jax.Array, alloc: jax.Array, n: int, K: int,
     overflow: jax.Array, forgotten: jax.Array,
+    row_ids: jax.Array = None,
 ):
     """The delivery pipeline on the sort-merge kernel: one lex-sort of
     the stream locates, allocates, and scatter-maxes in a single pass
@@ -288,15 +294,20 @@ def _merge_arrivals(
     a remembered incarnation (``forgotten``); allocation-worthy news
     that finds no slot counts into ``overflow``.
 
-    Returns (slots, key_rx[n,K], sus_rx[n,K], overflow, forgotten);
-    the returned slot planes and rx planes are row-sorted together, so
-    positional state carried across the call must be re-derived (the
-    round re-locates the self slot)."""
+    ``recv`` indexes rows of the slot planes (LOCAL row ids under the
+    sharded plane); ``row_ids`` maps rows to global node ids for the
+    self-slot eviction pin (see :func:`settled_of`); ``n`` stays the
+    GLOBAL population (it only gates the K < n allocation stage).
+
+    Returns (slots, key_rx[rows,K], sus_rx[rows,K], overflow,
+    forgotten); the returned slot planes and rx planes are row-sorted
+    together, so positional state carried across the call must be
+    re-derived (the round re-locates the self slot)."""
     slot_subj, key_m, since, conf, tx = slots
     allocate = K < n
     new_subj, claimed, key_rx, sus_rx, dropped, forgot = merge_deliveries(
         slot_subj, recv, subj, val, sus, ok, alloc,
-        evictable=settled_of(slots),
+        evictable=settled_of(slots, row_ids),
         remembers=(slot_subj >= 0) & (key_m != DEFAULT_KEY),
         default_val=DEFAULT_KEY, allocate=allocate,
     )

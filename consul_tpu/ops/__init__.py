@@ -8,6 +8,7 @@ queue.go TransmitLimitedQueue), and the per-edge packet-loss model.
 
 from consul_tpu.ops.sampling import (
     sample_peers,
+    sample_alive_peers,
     sample_probe_targets,
     bernoulli_mask,
     aggregate_arrivals,
@@ -28,6 +29,7 @@ __all__ = [
     "row_locate",
     "sort_slot_rows",
     "sample_peers",
+    "sample_alive_peers",
     "sample_probe_targets",
     "bernoulli_mask",
     "aggregate_arrivals",

@@ -36,6 +36,36 @@ def sample_peers(key: jax.Array, n: int, fanout: int) -> jax.Array:
     return jnp.where(draws >= self_idx, draws + 1, draws) % n
 
 
+def sample_alive_peers(key: jax.Array, alive: jax.Array, fanout: int) -> jax.Array:
+    """Each node picks ``fanout`` peers uniformly among the ALIVE nodes,
+    excluding itself — the masked form of :func:`sample_peers`.
+
+    kRandomNodes filters dead/left members out of the candidate list
+    (memberlist/util.go:131-153 via state.go:575-585), so a sender never
+    spends a transmission on a node it knows to be gone.  Vectorized:
+    order the alive indices first (stable argsort of the dead mask),
+    rank each node within that order, draw from [0, A-1) over the other
+    A-1 alive nodes with the same shift trick as :func:`sample_peers`,
+    and map the draw through the alive-first index table.  Dead rows
+    still draw (static shapes under jit) but their packets are masked by
+    the caller's sender set.  Returns int32 [n, fanout].
+    """
+    n = alive.shape[0]
+    cnt = jnp.sum(alive, dtype=jnp.int32)
+    order = jnp.argsort(~alive, stable=True).astype(jnp.int32)
+    rank = (
+        jnp.zeros((n,), jnp.int32)
+        .at[order]
+        .set(jnp.arange(n, dtype=jnp.int32))
+    )
+    draws = jax.random.randint(
+        key, (n, fanout), minval=0, maxval=jnp.maximum(cnt - 1, 1),
+        dtype=jnp.int32,
+    )
+    draws = jnp.where(draws >= rank[:, None], draws + 1, draws)
+    return order[draws % jnp.maximum(cnt, 1)]
+
+
 def sample_probe_targets(key: jax.Array, n: int) -> jax.Array:
     """One probe target per node per probe round (memberlist probes one
     node per ProbeInterval, state.go:214-256).  Uniform excluding self.
@@ -63,6 +93,7 @@ def aggregate_arrivals(
     fanout: int,
     loss: float,
     n: int,
+    alive: jax.Array = None,
 ) -> jax.Array:
     """bool[n]: received >= 1 copy, under Poissonized push-gossip delivery.
 
@@ -75,15 +106,23 @@ def aggregate_arrivals(
     message class being identical is what makes the count sufficient —
     see BroadcastConfig.delivery for the full argument; equivalence to
     the exact edge-level path is pinned by tests/test_aggregate.py.
+
+    ``alive`` (bool[n], optional) is the aggregate dual of
+    :func:`sample_alive_peers`: senders spread their copies over the
+    OTHER A-1 alive nodes only (the denominator shrinks to A-1) and
+    dead receivers hear nothing.  One formula, both pools — the
+    edge-level and aggregate paths stay in sync by construction.
     """
     s_total = jnp.sum(senders, dtype=jnp.float32)
-    lam = (
-        (s_total - senders.astype(jnp.float32))
-        * fanout
-        * (1.0 - loss)
-        / max(n - 1, 1)
-    )
-    return poissonized_arrivals(key, jnp.broadcast_to(lam, (n,)))
+    lam = (s_total - senders.astype(jnp.float32)) * fanout * (1.0 - loss)
+    if alive is None:
+        lam = lam / max(n - 1, 1)
+    else:
+        lam = lam / jnp.maximum(
+            jnp.sum(alive, dtype=jnp.float32) - 1.0, 1.0
+        )
+    got = poissonized_arrivals(key, jnp.broadcast_to(lam, (n,)))
+    return got if alive is None else got & alive
 
 
 def poissonized_arrivals(key: jax.Array, lam: jax.Array) -> jax.Array:

@@ -1,10 +1,40 @@
-"""Device-mesh and sharding helpers (node-axis data parallelism)."""
+"""Device-mesh and sharding helpers (node-axis data parallelism).
+
+Two planes: ``mesh.py`` places the UNSHARDED program's arrays over a
+mesh (GSPMD, legacy ``sharded=True`` path), ``shard.py`` is the
+explicit multi-chip simulation plane — per-device node blocks, outbox
+message routing over ``lax.all_to_all``, whole studies inside one
+``shard_map`` region.
+"""
 
 from consul_tpu.parallel.mesh import (
+    block_size,
     make_mesh,
+    mesh_for,
     node_sharding,
     replicated,
     shard_state,
 )
+from consul_tpu.parallel.shard import (
+    exchange_outbox,
+    outbox_budget,
+    pack_outbox,
+    sharded_broadcast_scan,
+    sharded_membership_scan,
+    sharded_sparse_membership_scan,
+)
 
-__all__ = ["make_mesh", "node_sharding", "replicated", "shard_state"]
+__all__ = [
+    "block_size",
+    "make_mesh",
+    "mesh_for",
+    "node_sharding",
+    "replicated",
+    "shard_state",
+    "exchange_outbox",
+    "outbox_budget",
+    "pack_outbox",
+    "sharded_broadcast_scan",
+    "sharded_membership_scan",
+    "sharded_sparse_membership_scan",
+]

@@ -27,6 +27,32 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.asarray(devices), (NODE_AXIS,))
 
 
+def mesh_for(n_devices: int) -> Mesh:
+    """1-D ``nodes`` mesh over the FIRST ``n_devices`` devices — the
+    ``cli sim --devices D`` entry point.  On a v5e-8 all eight chips
+    form the mesh; on CPU containers the virtual host devices
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) stand in.
+    """
+    devs = jax.devices()
+    if n_devices < 1 or n_devices > len(devs):
+        raise ValueError(
+            f"need 1..{len(devs)} devices, asked for {n_devices} "
+            "(force host devices with XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N before JAX import)"
+        )
+    return make_mesh(devs[:n_devices])
+
+
+def block_size(n: int, mesh: Mesh) -> int:
+    """Nodes per device under contiguous-block sharding; the node axis
+    must divide evenly (same constraint shard_state's placement rule
+    encodes as 'shape[0] % n_dev == 0')."""
+    d = int(mesh.devices.size)
+    if n % d:
+        raise ValueError(f"n={n} does not divide over {d} devices")
+    return n // d
+
+
 def node_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a per-node array: first dim split across the mesh."""
     return NamedSharding(mesh, P(NODE_AXIS))

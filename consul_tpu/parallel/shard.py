@@ -1,0 +1,1208 @@
+"""Sharded multi-chip simulation plane: ``shard_map`` gossip over the mesh.
+
+Every study so far ran on ONE chip; this module is the plane that
+multiplies node capacity by the device count.  Each device owns a
+CONTIGUOUS block of ``n/D`` nodes (global ids ``[me*blk, (me+1)*blk)``)
+and the whole study — ``lax.scan`` over ticks included — runs inside a
+single ``shard_map`` region, so cross-shard traffic compiles to XLA
+collectives over ICI instead of host round-trips.
+
+One sharded gossip round decomposes exactly like the real protocol's
+traffic (nodes are independent actors exchanging messages — the
+parallel-replication structure of "Rethinking State-Machine Replication
+for Parallelism", pipelined cross-shard per "The Algorithm of Pipelined
+Gossiping"):
+
+  1. **Sample globally.**  Probe/gossip targets are GLOBAL node ids.
+     Every shard derives the full population's draws from the same
+     replicated per-round key and slices its own row block, so the RNG
+     stream is bit-identical to the unsharded scan regardless of D —
+     the property the D == 1 equality pin rides on.
+  2. **Route.**  Messages whose receiver lives on another shard are
+     packed into a fixed per-destination **outbox** (budget =
+     c x the Poissonized mean arrivals per destination,
+     :func:`outbox_budget`); misses are counted into ``overflow`` —
+     never silent, same exactness-ladder discipline as the sparse
+     model's compacted push/pull — and exchanged with ONE
+     ``lax.all_to_all`` per round.
+  3. **Merge.**  Inbound arrivals join the local stream and land
+     through the same delivery kernels the single-chip models use —
+     the sparse plane's sort-merge kernel (``ops/sortmerge.py``)
+     UNCHANGED, operating on the local row block.
+
+Exactness ladder:
+  D == 1          bit-equal to the unsharded scan (dense, sparse, and
+                  broadcast models; pinned by tests/test_shard.py) —
+                  the same pin strategy as sparse == dense at K == n.
+  overflow == 0   the sharded run delivered every message a single
+                  chip would have; the only difference from D == 1 is
+                  placement.
+  overflow > 0    outbox budget misses (bigger c or fewer shards is
+                  the remedy) or push/pull initiator-budget misses
+                  (the Poissonized schedule retries next interval).
+
+Replicated-draw memory note: the bit-equality discipline makes each
+device materialize full-population random draws ([n, fanout] targets;
+the sparse plane's [n, K] gossip-priority tie-break) before slicing its
+block.  At the v5e-8 flagship scale (8M aggregate nodes, K = 64) the
+largest transient is ~2 GB/device against 16 GB HBM; a future
+per-(round, node) keyed stream could drop it to O(n/D) at the cost of a
+new RNG discipline.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from consul_tpu.parallel.mesh import NODE_AXIS, block_size
+
+OUTBOX_SAFETY = 2   # c: budget multiple of the per-destination mean
+OUTBOX_FLOOR = 64   # never fewer slots than this (small-n studies)
+
+
+# ---------------------------------------------------------------------------
+# Outbox: fixed-budget cross-shard message routing.
+# ---------------------------------------------------------------------------
+
+
+def outbox_budget(stream_len: int, n_shards: int,
+                  c: int = OUTBOX_SAFETY, floor: int = OUTBOX_FLOOR) -> int:
+    """Per-destination outbox slots for a shard emitting ``stream_len``
+    messages a round.  Uniform global targeting sends a message to each
+    of the D shards with probability 1/D, so the Poissonized mean per
+    destination is stream_len/D; the budget is ``c`` times that (floor
+    ``floor``), and misses are counted — the same c x-mean discipline as
+    ``pp_initiator_budget`` in models/membership_sparse.py."""
+    if n_shards <= 1:
+        return 1  # degenerate: remote traffic cannot exist
+    return min(stream_len, max(floor, -(-c * stream_len // n_shards)))
+
+
+def pack_outbox(dest: jax.Array, ok: jax.Array, cols: tuple,
+                n_shards: int, budget: int):
+    """Pack a flat message stream into per-destination outbox slots.
+
+    ``dest`` int32[A] — destination shard per message; ``ok`` bool[A] —
+    message exists (False slots of the static stream are dropped);
+    ``cols`` — int32[A] payload planes (first is conventionally the
+    global receiver id).  Messages sort by destination, take their rank
+    within the destination's segment, and claim slot ``rank`` of that
+    destination's ``budget`` slots; unpacked slots hold -1.  Messages
+    ranked past the budget are dropped and counted.
+
+    Returns ``(outbox_cols, dropped)`` with each outbox plane shaped
+    [n_shards, budget]."""
+    # Reuse the sort-merge kernel's segmented prefix sum: the outbox is
+    # the same rank-matched allocation, with destination shards as the
+    # segments and slot index as the claim order.
+    from consul_tpu.ops.sortmerge import _segmented_sum
+
+    a_len = dest.shape[0]
+    idx = jnp.arange(a_len, dtype=jnp.int32)
+    d = jnp.where(ok, dest.astype(jnp.int32), n_shards)
+    d_sorted, perm = jax.lax.sort((d, idx), num_keys=1)
+    seg_start = (idx == 0) | (d_sorted != jnp.roll(d_sorted, 1))
+    rank = _segmented_sum(
+        seg_start, jnp.ones((a_len,), jnp.int32)
+    ) - 1
+    valid = d_sorted < n_shards
+    can = valid & (rank < budget)
+    slot = jnp.where(can, d_sorted * budget + rank, n_shards * budget)
+    packed = tuple(
+        jnp.full((n_shards * budget,), -1, jnp.int32)
+        .at[slot].set(c_[perm].astype(jnp.int32), mode="drop")
+        .reshape(n_shards, budget)
+        for c_ in cols
+    )
+    dropped = jnp.sum((valid & ~can).astype(jnp.int32))
+    return packed, dropped
+
+
+def exchange_outbox(planes: tuple, axis_name: str = NODE_AXIS) -> tuple:
+    """One ``all_to_all`` per payload plane: row d of each [D, budget]
+    outbox goes to shard d; the result flattens to the [D*budget] inbox
+    (row d = what shard d addressed to us, -1 slots empty)."""
+    return tuple(
+        jax.lax.all_to_all(p, axis_name, 0, 0, tiled=True).reshape(-1)
+        for p in planes
+    )
+
+
+def _rows(x: jax.Array, start: jax.Array, blk: int) -> jax.Array:
+    """This shard's row block of a replicated full-population array."""
+    return jax.lax.dynamic_slice_in_dim(x, start, blk, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Sharded broadcast (serf user-event epidemic).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps", "mesh"))
+def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
+                           mesh: Mesh):
+    """Sharded twin of ``sim.engine.broadcast_scan``: returns
+    ``(final_state, (infected[steps], overflow))`` with every per-node
+    plane block-sharded over the mesh and ``overflow`` the total outbox
+    budget misses (0 at D == 1 by construction)."""
+    from consul_tpu.models.broadcast import BroadcastState
+    from consul_tpu.ops import bernoulli_mask, deliver_or, sample_peers
+
+    n, fanout = cfg.n, cfg.fanout
+    d_shards = int(mesh.devices.size)
+    blk = block_size(n, mesh)
+    budget = (
+        outbox_budget(blk * fanout, d_shards)
+        if cfg.delivery == "edges" else 1
+    )
+
+    def tick(carry, k):
+        st, ov = carry
+        me = jax.lax.axis_index(NODE_AXIS)
+        start = me * blk
+        k_sel, k_loss = jax.random.split(k)
+        senders = st.knows & (st.tx_left > 0)
+
+        if cfg.delivery == "edges":
+            # Global sampling, local slice: same draws as the
+            # unsharded round for any D.
+            targets = _rows(sample_peers(k_sel, n, fanout), start, blk)
+            ok = senders[:, None] & _rows(
+                bernoulli_mask(k_loss, (n, fanout), 1.0 - cfg.loss),
+                start, blk,
+            )
+            recv = targets.ravel()
+            okf = ok.ravel()
+            dest = recv // blk
+            local = okf & (dest == me)
+            new_knows = deliver_or(
+                st.knows, jnp.where(local, recv - start, blk), local
+            )
+            (ob_recv,), dropped = pack_outbox(
+                dest, okf & (dest != me), (recv,), d_shards, budget
+            )
+            (ib_recv,) = exchange_outbox((ob_recv,))
+            got_in = ib_recv >= 0
+            new_knows = deliver_or(
+                new_knows, jnp.where(got_in, ib_recv - start, blk), got_in
+            )
+            ov = ov + jax.lax.psum(dropped, NODE_AXIS)
+        else:
+            # Poissonized aggregate delivery: the only cross-shard
+            # traffic is ONE scalar — the live sender count.
+            s_total = jax.lax.psum(
+                jnp.sum(senders, dtype=jnp.float32), NODE_AXIS
+            )
+            lam = (
+                (s_total - senders.astype(jnp.float32))
+                * fanout
+                * (1.0 - cfg.loss)
+                / max(n - 1, 1)
+            )
+            u = _rows(jax.random.uniform(k_loss, (n,)), start, blk)
+            new_knows = st.knows | (u < -jnp.expm1(-lam))
+
+        spent = jnp.where(senders, fanout, 0).astype(jnp.int32)
+        tx_left = jnp.maximum(st.tx_left - spent, 0)
+        newly = new_knows & ~st.knows
+        tx_left = jnp.where(newly, cfg.tx_limit, tx_left)
+        nxt = BroadcastState(
+            knows=new_knows, tx_left=tx_left, tick=st.tick + 1
+        )
+        infected = jax.lax.psum(
+            jnp.sum(new_knows, dtype=jnp.int32), NODE_AXIS
+        )
+        return (nxt, ov), infected
+
+    def body(st, key):
+        keys = jax.random.split(key, steps)
+        (final, ov), infected = jax.lax.scan(
+            tick, (st, jnp.int32(0)), keys
+        )
+        return final, infected, ov
+
+    state_spec = BroadcastState(P(NODE_AXIS), P(NODE_AXIS), P())
+    run = shard_map(
+        body, mesh=mesh,
+        in_specs=(state_spec, P()),
+        out_specs=(state_spec, P(), P()),
+        check_rep=False,
+    )
+    final, infected, ov = run(state, key)
+    return final, (infected, ov)
+
+
+# ---------------------------------------------------------------------------
+# Sharded dense membership (full N x N view matrix, row blocks).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track")
+)
+def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
+                            mesh: Mesh, track: tuple = ()):
+    """Sharded twin of ``sim.engine.membership_scan``: each device owns
+    ``n/D`` observer ROWS of every [n, n] plane.  Gossip scatters route
+    through the outbox; the push/pull row exchange gathers the budgeted
+    initiator/partner rows with a ``pmax`` over the mesh (rows are
+    [n]-wide, so dense sharding shards STATE and the probe/suspicion
+    planes — scale itself belongs to the sparse model).  Returns
+    ``(final_state, (outs..., overflow))`` with the same per-tick
+    counters as the unsharded scan."""
+    from consul_tpu.models.membership import (
+        NEVER,
+        RANK_ALIVE,
+        RANK_DEAD,
+        RANK_LEFT,
+        RANK_SUSPECT,
+        MembershipState,
+        _lifeguard_timeout_ticks,
+        _schedule_array,
+        key_inc,
+        key_rank,
+        make_key,
+    )
+    from consul_tpu.models.membership_sparse import pp_initiator_budget
+    from consul_tpu.ops import (
+        bernoulli_mask,
+        sample_peers,
+        sample_probe_targets,
+    )
+
+    n, fanout = cfg.n, cfg.fanout
+    m_drain = min(cfg.piggyback, n)
+    d_shards = int(mesh.devices.size)
+    blk = block_size(n, mesh)
+    budget = outbox_budget(blk * fanout * m_drain, d_shards)
+    track_idx = jnp.asarray(track, jnp.int32) if track else jnp.zeros(
+        (0,), jnp.int32
+    )
+
+    def tick(carry, k_rng):
+        st, ov = carry
+        me = jax.lax.axis_index(NODE_AXIS)
+        start = me * blk
+        t = st.tick
+        (k_tie, k_tgt, k_loss, k_pp, k_ppsel, k_probe, k_pfail) = (
+            jax.random.split(k_rng, 7)
+        )
+        rows_l = jnp.arange(blk, dtype=jnp.int32)
+        rows_g = start + rows_l
+
+        # Ground truth (replicated [n] schedules; local boolean slices).
+        fail_tick = _schedule_array(n, cfg.fail_at, NEVER)
+        leave_tick = _schedule_array(n, cfg.leave_at, NEVER)
+        join_tick = _schedule_array(n, cfg.join_at, 0)
+        present = t >= join_tick
+        crashed = t >= fail_tick
+        leaving = present & (t >= leave_tick) & ~crashed
+        departed = present & ~crashed & (
+            t >= jnp.where(
+                leave_tick == NEVER, NEVER,
+                leave_tick + cfg.leave_grace_ticks,
+            )
+        )
+        participates = present & ~crashed & ~departed
+        part_l = _rows(participates, start, blk)
+        present_l = _rows(present, start, blk)
+        leaving_l = _rows(leaving, start, blk)
+
+        key_m = st.key
+        tx = st.tx
+        suspect_since = st.suspect_since
+        confirms = st.confirms
+        own_inc = st.own_inc
+        awareness = st.awareness
+
+        # Leave intent: re-stamp the self cell (column = global id).
+        diag = key_m[rows_l, rows_g]
+        diag_val = jnp.where(
+            leaving_l,
+            make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE),
+        )
+        diag_val = jnp.maximum(diag, diag_val)
+        key_m = key_m.at[rows_l, rows_g].set(
+            jnp.where(present_l, diag_val, diag)
+        )
+        tx = tx.at[rows_l, rows_g].set(
+            jnp.where(diag_val > diag, cfg.tx_limit, tx[rows_l, rows_g])
+        )
+
+        # -- 1. gossip -------------------------------------------------
+        prio = tx.astype(jnp.float32) + _rows(
+            jax.random.uniform(k_tie, (n, n)), start, blk
+        )
+        _, subj = jax.lax.top_k(prio, m_drain)
+        subj = subj.astype(jnp.int32)                  # [blk, M] global
+        msg_key = jnp.take_along_axis(key_m, subj, axis=1)
+        msg_valid = (
+            (jnp.take_along_axis(tx, subj, axis=1) > 0)
+            & (msg_key >= 0)
+            & part_l[:, None]
+        )
+
+        targets = _rows(sample_peers(k_tgt, n, fanout), start, blk)
+        tgt_view = jnp.take_along_axis(key_m, targets, axis=1)
+        tgt_sendable = (
+            (tgt_view >= 0) & (key_rank(tgt_view) <= RANK_SUSPECT)
+        )
+        packet_ok = (
+            part_l[:, None]
+            & tgt_sendable
+            & _rows(
+                bernoulli_mask(k_loss, (n, fanout), 1.0 - cfg.loss),
+                start, blk,
+            )
+            & participates[targets]
+        )
+
+        shape3 = (blk, fanout, m_drain)
+        recv = jnp.broadcast_to(targets[:, :, None], shape3).ravel()
+        subj3 = jnp.broadcast_to(subj[:, None, :], shape3).ravel()
+        val3 = jnp.broadcast_to(msg_key[:, None, :], shape3).ravel()
+        ok3 = (
+            packet_ok[:, :, None] & msg_valid[:, None, :]
+        ).ravel()
+        sus3 = jnp.where(
+            key_rank(val3) == RANK_SUSPECT, key_inc(val3), -1
+        )
+
+        # Local deliveries scatter straight into the row block; remote
+        # ones ride the outbox.
+        dest = recv // blk
+        local = ok3 & (dest == me)
+        flat = jnp.where(local, (recv - start) * n + subj3, blk * n)
+        key_rx = (
+            jnp.full((blk * n,), -1, jnp.int32)
+            .at[flat].max(val3, mode="drop").reshape(blk, n)
+        )
+        sus_rx = (
+            jnp.full((blk * n,), -1, jnp.int32)
+            .at[flat].max(sus3, mode="drop").reshape(blk, n)
+        )
+        packed, dropped = pack_outbox(
+            dest, ok3 & (dest != me), (recv, subj3, val3, sus3),
+            d_shards, budget,
+        )
+        ib_recv, ib_subj, ib_val, ib_sus = exchange_outbox(packed)
+        got_in = ib_recv >= 0
+        flat_in = jnp.where(
+            got_in, (ib_recv - start) * n + ib_subj, blk * n
+        )
+        key_rx = (
+            key_rx.ravel().at[flat_in].max(ib_val, mode="drop")
+            .reshape(blk, n)
+        )
+        sus_rx = (
+            sus_rx.ravel().at[flat_in].max(ib_sus, mode="drop")
+            .reshape(blk, n)
+        )
+        ov_local = dropped
+
+        spend = jnp.where(msg_valid, fanout, 0)
+        tx = jnp.maximum(
+            tx.at[jnp.repeat(rows_l, m_drain), subj.ravel()]
+            .add(-spend.ravel()),
+            0,
+        )
+
+        # -- 2. push/pull ----------------------------------------------
+        ov_repl = jnp.int32(0)
+        if cfg.push_pull_enabled:
+            known_l = jnp.sum(
+                (key_m >= 0) & (key_rank(key_m) <= RANK_SUSPECT), axis=1
+            )
+            known_cnt = jax.lax.all_gather(
+                known_l, NODE_AXIS, tiled=True
+            )
+            needs_join = participates & (known_cnt <= 1)
+            initiate = participates & (
+                needs_join
+                | bernoulli_mask(k_pp, (n,), 1.0 / cfg.push_pull_ticks)
+            )
+            partner = sample_probe_targets(k_ppsel, n)
+            pp_ok = initiate & participates[partner]
+            if d_shards == 1:
+                # Full-width exchange — bit-equal to the unsharded
+                # round (the D == 1 pin, like sparse == dense at K == n).
+                key_rx = jnp.maximum(
+                    key_rx,
+                    jnp.where(pp_ok[:, None], key_m[partner], -1),
+                )
+                prow = jnp.where(pp_ok, partner, n)
+                key_rx = key_rx.at[prow].max(key_m, mode="drop")
+            else:
+                # Budgeted initiators (pp_initiator_budget, the sparse
+                # model's discipline); the [I, n] initiator and partner
+                # rows assemble by pmax — each shard contributes the
+                # rows it owns, -1 elsewhere.
+                i_slots = pp_initiator_budget(n, cfg.push_pull_ticks)
+                got_i, who = jax.lax.top_k(
+                    pp_ok.astype(jnp.int32), i_slots
+                )
+                who = who.astype(jnp.int32)
+                sel = got_i > 0
+                ov_repl = ov_repl + (
+                    jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got_i)
+                )
+                pwho = partner[who]
+
+                def rows_of(ids, live):
+                    loc = ids - start
+                    own = (loc >= 0) & (loc < blk) & live
+                    vals = key_m[jnp.clip(loc, 0, blk - 1)]
+                    return jax.lax.pmax(
+                        jnp.where(own[:, None], vals, -1), NODE_AXIS
+                    ), loc, own
+
+                init_rows, li, own_i = rows_of(who, sel)
+                partner_rows, lp, own_p = rows_of(pwho, sel)
+                # Pull: a locally-owned initiator merges its partner's
+                # row; push: a locally-owned partner merges the
+                # initiator's.
+                key_rx = key_rx.at[jnp.where(own_i, li, blk)].max(
+                    partner_rows, mode="drop"
+                )
+                key_rx = key_rx.at[jnp.where(own_p, lp, blk)].max(
+                    init_rows, mode="drop"
+                )
+
+        # -- 3. refutation ---------------------------------------------
+        self_rx = key_rx[rows_l, rows_g]
+        accused = jnp.where(
+            key_rank(self_rx) >= RANK_SUSPECT, key_inc(self_rx), -1
+        )
+        refuting = part_l & ~leaving_l & (accused >= own_inc)
+        own_inc = jnp.where(refuting, accused + 1, own_inc)
+        awareness = jnp.clip(
+            awareness + refuting.astype(jnp.int32),
+            0, cfg.profile.awareness_max_multiplier - 1,
+        )
+        key_rx = key_rx.at[rows_l, rows_g].set(-1)
+        self_key = jnp.where(
+            leaving_l,
+            make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE),
+        )
+        key_after_refute = key_m.at[rows_l, rows_g].max(
+            jnp.where(present_l, self_key, -1)
+        )
+        tx = tx.at[rows_l, rows_g].set(
+            jnp.where(refuting, cfg.tx_limit, tx[rows_l, rows_g])
+        )
+
+        # -- 4. merge --------------------------------------------------
+        old_key = key_after_refute
+        new_key = jnp.maximum(old_key, key_rx)
+        changed = new_key > old_key
+        fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
+        suspect_since = jnp.where(
+            fresh_suspect, t, jnp.where(changed, NEVER, suspect_since)
+        )
+        confirming = (
+            ~changed
+            & (key_rank(old_key) == RANK_SUSPECT)
+            & (sus_rx >= key_inc(old_key))
+        )
+        new_confirms = jnp.minimum(
+            confirms + confirming.astype(jnp.int32), cfg.confirmations_k
+        )
+        gained_conf = confirming & (new_confirms > confirms)
+        confirms = jnp.where(changed, 0, new_confirms)
+        tx = jnp.where(changed | gained_conf, cfg.tx_limit, tx)
+        key_m = new_key
+
+        # -- 5. probes -------------------------------------------------
+        if cfg.probe_enabled:
+            is_probe_tick = (t % cfg.probe_interval_ticks) == 0
+            ptarget = _rows(sample_probe_targets(k_probe, n), start, blk)
+            pt_view = key_m[rows_l, ptarget]
+            probing = (
+                is_probe_tick
+                & part_l
+                & (pt_view >= 0)
+                & (key_rank(pt_view) <= RANK_SUSPECT)
+            )
+            target_up = participates[ptarget]
+            p_fail = jnp.where(
+                target_up, jnp.float32(cfg.probe_fail_prob_alive), 1.0
+            )
+            failed = probing & (
+                _rows(jax.random.uniform(k_pfail, (n,)), start, blk)
+                < p_fail
+            )
+            can_pend = failed & (st.probe_pending_at == NEVER)
+            matures_at = (
+                t + cfg.probe_interval_ticks
+                + awareness * cfg.probe_timeout_ticks
+            )
+            awareness = jnp.clip(
+                awareness + failed.astype(jnp.int32)
+                - (probing & ~failed).astype(jnp.int32),
+                0, cfg.profile.awareness_max_multiplier - 1,
+            )
+            probe_pending_at = jnp.where(
+                can_pend, matures_at, st.probe_pending_at
+            )
+            probe_subject = jnp.where(can_pend, ptarget, st.probe_subject)
+
+            mature = (probe_pending_at <= t) & part_l
+            mview = key_m[rows_l, probe_subject]
+            apply_sus = mature & (key_rank(mview) == RANK_ALIVE)
+            sus_key = make_key(key_inc(mview), RANK_SUSPECT)
+            scol = jnp.where(apply_sus, probe_subject, n)
+            key_m = key_m.at[rows_l, scol].set(
+                jnp.where(apply_sus, sus_key, 0), mode="drop"
+            )
+            suspect_since = suspect_since.at[rows_l, scol].set(
+                jnp.where(apply_sus, t, 0), mode="drop"
+            )
+            confirms = confirms.at[rows_l, scol].set(0, mode="drop")
+            tx = tx.at[rows_l, scol].set(cfg.tx_limit, mode="drop")
+            probe_pending_at = jnp.where(mature, NEVER, probe_pending_at)
+        else:
+            probe_pending_at = st.probe_pending_at
+            probe_subject = st.probe_subject
+
+        # -- 6. suspicion expiry ---------------------------------------
+        timeout = _lifeguard_timeout_ticks(cfg, confirms)
+        elapsed = (t - suspect_since).astype(jnp.float32)
+        expire = (
+            (key_rank(key_m) == RANK_SUSPECT)
+            & (suspect_since != NEVER)
+            & (elapsed >= timeout)
+            & part_l[:, None]
+        )
+        key_m = jnp.where(
+            expire, make_key(key_inc(key_m), RANK_DEAD), key_m
+        )
+        suspect_since = jnp.where(expire, NEVER, suspect_since)
+        tx = jnp.where(expire, cfg.tx_limit, tx)
+
+        nxt = MembershipState(
+            key=key_m,
+            suspect_since=suspect_since,
+            confirms=confirms,
+            tx=tx,
+            own_inc=own_inc,
+            awareness=awareness,
+            probe_pending_at=probe_pending_at,
+            probe_subject=probe_subject,
+            tick=t + 1,
+        )
+        ranks = key_rank(key_m)
+        cols = ranks[:, track_idx] if track else jnp.zeros(
+            (blk, 0), jnp.int32
+        )
+        out = (
+            jax.lax.psum(
+                jnp.sum(cols == RANK_SUSPECT, axis=0, dtype=jnp.int32),
+                NODE_AXIS,
+            ),
+            jax.lax.psum(
+                jnp.sum(cols == RANK_DEAD, axis=0, dtype=jnp.int32),
+                NODE_AXIS,
+            ),
+            jax.lax.psum(
+                jnp.sum(ranks == RANK_SUSPECT, dtype=jnp.int32),
+                NODE_AXIS,
+            ),
+            jax.lax.psum(
+                jnp.sum(
+                    (key_m >= 0) & (ranks <= RANK_SUSPECT),
+                    dtype=jnp.int32,
+                ),
+                NODE_AXIS,
+            ),
+        )
+        ov = ov + jax.lax.psum(ov_local, NODE_AXIS) + ov_repl
+        return (nxt, ov), out
+
+    state_spec = MembershipState(
+        key=P(NODE_AXIS, None),
+        suspect_since=P(NODE_AXIS, None),
+        confirms=P(NODE_AXIS, None),
+        tx=P(NODE_AXIS, None),
+        own_inc=P(NODE_AXIS),
+        awareness=P(NODE_AXIS),
+        probe_pending_at=P(NODE_AXIS),
+        probe_subject=P(NODE_AXIS),
+        tick=P(),
+    )
+
+    def body(st, key):
+        keys = jax.random.split(key, steps)
+        (final, ov), outs = jax.lax.scan(
+            tick, (st, jnp.int32(0)), keys
+        )
+        return final, outs, ov
+
+    run = shard_map(
+        body, mesh=mesh,
+        in_specs=(state_spec, P()),
+        out_specs=(state_spec, (P(), P(), P(), P()), P()),
+        check_rep=False,
+    )
+    final, outs, ov = run(state, key)
+    return final, (*outs, ov)
+
+
+# ---------------------------------------------------------------------------
+# Sharded sparse membership (top-K slots, sort-merge delivery).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track")
+)
+def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
+                                   steps: int, mesh: Mesh,
+                                   track: tuple = ()):
+    """Sharded twin of ``sim.engine.sparse_membership_scan``: each
+    device owns ``n/D`` observer rows of the [n, K] slot planes; the
+    whole inbound stream — local gossip, compacted push/pull, and the
+    outbox inbox — lands through ONE call to the sort-merge delivery
+    kernel per tick (``ops/sortmerge.py``, unchanged, on the local
+    block).  Requires K < n (the K == n identity layout is the
+    unsharded parity mode).  Returns ``(final_state, outs)`` shaped
+    like the unsharded scan; ``state.overflow`` additionally counts
+    outbox budget misses."""
+    from consul_tpu.models.membership import (
+        NEVER,
+        RANK_ALIVE,
+        RANK_DEAD,
+        RANK_LEFT,
+        RANK_SUSPECT,
+        _lifeguard_timeout_ticks,
+        _schedule_array,
+        key_inc,
+        key_rank,
+        make_key,
+    )
+    from consul_tpu.models.membership_sparse import (
+        DEFAULT_KEY,
+        SparseMembershipState,
+        _claim_slot,
+        _merge_arrivals,
+        _view_of,
+        pp_initiator_budget,
+        settled_of,
+    )
+    from consul_tpu.ops import (
+        bernoulli_mask,
+        row_locate,
+        sample_peers,
+        sample_probe_targets,
+        sort_slot_rows,
+    )
+
+    base = cfg.base
+    n, fanout = base.n, base.fanout
+    k_slots = min(cfg.k_slots, n)
+    if k_slots >= n:
+        raise ValueError(
+            "sharded sparse plane requires k_slots < n (K == n is the "
+            "unsharded dense-parity mode)"
+        )
+    m_drain = min(base.piggyback, k_slots)
+    d_shards = int(mesh.devices.size)
+    blk = block_size(n, mesh)
+    i_slots = pp_initiator_budget(n, base.push_pull_ticks)
+    stream_len = blk * fanout * m_drain
+    if base.push_pull_enabled:
+        stream_len += 2 * i_slots * k_slots
+    budget = outbox_budget(stream_len, d_shards)
+    track_idx = jnp.asarray(track, jnp.int32) if track else jnp.zeros(
+        (0,), jnp.int32
+    )
+
+    def tick(st, k_rng):
+        me = jax.lax.axis_index(NODE_AXIS)
+        start = me * blk
+        t = st.tick
+        (k_tie, k_tgt, k_loss, k_pp, k_ppsel, k_probe, k_pfail) = (
+            jax.random.split(k_rng, 7)
+        )
+        rows_l = jnp.arange(blk, dtype=jnp.int32)
+        rows_g = start + rows_l
+
+        fail_tick = _schedule_array(n, base.fail_at, NEVER)
+        leave_tick = _schedule_array(n, base.leave_at, NEVER)
+        present = jnp.ones((n,), bool)
+        crashed = t >= fail_tick
+        leaving = present & (t >= leave_tick) & ~crashed
+        departed = present & ~crashed & (
+            t >= jnp.where(
+                leave_tick == NEVER, NEVER,
+                leave_tick + base.leave_grace_ticks,
+            )
+        )
+        participates = present & ~crashed & ~departed
+        part_l = _rows(participates, start, blk)
+        leaving_l = _rows(leaving, start, blk)
+
+        slot_subj = st.slot_subj
+        key_m = st.key
+        tx = st.tx
+        suspect_since = st.suspect_since
+        confirms = st.confirms
+        own_inc = st.own_inc
+        awareness = st.awareness
+        overflow = st.overflow
+        forgotten = st.forgotten
+
+        occupied = slot_subj >= 0
+        self_slot = row_locate(slot_subj, rows_l, rows_g)
+
+        diag = key_m[rows_l, self_slot]
+        diag_val = jnp.where(
+            leaving_l,
+            make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE),
+        )
+        diag_val = jnp.maximum(diag, diag_val)
+        key_m = key_m.at[rows_l, self_slot].set(diag_val)
+        tx = tx.at[rows_l, self_slot].set(
+            jnp.where(
+                diag_val > diag, base.tx_limit, tx[rows_l, self_slot]
+            )
+        )
+
+        # -- 1. gossip -------------------------------------------------
+        prio = jnp.where(
+            occupied, tx.astype(jnp.float32), -jnp.inf
+        ) + _rows(jax.random.uniform(k_tie, (n, k_slots)), start, blk)
+        _, sslot = jax.lax.top_k(prio, m_drain)
+        sslot = sslot.astype(jnp.int32)
+        msg_subj = jnp.take_along_axis(slot_subj, sslot, axis=1)
+        msg_key = jnp.take_along_axis(key_m, sslot, axis=1)
+        msg_valid = (
+            (jnp.take_along_axis(tx, sslot, axis=1) > 0)
+            & (msg_subj >= 0)
+            & part_l[:, None]
+        )
+
+        targets = _rows(sample_peers(k_tgt, n, fanout), start, blk)
+        tgt_view = _view_of(slot_subj, key_m, rows_l[:, None], targets)
+        tgt_sendable = key_rank(tgt_view) <= RANK_SUSPECT
+        packet_ok = (
+            part_l[:, None]
+            & tgt_sendable
+            & _rows(
+                bernoulli_mask(k_loss, (n, fanout), 1.0 - base.loss),
+                start, blk,
+            )
+            & participates[targets]
+        )
+
+        shape3 = (blk, fanout, m_drain)
+        recv_g = jnp.broadcast_to(targets[:, :, None], shape3).ravel()
+        subj_g = jnp.broadcast_to(msg_subj[:, None, :], shape3).ravel()
+        val_g = jnp.broadcast_to(msg_key[:, None, :], shape3).ravel()
+        ok_g = (packet_ok[:, :, None] & msg_valid[:, None, :]).ravel()
+        sus_g = jnp.where(
+            key_rank(val_g) == RANK_SUSPECT, key_inc(val_g), -1
+        )
+        alloc_g = jnp.ones(recv_g.shape, bool)
+
+        spend = jnp.where(msg_valid, fanout, 0)
+        tx = jnp.maximum(
+            tx.at[jnp.repeat(rows_l, m_drain), sslot.ravel()]
+            .add(-spend.ravel()),
+            0,
+        )
+
+        # -- 2. push/pull (compacted; sources emit, outbox routes) -----
+        ov_repl = jnp.int32(0)
+        streams = [(recv_g, subj_g, val_g, sus_g, ok_g, alloc_g)]
+        if base.push_pull_enabled:
+            dead_cnt_l = jnp.sum(
+                occupied & (key_rank(key_m) > RANK_SUSPECT), axis=1
+            )
+            known_l = n - dead_cnt_l
+            known_cnt = jax.lax.all_gather(
+                known_l, NODE_AXIS, tiled=True
+            )
+            needs_join = participates & (known_cnt <= 1)
+            initiate = participates & (
+                needs_join
+                | bernoulli_mask(k_pp, (n,), 1.0 / base.push_pull_ticks)
+            )
+            partner = sample_probe_targets(k_ppsel, n)
+            pp_ok = initiate & participates[partner]
+            got_i, who = jax.lax.top_k(pp_ok.astype(jnp.int32), i_slots)
+            who = who.astype(jnp.int32)
+            sel = got_i > 0
+            ov_repl = ov_repl + (
+                jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got_i)
+            )
+            pwho = partner[who]
+            # Each shard emits the exchange legs whose SOURCE row it
+            # owns; the outbox routes them to the receiver's shard.
+            lp = pwho - start
+            own_p = (lp >= 0) & (lp < blk) & sel
+            src_p = jnp.clip(lp, 0, blk - 1)
+            subj_pull = slot_subj[src_p].ravel()
+            val_pull = key_m[src_p].ravel()
+            recv_pull = jnp.repeat(who, k_slots)
+            ok_pull = jnp.repeat(own_p, k_slots) & (subj_pull >= 0)
+            li = who - start
+            own_i = (li >= 0) & (li < blk) & sel
+            src_i = jnp.clip(li, 0, blk - 1)
+            subj_push = slot_subj[src_i].ravel()
+            val_push = key_m[src_i].ravel()
+            recv_push = jnp.repeat(pwho, k_slots)
+            ok_push = jnp.repeat(own_i, k_slots) & (subj_push >= 0)
+            minus1 = jnp.full(recv_pull.shape, -1, jnp.int32)
+            # Settled alive@inc pp rows merge but never allocate (the
+            # evict->relearn amplification gate, as unsharded).
+            alloc_pull = key_rank(val_pull) >= RANK_SUSPECT
+            alloc_push = key_rank(val_push) >= RANK_SUSPECT
+            streams.append((recv_pull, subj_pull, val_pull, minus1,
+                            ok_pull, alloc_pull))
+            streams.append((recv_push, subj_push, val_push, minus1,
+                            ok_push, alloc_push))
+
+        recv = jnp.concatenate([s[0] for s in streams])
+        subj = jnp.concatenate([s[1] for s in streams])
+        val = jnp.concatenate([s[2] for s in streams])
+        sus = jnp.concatenate([s[3] for s in streams])
+        ok = jnp.concatenate([s[4] for s in streams])
+        alloc = jnp.concatenate([s[5] for s in streams])
+
+        # -- 3. route: local stream + outbox exchange ------------------
+        dest = recv // blk
+        local = ok & (dest == me)
+        packed, dropped = pack_outbox(
+            dest, ok & (dest != me),
+            (recv, subj, val, sus, alloc.astype(jnp.int32)),
+            d_shards, budget,
+        )
+        ib_recv, ib_subj, ib_val, ib_sus, ib_alloc = exchange_outbox(
+            packed
+        )
+        ib_ok = ib_recv >= 0
+        recv_l = jnp.concatenate([
+            jnp.where(local, recv - start, 0),
+            jnp.where(ib_ok, ib_recv - start, 0),
+        ])
+        subj_l = jnp.concatenate([subj, ib_subj])
+        val_l = jnp.concatenate([val, ib_val])
+        sus_l = jnp.concatenate([sus, ib_sus])
+        ok_l = jnp.concatenate([local, ib_ok])
+        alloc_l = jnp.concatenate([alloc, ib_alloc > 0])
+
+        slots_t, key_rx, sus_rx, overflow_l, forgotten_l = (
+            _merge_arrivals(
+                (slot_subj, key_m, suspect_since, confirms, tx),
+                recv_l, subj_l, val_l, sus_l, ok_l, alloc_l, n, k_slots,
+                jnp.int32(0), jnp.int32(0), row_ids=rows_g,
+            )
+        )
+        slot_subj, key_m, suspect_since, confirms, tx = slots_t
+        overflow = overflow + ov_repl + jax.lax.psum(
+            overflow_l + dropped, NODE_AXIS
+        )
+        forgotten = forgotten + jax.lax.psum(forgotten_l, NODE_AXIS)
+        self_slot = row_locate(slot_subj, rows_l, rows_g)
+
+        # -- 4. refutation + merge -------------------------------------
+        self_rx = key_rx[rows_l, self_slot]
+        accused = jnp.where(
+            key_rank(self_rx) >= RANK_SUSPECT, key_inc(self_rx), -1
+        )
+        refuting = part_l & ~leaving_l & (accused >= own_inc)
+        own_inc = jnp.where(refuting, accused + 1, own_inc)
+        awareness = jnp.clip(
+            awareness + refuting.astype(jnp.int32),
+            0, base.profile.awareness_max_multiplier - 1,
+        )
+        key_rx = key_rx.at[rows_l, self_slot].set(-1)
+        self_key = jnp.where(
+            leaving_l,
+            make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE),
+        )
+        key_after_refute = key_m.at[rows_l, self_slot].max(self_key)
+        tx = tx.at[rows_l, self_slot].set(
+            jnp.where(refuting, base.tx_limit, tx[rows_l, self_slot])
+        )
+
+        old_key = key_after_refute
+        new_key = jnp.maximum(old_key, key_rx)
+        changed = new_key > old_key
+        fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
+        suspect_since = jnp.where(
+            fresh_suspect, t, jnp.where(changed, NEVER, suspect_since)
+        )
+        confirming = (
+            ~changed
+            & (key_rank(old_key) == RANK_SUSPECT)
+            & (sus_rx >= key_inc(old_key))
+        )
+        new_confirms = jnp.minimum(
+            confirms + confirming.astype(jnp.int32),
+            base.confirmations_k,
+        )
+        gained_conf = confirming & (new_confirms > confirms)
+        confirms = jnp.where(changed, 0, new_confirms)
+        tx = jnp.where(changed | gained_conf, base.tx_limit, tx)
+        key_m = new_key
+
+        # -- 5. probes -------------------------------------------------
+        if base.probe_enabled:
+            is_probe_tick = (t % base.probe_interval_ticks) == 0
+            ptarget = _rows(sample_probe_targets(k_probe, n), start, blk)
+            pt_view = _view_of(slot_subj, key_m, rows_l, ptarget)
+            probing = (
+                is_probe_tick
+                & part_l
+                & (key_rank(pt_view) <= RANK_SUSPECT)
+            )
+            target_up = participates[ptarget]
+            p_fail = jnp.where(
+                target_up, jnp.float32(base.probe_fail_prob_alive), 1.0
+            )
+            failed = probing & (
+                _rows(jax.random.uniform(k_pfail, (n,)), start, blk)
+                < p_fail
+            )
+            can_pend = failed & (st.probe_pending_at == NEVER)
+            matures_at = (
+                t + base.probe_interval_ticks
+                + awareness * base.probe_timeout_ticks
+            )
+            awareness = jnp.clip(
+                awareness + failed.astype(jnp.int32)
+                - (probing & ~failed).astype(jnp.int32),
+                0, base.profile.awareness_max_multiplier - 1,
+            )
+            probe_pending_at = jnp.where(
+                can_pend, matures_at, st.probe_pending_at
+            )
+            probe_subject = jnp.where(can_pend, ptarget, st.probe_subject)
+
+            mature = (probe_pending_at <= t) & part_l
+            mslot = row_locate(slot_subj, rows_l, probe_subject)
+            need = mature & (mslot < 0)
+            slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
+            slots_p, can, choice, forgot = _claim_slot(
+                slots_p, settled_of(slots_p, rows_g), need,
+                probe_subject, blk, k_slots,
+            )
+            slot_subj, key_m, suspect_since, confirms, tx = slots_p
+            forgotten = forgotten + jax.lax.psum(forgot, NODE_AXIS)
+            overflow = overflow + jax.lax.psum(
+                jnp.sum((need & ~can).astype(jnp.int32)), NODE_AXIS
+            )
+            mslot = jnp.where(can, choice, mslot)
+            mview = jnp.where(
+                mslot >= 0,
+                key_m[rows_l, jnp.maximum(mslot, 0)], DEFAULT_KEY,
+            )
+            apply_sus = mature & (mslot >= 0) & (
+                key_rank(mview) == RANK_ALIVE
+            )
+            sus_key = make_key(key_inc(mview), RANK_SUSPECT)
+            scol = jnp.where(apply_sus, mslot, k_slots)
+            key_m = key_m.at[rows_l, scol].set(
+                jnp.where(apply_sus, sus_key, 0), mode="drop"
+            )
+            suspect_since = suspect_since.at[rows_l, scol].set(
+                jnp.where(apply_sus, t, 0), mode="drop"
+            )
+            confirms = confirms.at[rows_l, scol].set(0, mode="drop")
+            tx = tx.at[rows_l, scol].set(base.tx_limit, mode="drop")
+            probe_pending_at = jnp.where(mature, NEVER, probe_pending_at)
+        else:
+            probe_pending_at = st.probe_pending_at
+            probe_subject = st.probe_subject
+
+        # -- 6. suspicion expiry ---------------------------------------
+        timeout = _lifeguard_timeout_ticks(base, confirms)
+        elapsed = (t - suspect_since).astype(jnp.float32)
+        expire = (
+            (key_rank(key_m) == RANK_SUSPECT)
+            & (suspect_since != NEVER)
+            & (elapsed >= timeout)
+            & part_l[:, None]
+        )
+        key_m = jnp.where(
+            expire, make_key(key_inc(key_m), RANK_DEAD), key_m
+        )
+        suspect_since = jnp.where(expire, NEVER, suspect_since)
+        tx = jnp.where(expire, base.tx_limit, tx)
+
+        if base.probe_enabled:
+            (slot_subj, key_m, suspect_since, confirms, tx) = (
+                sort_slot_rows(
+                    slot_subj, key_m, suspect_since, confirms, tx
+                )
+            )
+
+        nxt = SparseMembershipState(
+            slot_subj=slot_subj,
+            key=key_m,
+            suspect_since=suspect_since,
+            confirms=confirms,
+            tx=tx,
+            own_inc=own_inc,
+            awareness=awareness,
+            probe_pending_at=probe_pending_at,
+            probe_subject=probe_subject,
+            overflow=overflow,
+            forgotten=forgotten,
+            tick=t + 1,
+        )
+
+        ranks = key_rank(key_m)
+        if track:
+            hit = slot_subj[:, :, None] == track_idx[None, None, :]
+            sus_t = jax.lax.psum(
+                jnp.sum(
+                    hit & (ranks == RANK_SUSPECT)[:, :, None],
+                    axis=(0, 1), dtype=jnp.int32,
+                ),
+                NODE_AXIS,
+            )
+            dead_t = jax.lax.psum(
+                jnp.sum(
+                    hit & (ranks == RANK_DEAD)[:, :, None],
+                    axis=(0, 1), dtype=jnp.int32,
+                ),
+                NODE_AXIS,
+            )
+        else:
+            sus_t = jnp.zeros((0,), jnp.int32)
+            dead_t = jnp.zeros((0,), jnp.int32)
+        occ = slot_subj >= 0
+        dead_cells = jax.lax.psum(
+            jnp.sum(occ & (ranks > RANK_SUSPECT), dtype=jnp.float32),
+            NODE_AXIS,
+        )
+        out = (
+            sus_t,
+            dead_t,
+            jax.lax.psum(
+                jnp.sum(occ & (ranks == RANK_SUSPECT), dtype=jnp.int32),
+                NODE_AXIS,
+            ),
+            jnp.float32(n) * n - dead_cells,
+        )
+        return nxt, out
+
+    state_spec = SparseMembershipState(
+        slot_subj=P(NODE_AXIS, None),
+        key=P(NODE_AXIS, None),
+        suspect_since=P(NODE_AXIS, None),
+        confirms=P(NODE_AXIS, None),
+        tx=P(NODE_AXIS, None),
+        own_inc=P(NODE_AXIS),
+        awareness=P(NODE_AXIS),
+        probe_pending_at=P(NODE_AXIS),
+        probe_subject=P(NODE_AXIS),
+        overflow=P(),
+        forgotten=P(),
+        tick=P(),
+    )
+
+    def body(st, key):
+        keys = jax.random.split(key, steps)
+        return jax.lax.scan(tick, st, keys)
+
+    run = shard_map(
+        body, mesh=mesh,
+        in_specs=(state_spec, P()),
+        out_specs=(state_spec, (P(), P(), P(), P())),
+        check_rep=False,
+    )
+    return run(state, key)
+
+
+# ---------------------------------------------------------------------------
+# Standalone multichip datapoint: python -m consul_tpu.parallel.shard
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """Emit one multichip datapoint as a JSON line: the sharded
+    broadcast study over ``--devices`` mesh devices at ``--n``
+    AGGREGATE nodes.
+
+    This is bench.py's subprocess on single-device (CPU) containers —
+    like ``__graft_entry__.dryrun_multichip``, when the process doesn't
+    already expose enough devices it forces virtual host devices via
+    ``xla_force_host_platform_device_count`` before first backend use.
+    On a real v5e-8 bench runs the same study in-process instead."""
+    import argparse
+    import json
+    import os
+    import time
+
+    import numpy as np
+
+    parser = argparse.ArgumentParser(prog="consul_tpu.parallel.shard")
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--n", type=int, default=4096,
+                        help="aggregate nodes across the mesh")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    forced = False
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.devices}"
+        ).strip()
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            forced = True
+        except RuntimeError:
+            pass  # backend already initialized; use whatever exists
+
+    from consul_tpu.models.broadcast import (
+        BroadcastConfig,
+        broadcast_init,
+    )
+    from consul_tpu.parallel.mesh import mesh_for
+
+    # mesh_for raises on a device shortfall (pre-set XLA_FLAGS with a
+    # smaller count, or a backend initialized before the forcing above)
+    # — a quietly-shrunk mesh would emit a "multichip" datapoint that
+    # isn't, violating the loud-never-silent discipline.
+    mesh = mesh_for(args.devices)
+    cfg = BroadcastConfig(n=args.n, fanout=4, delivery="edges")
+    key = jax.random.PRNGKey(args.seed)
+    # Warmup compiles the program; the timed pass is steady-state.
+    _, (infected, ov) = sharded_broadcast_scan(
+        broadcast_init(cfg), key, cfg, args.steps, mesh
+    )
+    np.asarray(infected)
+    t0 = time.perf_counter()
+    _, (infected, ov) = sharded_broadcast_scan(
+        broadcast_init(cfg), key, cfg, args.steps, mesh
+    )
+    infected = np.asarray(infected)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "devices": int(mesh.devices.size),
+        "nodes_aggregate": cfg.n,
+        "nodes_per_device": cfg.n // int(mesh.devices.size),
+        "rounds": args.steps,
+        "rounds_per_sec": round(args.steps / wall, 2) if wall > 0 else None,
+        "infected_final": int(infected[-1]),
+        "overflow": int(np.asarray(ov)),
+        "host_devices_forced": forced,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -23,6 +23,9 @@ from consul_tpu.sim.engine import (
     broadcast_scan,
     lifeguard_scan,
     swim_scan,
+    sharded_broadcast_scan,
+    sharded_membership_scan,
+    sharded_sparse_membership_scan,
 )
 from consul_tpu.sim.metrics import (
     time_to_fraction,
@@ -54,6 +57,9 @@ __all__ = [
     "broadcast_scan",
     "multidc_scan",
     "swim_scan",
+    "sharded_broadcast_scan",
+    "sharded_membership_scan",
+    "sharded_sparse_membership_scan",
     "time_to_fraction",
     "BroadcastReport",
     "SwimReport",

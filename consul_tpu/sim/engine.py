@@ -42,6 +42,11 @@ from consul_tpu.models.swim import (
     VIEW_SUSPECT,
 )
 from consul_tpu.parallel import make_mesh, shard_state
+from consul_tpu.parallel.shard import (
+    sharded_broadcast_scan,
+    sharded_membership_scan,
+    sharded_sparse_membership_scan,
+)
 from consul_tpu.sim.metrics import (
     BroadcastReport,
     FalsePositiveReport,
@@ -192,11 +197,34 @@ def run_broadcast(
     mesh=None,
     warmup: bool = True,
 ) -> BroadcastReport:
+    """``mesh=`` alone selects the explicit multi-chip plane
+    (consul_tpu/parallel/shard.py: per-device node blocks, outbox
+    message routing, D == 1 bit-equal to the unsharded scan) and fills
+    ``report.overflow``; ``sharded=True`` keeps the legacy GSPMD
+    placement path (shard_state over the unsharded program)."""
     def make_state():
         st = broadcast_init(cfg, origin=origin)
         return shard_state(st, mesh or make_mesh()) if sharded else st
 
     key = jax.random.PRNGKey(seed)
+    if mesh is not None and not sharded:
+        # Positional static args on purpose: jit caches keyword and
+        # positional call shapes separately, and tests/benches call the
+        # sharded scans positionally.
+        def scan(st, k, c, s):
+            return sharded_broadcast_scan(st, k, c, s, mesh)
+
+        _, (infected, ov), wall = _timed(
+            make_state, scan, key, cfg, steps, warmup
+        )
+        return BroadcastReport(
+            n=cfg.n,
+            ticks=steps,
+            tick_ms=cfg.profile.gossip_interval_ms,
+            infected=np.asarray(infected),
+            wall_s=wall,
+            overflow=int(np.asarray(ov)),
+        )
     _, infected, wall = _timed(make_state, broadcast_scan, key, cfg, steps, warmup)
     return BroadcastReport(
         n=cfg.n,
@@ -250,7 +278,8 @@ def run_membership(
     warmup: bool = True,
 ):
     """Full-membership study; ``track`` selects the subject columns whose
-    detection curves come back per tick."""
+    detection curves come back per tick.  ``mesh=`` alone selects the
+    explicit multi-chip plane (see :func:`run_broadcast`)."""
     from consul_tpu.sim.metrics import MembershipReport
 
     def make_state():
@@ -258,6 +287,28 @@ def run_membership(
         return shard_state(st, mesh or make_mesh()) if sharded else st
 
     key = jax.random.PRNGKey(seed)
+    if mesh is not None and not sharded:
+        track_t = tuple(track)
+
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return sharded_membership_scan(st, k, c, s, mesh, track_t)
+
+        _, (sus, dead, sus_cells, known, ov), wall = _timed(
+            make_state, scan, key, cfg, steps, warmup
+        )
+        return MembershipReport(
+            n=cfg.n,
+            ticks=steps,
+            tick_ms=cfg.profile.gossip_interval_ms,
+            probe_interval_ms=cfg.profile.probe_interval_ms,
+            track=tuple(track),
+            suspecting=sus,
+            dead_known=dead,
+            suspect_cells=sus_cells,
+            known_members=known,
+            wall_s=wall,
+            overflow=int(np.asarray(ov)),
+        )
     scan = functools.partial(membership_scan, track=tuple(track))
     _, (sus, dead, sus_cells, known), wall = _timed(
         make_state, scan, key, cfg, steps, warmup
@@ -338,15 +389,28 @@ def run_membership_sparse(
     seed: int = 0,
     track: tuple = (),
     warmup: bool = True,
+    mesh=None,
 ):
     """Top-K sparse membership study (models/membership_sparse.py): the
     n ≥ 10⁵ regime the dense model's O(N²) state cannot reach, delivered
-    through the O(A log K) sort-merge kernel (ops/sortmerge.py)."""
+    through the O(A log K) sort-merge kernel (ops/sortmerge.py).
+
+    ``mesh=`` shards the observer rows over the device mesh
+    (consul_tpu/parallel/shard.py); the returned overflow then also
+    counts outbox budget misses."""
     from consul_tpu.models.membership_sparse import sparse_membership_init
     from consul_tpu.sim.metrics import MembershipReport
 
     key = jax.random.PRNGKey(seed)
-    scan = functools.partial(sparse_membership_scan, track=tuple(track))
+    if mesh is not None:
+        track_t = tuple(track)
+
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return sharded_sparse_membership_scan(
+                st, k, c, s, mesh, track_t
+            )
+    else:
+        scan = functools.partial(sparse_membership_scan, track=tuple(track))
     final, (sus, dead, sus_cells, known), wall = _timed(
         lambda: sparse_membership_init(cfg), scan, key, cfg, steps, warmup
     )

@@ -28,6 +28,10 @@ class BroadcastReport:
     tick_ms: float
     infected: np.ndarray          # int per tick (post-tick counts)
     wall_s: float                 # host wall time for the simulated run
+    # Sharded (shard_map) runs only: outbox messages dropped to the
+    # static per-shard budget (consul_tpu/parallel/shard.py); 0 means
+    # the multi-chip run delivered exactly what a single chip would.
+    overflow: Optional[int] = None
 
     def time_to_ms(self, frac: float) -> Optional[float]:
         t = time_to_fraction(self.infected, self.n, frac)
@@ -115,6 +119,8 @@ class MembershipReport:
     suspect_cells: np.ndarray     # int32[ticks] — global suspicion pressure
     known_members: np.ndarray     # int32[ticks] — sum of membership sizes
     wall_s: float
+    # Sharded (shard_map) runs only — see BroadcastReport.overflow.
+    overflow: Optional[int] = None
 
     @property
     def rounds_per_sec(self) -> float:

@@ -40,20 +40,26 @@ def dev3(seed: int = 0) -> dict:
     return {"scenario": "dev3", **rep.summary()}
 
 
-def probe1k(seed: int = 0) -> dict:
+def probe1k(seed: int = 0, devices: int = None) -> dict:
     """BASELINE config 2: 1k nodes, SWIM probe/ack, 1% induced failure.
 
     1% of 1000 = 10 CONCURRENT crashes in one full-membership program
     (models/membership.py): the failures interact through shared gossip
     bandwidth, confirmation cross-talk, and the push/pull backstop —
-    the dynamics 10 independent single-subject universes can't show."""
+    the dynamics 10 independent single-subject universes can't show.
+
+    ``devices`` shards the observer rows over the first D devices
+    (``cli sim probe1k --devices D``)."""
+    from consul_tpu.parallel import mesh_for
+
     failed = tuple(range(0, 1000, 100))  # 10 spread-out subjects
     cfg = MembershipConfig(
         n=1000, loss=0.0, profile=LAN, fanout=3,
         fail_at=tuple((f, 10) for f in failed),
     )
     rep = run_membership(cfg, steps=300, seed=seed, track=failed,
-                         warmup=False)
+                         warmup=False,
+                         mesh=mesh_for(devices) if devices else None)
     first_sus = [rep.first_detection_ms(i) for i in range(len(failed))]
     live = cfg.n - len(failed)
     conv = [rep.dead_converged(i, live) for i in range(len(failed))]
@@ -69,11 +75,26 @@ def probe1k(seed: int = 0) -> dict:
             [(c + 1) * rep.tick_ms for c in conv if c is not None]
         )) if any(c is not None for c in conv) else None,
         "sim_rounds_per_sec": rep.rounds_per_sec,
+        **({"devices": devices, "shard_overflow": rep.overflow}
+           if devices else {}),
     }
 
 
-def event100k(seed: int = 0) -> dict:
-    """BASELINE config 3: 100k-node event broadcast, LAN, fanout 4."""
+def event100k(seed: int = 0, devices: int = None) -> dict:
+    """BASELINE config 3: 100k-node event broadcast, LAN, fanout 4.
+
+    ``devices`` runs the exact per-message path sharded over the first
+    D devices (``cli sim event100k --devices D``) — the outbox/
+    all_to_all plane, with budget misses reported as shard_overflow."""
+    from consul_tpu.parallel import mesh_for
+
+    if devices:
+        cfg = BroadcastConfig(n=100_000, fanout=4, profile=LAN,
+                              delivery="edges")
+        rep = run_broadcast(cfg, steps=100, seed=seed,
+                            mesh=mesh_for(devices))
+        return {"scenario": "event100k", **rep.summary(),
+                "devices": devices, "shard_overflow": rep.overflow}
     cfg = BroadcastConfig(n=100_000, fanout=4, profile=LAN,
                           delivery="aggregate")
     rep = run_broadcast(cfg, steps=100, seed=seed)
@@ -193,11 +214,23 @@ SCENARIOS: dict[str, Callable[..., dict]] = {
 }
 
 
-def run_scenario(name: str, seed: int = 0) -> dict:
+def run_scenario(name: str, seed: int = 0, devices: int = None) -> dict:
+    """Run a preset by name.  ``devices`` shards the node axis over the
+    first D mesh devices for the scenarios that support it (probe1k,
+    event100k); asking it of any other preset is an error, not a silent
+    single-chip run."""
+    import inspect
+
     try:
         fn = SCENARIOS[name]
     except KeyError:
         raise ValueError(
             f"unknown scenario {name!r}; choose from {sorted(SCENARIOS)}"
         ) from None
+    if devices:
+        if "devices" not in inspect.signature(fn).parameters:
+            raise ValueError(
+                f"scenario {name!r} does not support --devices"
+            )
+        return fn(seed=seed, devices=devices)
     return fn(seed=seed)

@@ -1,0 +1,393 @@
+"""Sharded multi-chip simulation plane (consul_tpu/parallel/shard.py).
+
+Runs on the virtual 8-device CPU mesh the session-wide conftest forces
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8`` is set before
+the FIRST jax import — XLA reads it at backend init, so it cannot be a
+per-test fixture), standing in for the v5e-8.
+
+Exactness contract under test, mirroring the sparse==dense K==n pin:
+  * D == 1 sharded scans are BIT-EQUAL to the unsharded scans for the
+    broadcast, dense-membership, and sparse-membership models.
+  * At D == 2 the outbox/all_to_all routing must deliver exactly what a
+    single chip would: ``overflow == 0`` at default budgets, and the
+    per-tick metric curves match D == 1 (the replicated-draw RNG
+    discipline makes them identical when nothing is dropped).
+  * The outbox pack/exchange path itself is property-tested against a
+    numpy brute-force router (random global targets, shard-crossing
+    duplicates, budget-overflow accounting).
+
+Tier-1 budget note: every scan config below is shared across its D1 /
+D2 / engine-wiring tests on purpose — identical (cfg, steps, track,
+mesh) tuples reuse one compiled program (Mesh hashes by value), so the
+module pays one XLA compile per distinct program, not per test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from consul_tpu.models.broadcast import BroadcastConfig, broadcast_init
+from consul_tpu.models.membership import (
+    MembershipConfig,
+    membership_init,
+)
+from consul_tpu.models.membership_sparse import (
+    SparseMembershipConfig,
+    sparse_membership_init,
+)
+from consul_tpu.parallel import make_mesh, mesh_for
+from consul_tpu.parallel.mesh import NODE_AXIS, block_size
+from consul_tpu.parallel.shard import (
+    exchange_outbox,
+    outbox_budget,
+    pack_outbox,
+    sharded_broadcast_scan,
+    sharded_membership_scan,
+    sharded_sparse_membership_scan,
+)
+
+# One config per model, shared by every test in this module (see the
+# budget note above).
+BCAST_CFG = BroadcastConfig(n=256, fanout=3, loss=0.2)
+BCAST_STEPS = 20
+DENSE_CFG = MembershipConfig(
+    n=64, loss=0.1, fail_at=((3, 4),), leave_at=((40, 6),)
+)
+# The drift-guard twin: exercises the round stages the main config
+# can't — join_at schedules (a joiner's unknown rows/cols + the
+# needs_join immediate push/pull) — since the sharded ticks mirror the
+# unsharded rounds line-for-line and only these pins catch divergence.
+DENSE_CFG_JOIN = MembershipConfig(
+    n=64, loss=0.1, fail_at=((3, 4),), join_at=((50, 6),)
+)
+DENSE_STEPS, DENSE_TRACK = 25, (3,)
+SPARSE_CFG = SparseMembershipConfig(
+    base=MembershipConfig(n=64, loss=0.05, fail_at=((5, 3),)),
+    k_slots=12,
+)
+# Anti-entropy off: the gossip-only tick (no pp exchange legs, no
+# initiator budget) must also match bit-for-bit.
+SPARSE_CFG_NOPP = SparseMembershipConfig(
+    base=MembershipConfig(n=64, loss=0.05, fail_at=((5, 3),),
+                          push_pull_enabled=False),
+    k_slots=12,
+)
+SPARSE_STEPS, SPARSE_TRACK = 20, (5,)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def forced_host_devices():
+    """The multi-device contract this module rides on: conftest.py set
+    XLA_FLAGS before the first JAX import, so ≥ 2 (virtual) devices
+    exist even in single-chip CPU containers."""
+    devs = jax.devices()
+    assert len(devs) >= 2, (
+        "test_shard needs ≥2 devices; set XLA_FLAGS="
+        "--xla_force_host_platform_device_count=8 before importing jax"
+    )
+    return devs
+
+
+def _mesh(d):
+    return make_mesh(jax.devices()[:d])
+
+
+# ---------------------------------------------------------------------------
+# Outbox pack/exchange vs a numpy brute-force router.
+# ---------------------------------------------------------------------------
+
+
+def _numpy_router(recv, val, ok, d_shards, blk, budget):
+    """Brute-force reference: per (src, dst) shard pair, remote-destined
+    messages land in stream order until the budget; the rest drop.
+    Returns (delivered lists per dst, dropped count)."""
+    inboxes = [[] for _ in range(d_shards)]
+    dropped = 0
+    counts = {}
+    for src in range(d_shards):
+        for i in range(recv.shape[1]):
+            if not ok[src, i]:
+                continue
+            dst = int(recv[src, i]) // blk
+            if dst == src:
+                continue  # local: never routed
+            c = counts.get((src, dst), 0)
+            if c < budget:
+                inboxes[dst].append((int(recv[src, i]), int(val[src, i])))
+                counts[(src, dst)] = c + 1
+            else:
+                dropped += 1
+    return inboxes, dropped
+
+
+class TestOutboxRouter:
+    # (d_shards, budget): a tight budget that forces overflow on a
+    # 2-mesh, and a roomy one on the widest routing (4-mesh).
+    @pytest.mark.parametrize("d_shards,budget", [(2, 3), (4, 64)])
+    def test_pack_exchange_matches_numpy(self, d_shards, budget):
+        n, a_len = 64, 120
+        blk = n // d_shards
+        mesh = _mesh(d_shards)
+
+        def body(recv, val, ok):
+            me = jax.lax.axis_index(NODE_AXIS)
+            r = recv.reshape(-1)
+            v = val.reshape(-1)
+            o = ok.reshape(-1)
+            dest = r // blk
+            remote = o & (dest != me)
+            packed, dropped = pack_outbox(
+                dest, remote, (r, v), d_shards, budget
+            )
+            ib_r, ib_v = exchange_outbox(packed)
+            return (
+                ib_r[None], ib_v[None],
+                jax.lax.psum(dropped, NODE_AXIS)[None],
+            )
+
+        from jax.experimental.shard_map import shard_map
+
+        run = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(NODE_AXIS, None),) * 3,
+            out_specs=(P(NODE_AXIS, None), P(NODE_AXIS, None),
+                       P(NODE_AXIS)),
+            check_rep=False,
+        ))
+
+        overflowed = False
+        for seed in range(3):  # same shapes: ONE compile, three datasets
+            rng = np.random.default_rng(seed)
+            # Random GLOBAL targets, duplicates included; ~70% valid.
+            recv = rng.integers(0, n, (d_shards, a_len)).astype(np.int32)
+            val = rng.integers(0, 1000, (d_shards, a_len)).astype(np.int32)
+            ok = rng.random((d_shards, a_len)) < 0.7
+            ib_r, ib_v, dropped = run(
+                jnp.asarray(recv), jnp.asarray(val), jnp.asarray(ok)
+            )
+            ib_r, ib_v = np.asarray(ib_r), np.asarray(ib_v)
+
+            ref_inboxes, ref_dropped = _numpy_router(
+                recv, val, ok, d_shards, blk, budget
+            )
+            assert int(np.asarray(dropped)[0]) == ref_dropped
+            overflowed |= ref_dropped > 0
+            for dst in range(d_shards):
+                got = sorted(
+                    (int(r), int(v))
+                    for r, v in zip(ib_r[dst], ib_v[dst]) if r >= 0
+                )
+                assert got == sorted(ref_inboxes[dst]), f"dst {dst}"
+                # Every routed message really belongs to dst's block.
+                for r, _ in got:
+                    assert r // blk == dst
+        if budget == 3:
+            assert overflowed, "tight budget must exercise the drop path"
+
+    def test_budget_formula(self):
+        # c x mean with a floor; degenerate single-shard mesh needs none.
+        assert outbox_budget(1000, 1) == 1
+        assert outbox_budget(8000, 8) == 2000       # 2 * 8000/8
+        assert outbox_budget(100, 8) == 64          # floor
+        assert outbox_budget(16, 8, floor=64) == 16  # never above stream
+
+
+# ---------------------------------------------------------------------------
+# D == 1 bit-equality pins (dense, sparse, broadcast).
+# ---------------------------------------------------------------------------
+
+
+def _assert_state_equal(a, b):
+    for fld in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, fld)), np.asarray(getattr(b, fld)),
+            err_msg=fld,
+        )
+
+
+class TestD1BitEquality:
+    @pytest.mark.parametrize("delivery", ["edges", "aggregate"])
+    def test_broadcast(self, delivery):
+        import dataclasses
+
+        from consul_tpu.sim.engine import broadcast_scan
+
+        cfg = dataclasses.replace(BCAST_CFG, delivery=delivery)
+        key = jax.random.PRNGKey(3)
+        f1, inf1 = broadcast_scan(
+            broadcast_init(cfg), key, cfg, BCAST_STEPS
+        )
+        f2, (inf2, ov) = sharded_broadcast_scan(
+            broadcast_init(cfg), key, cfg, BCAST_STEPS, _mesh(1)
+        )
+        np.testing.assert_array_equal(np.asarray(inf1), np.asarray(inf2))
+        _assert_state_equal(f1, f2)
+        assert int(ov) == 0
+
+    @pytest.mark.parametrize(
+        "cfg", [DENSE_CFG, DENSE_CFG_JOIN], ids=["leave", "join"]
+    )
+    def test_membership_dense(self, cfg):
+        from consul_tpu.sim.engine import membership_scan
+
+        key = jax.random.PRNGKey(9)
+        f1, o1 = membership_scan(
+            membership_init(cfg), key, cfg, DENSE_STEPS, DENSE_TRACK
+        )
+        f2, o2 = sharded_membership_scan(
+            membership_init(cfg), key, cfg, DENSE_STEPS,
+            _mesh(1), DENSE_TRACK,
+        )
+        for a, b in zip(o1, o2[:-1]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        _assert_state_equal(f1, f2)
+        assert int(o2[-1]) == 0  # no overflow path exists at D == 1
+
+    @pytest.mark.parametrize(
+        "cfg", [SPARSE_CFG, SPARSE_CFG_NOPP], ids=["pp", "nopp"]
+    )
+    def test_membership_sparse(self, cfg):
+        from consul_tpu.sim.engine import sparse_membership_scan
+
+        key = jax.random.PRNGKey(4)
+        f1, o1 = sparse_membership_scan(
+            sparse_membership_init(cfg), key, cfg,
+            SPARSE_STEPS, SPARSE_TRACK,
+        )
+        f2, o2 = sharded_sparse_membership_scan(
+            sparse_membership_init(cfg), key, cfg,
+            SPARSE_STEPS, _mesh(1), SPARSE_TRACK,
+        )
+        for a, b in zip(o1, o2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        _assert_state_equal(f1, f2)
+
+    def test_sparse_rejects_k_equals_n(self):
+        # K == n is the unsharded dense-parity mode; the sharded plane
+        # refuses it loudly instead of silently densifying.
+        cfg = SparseMembershipConfig(
+            base=MembershipConfig(n=16), k_slots=16
+        )
+        with pytest.raises(ValueError, match="k_slots < n"):
+            sharded_sparse_membership_scan(
+                sparse_membership_init(cfg), jax.random.PRNGKey(0),
+                cfg, 2, _mesh(1), ()
+            )
+
+
+# ---------------------------------------------------------------------------
+# D == 2: the collectives actually route, nothing drops, metrics match.
+# ---------------------------------------------------------------------------
+
+
+class TestD2:
+    def test_broadcast_edges_matches_d1_and_overflow0(self):
+        key = jax.random.PRNGKey(3)
+        _, (inf1, _) = sharded_broadcast_scan(
+            broadcast_init(BCAST_CFG), key, BCAST_CFG, BCAST_STEPS,
+            _mesh(1),
+        )
+        f2, (inf2, ov2) = sharded_broadcast_scan(
+            broadcast_init(BCAST_CFG), key, BCAST_CFG, BCAST_STEPS,
+            _mesh(2),
+        )
+        assert int(ov2) == 0, "default budget must not drop messages"
+        # With nothing dropped, the replicated-draw discipline makes the
+        # distributional metric exactly equal, not merely within
+        # tolerance.
+        np.testing.assert_array_equal(np.asarray(inf1), np.asarray(inf2))
+        assert int(np.asarray(inf2)[-1]) == BCAST_CFG.n
+        # The final state is genuinely block-sharded over the mesh.
+        assert not f2.knows.sharding.is_fully_replicated
+
+    def test_membership_dense_matches_d1(self):
+        key = jax.random.PRNGKey(9)
+        _, o1 = sharded_membership_scan(
+            membership_init(DENSE_CFG), key, DENSE_CFG, DENSE_STEPS,
+            _mesh(1), DENSE_TRACK,
+        )
+        _, o2 = sharded_membership_scan(
+            membership_init(DENSE_CFG), key, DENSE_CFG, DENSE_STEPS,
+            _mesh(2), DENSE_TRACK,
+        )
+        assert int(o2[-1]) == 0
+        for a, b in zip(o1[:-1], o2[:-1]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_membership_sparse_matches_d1(self):
+        key = jax.random.PRNGKey(4)
+        f1, o1 = sharded_sparse_membership_scan(
+            sparse_membership_init(SPARSE_CFG), key, SPARSE_CFG,
+            SPARSE_STEPS, _mesh(1), SPARSE_TRACK,
+        )
+        f2, o2 = sharded_sparse_membership_scan(
+            sparse_membership_init(SPARSE_CFG), key, SPARSE_CFG,
+            SPARSE_STEPS, _mesh(2), SPARSE_TRACK,
+        )
+        assert int(f2.overflow) == 0
+        for a, b in zip(o1, o2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        _assert_state_equal(f1, f2)
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring + retrace discipline.
+# ---------------------------------------------------------------------------
+
+
+class TestEngineWiring:
+    def test_run_broadcast_mesh_reports_overflow(self):
+        # Same (cfg, steps, mesh) as the D2 pin: the engine path must
+        # reuse the compiled program, and its report carries overflow.
+        from consul_tpu.sim.engine import run_broadcast
+
+        rep = run_broadcast(BCAST_CFG, steps=BCAST_STEPS, seed=3,
+                            mesh=_mesh(2), warmup=False)
+        assert rep.overflow == 0
+        assert int(rep.infected[-1]) == BCAST_CFG.n
+        # The legacy GSPMD path stays overflow-less and agrees.
+        rep2 = run_broadcast(BCAST_CFG, steps=BCAST_STEPS, seed=3,
+                             warmup=False)
+        assert rep2.overflow is None
+        np.testing.assert_array_equal(rep.infected, rep2.infected)
+
+    def test_run_membership_sparse_mesh(self):
+        from consul_tpu.sim.engine import run_membership_sparse
+
+        rep, ov = run_membership_sparse(
+            SPARSE_CFG, steps=SPARSE_STEPS, seed=4, track=SPARSE_TRACK,
+            warmup=False, mesh=_mesh(2),
+        )
+        assert ov == 0
+        assert rep.overflow is None  # sparse reports overflow separately
+        # The crash at tick 3 is eventually suspected by live observers.
+        assert int(np.asarray(rep.suspecting)[:, 0].max()) > 0
+
+    @pytest.mark.single_trace(
+        entrypoints=("sharded_broadcast_scan",), max_traces=2
+    )
+    def test_resharding_compiles_once_per_mesh(self, retrace_guard):
+        # One XLA program per distinct mesh, and re-running on a mesh
+        # already seen must NOT retrace — resharding is never a silent
+        # recompile treadmill (max_traces=2 covers D ∈ {1, 2}).
+        cfg = BroadcastConfig(n=128, fanout=3)
+        key = jax.random.PRNGKey(0)
+        for d in (1, 2, 1, 2):
+            sharded_broadcast_scan(
+                broadcast_init(cfg), key, cfg, 6, _mesh(d)
+            )
+        assert retrace_guard["sharded_broadcast_scan"].traces == 2
+
+
+class TestMeshHelpers:
+    def test_block_size_divisibility(self):
+        assert block_size(64, _mesh(2)) == 32
+        with pytest.raises(ValueError, match="divide"):
+            block_size(65, _mesh(2))
+
+    def test_mesh_for_bounds(self):
+        assert int(mesh_for(2).devices.size) == 2
+        with pytest.raises(ValueError):
+            mesh_for(len(jax.devices()) + 1)

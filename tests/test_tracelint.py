@@ -24,7 +24,10 @@ from consul_tpu.analysis import (
 )
 
 PKG_ROOT = pathlib.Path(consul_tpu.__file__).resolve().parent
-LINT_TREES = [PKG_ROOT / "models", PKG_ROOT / "sim", PKG_ROOT / "ops"]
+LINT_TREES = [
+    PKG_ROOT / "models", PKG_ROOT / "sim", PKG_ROOT / "ops",
+    PKG_ROOT / "parallel",
+]
 
 
 def rules_at(src: str, rule: str = None):
@@ -428,6 +431,21 @@ class TestRepoGate:
         ), "ops/sortmerge.py left the linted trees"
         assert lint_paths([target]) == []
 
+    def test_parallel_plane_is_covered_and_clean(self):
+        # The sharded multi-chip plane (shard_map rounds + outbox
+        # collectives) is traced code end to end; pin consul_tpu/
+        # parallel/ into the gate BY NAME so a tree reshuffle can't
+        # silently drop the newest traced subsystem from LINT_TREES.
+        target = PKG_ROOT / "parallel"
+        assert any(
+            target == tree or target.is_relative_to(tree)
+            for tree in LINT_TREES
+        ), "consul_tpu/parallel left the linted trees"
+        violations = lint_paths([target])
+        assert violations == [], "\n".join(
+            v.format() for v in violations
+        )
+
     def test_cli_lint_clean_exits_zero(self):
         from consul_tpu.cli import build_parser
 
@@ -550,6 +568,32 @@ class TestTraceGuard:
             run_lifeguard(lcfg, steps=8, seed=seed, warmup=False)
         for name in ("broadcast_scan", "swim_scan", "lifeguard_scan"):
             assert retrace_guard[name].traces <= 1
+
+    @pytest.mark.single_trace(
+        entrypoints=("sharded_broadcast_scan",), max_traces=2
+    )
+    def test_sharded_entrypoint_one_trace_per_mesh(self, retrace_guard):
+        # Resharding discipline: a distinct mesh is a distinct static
+        # signature (one program per D), but repeating a mesh already
+        # compiled must NOT retrace — D ∈ {1, 2} on four runs stays at
+        # exactly two programs.
+        from consul_tpu.models.broadcast import (
+            BroadcastConfig,
+            broadcast_init,
+        )
+        from consul_tpu.parallel import make_mesh
+        from consul_tpu.sim.engine import sharded_broadcast_scan
+
+        import jax
+
+        cfg = BroadcastConfig(n=64, fanout=3)
+        key = jax.random.PRNGKey(0)
+        for d in (1, 2, 1, 2):
+            mesh = make_mesh(jax.devices()[:d])
+            sharded_broadcast_scan(
+                broadcast_init(cfg), key, cfg, 4, mesh
+            )
+        assert retrace_guard["sharded_broadcast_scan"].traces == 2
 
     @pytest.mark.single_trace(entrypoints=("sparse_membership_scan",))
     def test_sparse_entrypoint_holds_single_trace(self, retrace_guard):
