@@ -24,6 +24,9 @@ The ``extra`` field carries the honest companions VERDICT r1 asked for:
                             false-positive suspicion rate and
                             incarnation-flap count with Lifeguard ON,
                             plus the _off twins and the reduction ratio
+  jaxlint_peak_bytes        estimated peak-HBM per big-config program
+                            (jaxlint J6, abstract eval only) — the
+                            memory axis alongside wall-clock
 
 vs_baseline: speedup over the real protocol's wall-clock rate — a real
 WAN-profile cluster advances one gossip round per GossipInterval
@@ -358,6 +361,25 @@ def main() -> None:
 
     multichip = section("multichip", _multichip, {})
 
+    # The memory axis of the perf trajectory: estimated peak-HBM per
+    # benchmarked program from jaxlint's J6 estimator (consul_tpu/
+    # analysis/jaxlint.py) over the big-config entrypoint registry.
+    # Abstract eval only — eval_shape states + make_jaxpr programs, no
+    # execution — so this costs seconds, not device time.  On a
+    # single-device process the registry's sharded entries register at
+    # D=1 (per-chip numbers still meaningful: blocks == whole arrays).
+    def _jaxlint():
+        try:
+            from consul_tpu.analysis.jaxlint import peak_bytes_report
+
+            return {"jaxlint_peak_bytes": peak_bytes_report(
+                include=("big",)
+            )}
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"jaxlint_error": str(e)[:200]}
+
+    jaxlint_peaks = section("jaxlint", _jaxlint, {})
+
     # Host-plane KV/HTTP throughput vs the reference's published numbers
     # (bench/results-0.7.1.md: 3,780 PUT/s, 9,774 stale GET/s).  Run in
     # a clean subprocess: the host plane never touches JAX, and this
@@ -417,6 +439,7 @@ def main() -> None:
                     **lifeguard,
                     **membership,
                     **multichip,
+                    **jaxlint_peaks,
                     **kv,
                 },
             }

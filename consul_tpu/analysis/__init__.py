@@ -1,21 +1,29 @@
 """Correctness tooling for the simulation plane.
 
-Two complementary halves keep the "whole study = one XLA program"
-invariant true as the codebase grows:
+Three complementary passes keep the "whole study = one XLA program"
+invariant (and its HBM budget) true as the codebase grows:
 
 * :mod:`consul_tpu.analysis.tracelint` — an AST-based static pass (8
-  rules) that catches trace-breaking code shapes before they run:
-  Python branches on traced values, host syncs in scan bodies, dtype
-  indiscipline, impurity under jit.  CLI: ``python -m consul_tpu.cli
-  lint`` (or ``python -m consul_tpu.analysis.tracelint``).
+  rules R1-R8) that catches trace-breaking code shapes before they
+  run: Python branches on traced values, host syncs in scan bodies,
+  dtype indiscipline, impurity under jit.  CLI: ``python -m
+  consul_tpu.cli lint`` (or ``python -m consul_tpu.analysis.
+  tracelint``).
+* :mod:`consul_tpu.analysis.jaxlint` — a jaxpr-level pass (rules
+  J1-J6) over the traced programs XLA actually receives: host
+  callbacks in scan bodies, x64 widening, undonated large buffers,
+  shard_map collective consistency, baked constants, and a peak-HBM
+  footprint estimate gated against a per-chip budget.  CLI:
+  ``python -m consul_tpu.cli jaxlint``.
 * :mod:`consul_tpu.analysis.guards` — runtime retrace counters for the
   jitted study entrypoints, surfaced to tests as
   ``@pytest.mark.single_trace``.
 
-Importable without JAX: linting stays accelerator-free (guards import
-JAX lazily, and only when asked to jit).  Re-exports resolve lazily so
-``python -m consul_tpu.analysis.tracelint`` runs without the package
-__init__ pre-importing the submodule (no runpy double-import warning).
+Importable without JAX: AST linting stays accelerator-free (guards and
+jaxlint import JAX lazily, and only when asked to trace).  Re-exports
+resolve lazily so ``python -m consul_tpu.analysis.tracelint`` runs
+without the package __init__ pre-importing the submodule (no runpy
+double-import warning).
 """
 
 import importlib
@@ -32,6 +40,14 @@ _EXPORTS = {
     "lint_file": "tracelint",
     "lint_paths": "tracelint",
     "lint_source": "tracelint",
+    "Finding": "jaxlint",
+    "JAXLINT_RULES": "jaxlint",
+    "PeakReport": "jaxlint",
+    "analyze_jaxpr": "jaxlint",
+    "eqn_count": "jaxlint",
+    "estimate_peak": "jaxlint",
+    "lint_programs": "jaxlint",
+    "peak_bytes_report": "jaxlint",
 }
 
 __all__ = sorted(_EXPORTS)

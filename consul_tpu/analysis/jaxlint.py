@@ -1,0 +1,898 @@
+"""jaxlint: jaxpr-level program analysis for the simulation plane.
+
+tracelint (the AST half of ``consul_tpu.analysis``) sees the code you
+*wrote*; this module sees the program XLA actually *receives*.  Each
+registered simulation entrypoint (``sim.engine.jaxlint_registry``:
+the dense/sparse/broadcast scans, their sharded twins at D ∈ {1, 2},
+and the lifeguard scan) is traced to a ``ClosedJaxpr`` with abstract
+inputs — ``jax.eval_shape`` for the state pytrees, ``jax.make_jaxpr``
+for the program, no device memory touched — and the equation graph is
+walked by a small rule engine.  Lifeguard (arXiv:1707.00788) argues
+for measuring the system you run rather than the one you think you
+wrote; the geo-replication budget literature (arXiv:2110.04448) wants
+budget violations caught before deployment.  Both arrive here as
+static checks over the traced program.
+
+Rules (``--list-rules`` prints this table):
+
+  J1  host-callback-in-scan   ``pure_callback``/``debug_callback``/
+                              ``io_callback`` inside a ``scan``/
+                              ``while`` body — a host round-trip per
+                              tick, serializing the whole study
+  J2  dtype-widening          a 64-bit aval (f64/i64/u64/c128) in a
+                              program whose inputs are all ≤ 32-bit —
+                              doubles HBM and halves TPU throughput
+  J3  undonated-large-buffer  a program input ≥ the size threshold
+                              (default 64 MiB) not covered by
+                              ``donate_argnums`` — the caller-held
+                              copy doubles the state's HBM footprint
+  J4  collective-consistency  collectives naming axes outside the
+                              enclosing ``shard_map`` mesh;
+                              ``all_to_all`` outbox dims not divisible
+                              by the axis size; device-varying values
+                              returned through a replicated out_spec
+                              without a reducing collective (the
+                              ``check_rep=False`` footgun)
+  J5  baked-constant          a constant ≥ the size threshold (default
+                              1 MiB) closed over into the jaxpr —
+                              closure-capture bloat that ships with
+                              every executable
+  J6  hbm-over-budget         estimated peak-HBM footprint (live-set
+                              sweep over a topological schedule, see
+                              :func:`estimate_peak`) exceeds the
+                              per-chip budget (``--budget-gb``,
+                              default 16 — one v5e chip)
+
+Findings cite entrypoint + equation provenance
+(``<program>: file:line J1 message``), mirroring ``cli lint``'s
+file:line/exit-code contract; ``cli jaxlint`` exits nonzero when any
+finding survives.
+
+The J6 estimator
+----------------
+
+``estimate_peak`` sweeps the equation list (jaxprs are topologically
+ordered) tracking the live-buffer set:
+
+* non-donated program inputs are caller-held — live for the whole
+  program; donated inputs die at their last use;
+* constants are executable-owned — live for the whole program;
+* an equation's candidate footprint is ``live + outputs + inner -
+  reuse``, where ``inner`` is the recursive transient of its
+  sub-jaxprs (scan/while/cond/pjit/shard_map) beyond their operands,
+  and ``reuse`` credits outputs written into buffers dying at that
+  equation (XLA input/output aliasing — exactly what donation buys);
+* scan/while carries are loop-internal in-place updates: body carry
+  inputs are treated as donated regardless of program-level donation
+  (XLA's while loop reuses the carry buffer), so program-level
+  donation is worth one copy of the state — the before/after delta
+  the J3 fix pins in tests.
+
+``shard_map`` bodies operate on per-device block shapes, so their
+recursive peak IS the per-chip estimate for the sharded entrypoints
+(replicated full-population draws included, matching the
+replicated-draw memory note in ``parallel/shard.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import sys
+from typing import Any, Iterable, Optional
+
+RULES: dict[str, str] = {
+    "J1": "host-callback-in-scan: pure_callback/debug_callback/io_callback "
+          "inside a scan/while body forces a host round-trip per tick",
+    "J2": "dtype-widening: a 64-bit aval in a program whose inputs are all "
+          "<= 32-bit (the simulation plane is f32/i32; x64 stays disabled)",
+    "J3": "undonated-large-buffer: a program input >= the threshold not in "
+          "donate_argnums keeps a caller-held copy live for the whole run",
+    "J4": "collective-consistency: axis names outside the shard_map mesh, "
+          "all_to_all dims not divisible by the axis size, or a "
+          "device-varying value under a replicated out_spec",
+    "J5": "baked-constant: a large constant closed over into the jaxpr "
+          "ships with every compiled executable (closure-capture bloat)",
+    "J6": "hbm-over-budget: estimated peak live-buffer footprint exceeds "
+          "the per-chip HBM budget",
+}
+
+# Package-level alias: consul_tpu.analysis re-exports this module's
+# rule table as JAXLINT_RULES (tracelint already owns the RULES name).
+JAXLINT_RULES = RULES
+
+J3_DEFAULT_BYTES = 64 << 20     # 64 MiB: the dense/sparse state planes
+J5_DEFAULT_BYTES = 1 << 20      # 1 MiB: anything larger belongs in args
+DEFAULT_BUDGET_GB = 16.0        # one v5e chip's HBM
+
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "debug_callback", "io_callback", "outside_call",
+})
+_LOOP_PRIMS = frozenset({"scan", "while"})
+# Collectives that REPLICATE their result over the named axis (legal
+# feeders of a replicated out_spec); all_to_all/ppermute stay
+# device-varying.
+_REPLICATING_PRIMS = frozenset({"psum", "pmax", "pmin", "all_gather"})
+_COLLECTIVE_PRIMS = _REPLICATING_PRIMS | frozenset({
+    "all_to_all", "ppermute", "pshuffle", "reduce_scatter", "axis_index",
+})
+_64BIT_NAMES = frozenset({"float64", "int64", "uint64", "complex128"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    program: str
+    rule: str
+    message: str
+    where: str = ""
+
+    def format(self) -> str:
+        where = self.where or "<program>"
+        return f"{self.program}: {where} {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class PeakReport:
+    """J6 output for one program: estimated peak live bytes and the
+    equation where the peak occurs; ``per_chip_bytes`` is the deepest
+    ``shard_map`` body's peak (block shapes = per-device footprint),
+    None for unsharded programs (whole program on one chip)."""
+
+    total_bytes: int
+    at: str = ""
+    per_chip_bytes: Optional[int] = None
+    per_chip_at: str = ""
+
+    @property
+    def chip_bytes(self) -> int:
+        """The number the per-chip budget compares against."""
+        return (self.per_chip_bytes
+                if self.per_chip_bytes is not None else self.total_bytes)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def format_bytes(n: int) -> str:
+    if n < 1024:
+        return f"{n} B"
+    for unit, shift in (("KiB", 10), ("MiB", 20), ("GiB", 30)):
+        if n < 1 << (shift + 10) or unit == "GiB":
+            return f"{n / (1 << shift):.2f} {unit}"
+    return f"{n} B"  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# jaxpr plumbing (no JAX import needed until analyze-time)
+# ---------------------------------------------------------------------------
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        item = int(dtype.itemsize)
+    except Exception:  # exotic extended dtype without itemsize
+        item = 8
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * item
+
+
+def _is_var(v) -> bool:
+    return hasattr(v, "aval") and not hasattr(v, "val")  # Var, not Literal
+
+
+def _src(eqn) -> str:
+    """``file:line`` provenance of an equation, '' when untracked."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return ""
+        return f"{frame.file_name}:{frame.start_line}"
+    except Exception:
+        return ""
+
+
+def _sub_jaxprs(eqn) -> list[tuple[str, Any, tuple]]:
+    """(param_name, raw Jaxpr, consts) for every sub-jaxpr of ``eqn``."""
+    out = []
+    for name, v in eqn.params.items():
+        if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # Closed
+            out.append((name, v.jaxpr, tuple(v.consts)))
+        elif hasattr(v, "eqns"):  # raw Jaxpr (shard_map)
+            out.append((name, v, ()))
+        elif isinstance(v, (tuple, list)):
+            for i, b in enumerate(v):
+                if hasattr(b, "jaxpr") and hasattr(b.jaxpr, "eqns"):
+                    out.append((f"{name}[{i}]", b.jaxpr, tuple(b.consts)))
+    return out
+
+
+def _axis_names(params: dict) -> tuple[str, ...]:
+    """Mesh-axis names a collective references (strings only — integer
+    'axes' entries are positional dims, not axis names)."""
+    names = []
+    for key in ("axis_name", "axes"):
+        v = params.get(key)
+        if v is None:
+            continue
+        for name in v if isinstance(v, (tuple, list)) else (v,):
+            if isinstance(name, str):
+                names.append(name)
+    return tuple(names)
+
+
+def eqn_count(closed_jaxpr) -> int:
+    """Total equations including every sub-jaxpr — the golden
+    program-size metric the bloat pins ride on."""
+
+    def count(jaxpr) -> int:
+        total = 0
+        for eqn in jaxpr.eqns:
+            total += 1
+            for _, sub, _ in _sub_jaxprs(eqn):
+                total += count(sub)
+        return total
+
+    return count(closed_jaxpr.jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# J6: peak-HBM estimator
+# ---------------------------------------------------------------------------
+
+
+def _last_uses(jaxpr) -> dict:
+    last: dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if _is_var(v):
+                last[v] = i
+    for v in jaxpr.outvars:
+        if _is_var(v):
+            last[v] = math.inf
+    return last
+
+
+class _PeakState:
+    """Carries the per-shard_map peaks found during one estimate."""
+
+    def __init__(self):
+        self.shard_peaks: list[tuple[int, str]] = []
+
+
+def _estimate(jaxpr, donated, ps: _PeakState,
+              ignore_donation: bool) -> tuple[int, str]:
+    last = _last_uses(jaxpr)
+    live: dict = {}
+    for v, d in zip(jaxpr.invars, donated):
+        if not d:
+            last[v] = math.inf  # caller-held: never freed mid-program
+        live[v] = _aval_bytes(v.aval)
+    for v in jaxpr.constvars:
+        last[v] = math.inf  # executable-owned (the consts' buffers)
+        live[v] = _aval_bytes(v.aval)
+    live_total = sum(live.values())
+    peak, at = live_total, "<inputs>"
+    for i, eqn in enumerate(jaxpr.eqns):
+        out_b = sum(_aval_bytes(o.aval) for o in eqn.outvars)
+        dying = [
+            v for v in {iv for iv in eqn.invars if _is_var(iv)}
+            if last.get(v) == i and v in live
+        ]
+        dying_b = sum(live[v] for v in dying)
+        # Boundary cost: outer live + outputs, crediting outputs
+        # written into buffers that die here (XLA aliasing/donation).
+        cand = live_total + out_b - min(dying_b, out_b)
+        # Working-set cost of sub-jaxprs: outer live minus the operands
+        # the inner accounting already covers, plus the inner peak.
+        for covered, inner_peak in _inner_peaks(
+            eqn, i, last, live, ps, ignore_donation
+        ):
+            cand = max(cand, live_total - covered + inner_peak)
+        if cand > peak:
+            peak, at = cand, (_src(eqn) or eqn.primitive.name)
+        live_total += out_b
+        for v in dying:
+            live_total -= live.pop(v)
+        for o in eqn.outvars:
+            if last.get(o) is None:  # unused output: freed immediately
+                live_total -= _aval_bytes(o.aval)
+            else:
+                live[o] = _aval_bytes(o.aval)
+    return peak, at
+
+
+def _dying_mask(eqn, i, last) -> list[bool]:
+    return [
+        _is_var(v) and last.get(v) == i for v in eqn.invars
+    ]
+
+
+def _inner_peaks(eqn, i, last, live: dict, ps: _PeakState,
+                 ignore_donation: bool) -> list[tuple[int, int]]:
+    """(covered_outer_bytes, inner_peak_bytes) per sub-jaxpr of a
+    higher-order equation.
+
+    ``inner_peak`` is the sub-program's own live-set maximum;
+    ``covered`` is the portion of the *outer* live set its accounting
+    already includes — operands the inner frame aliases rather than
+    copies.  Call-like boundaries (pjit, shard_map, cond branches,
+    loop consts) read the caller's buffer in place; a loop CARRY is
+    writable, so a non-dying (caller-held, undonated) init must be
+    copied and both buffers exist — exactly the copy donation
+    eliminates.  Operands whose inner aval differs (a scan's xs enter
+    as per-iteration slices) stay charged to the outer frame.
+
+    ``ignore_donation`` neutralizes ``donated_invars`` masks only —
+    the *structural* aliasing XLA performs regardless of donation
+    (loop carries update in place; dead temporaries are reused) stays
+    on, so the before/after delta isolates exactly what
+    ``donate_argnums`` buys."""
+    prim = eqn.primitive.name
+    dying = _dying_mask(eqn, i, last)
+    subs = _sub_jaxprs(eqn)
+    if not subs:
+        return []
+
+    def donation_mask(name: str, sub) -> tuple[int, list[bool], list[bool]]:
+        """(offset of sub invars into eqn.invars, donated mask,
+        copies-unless-dying mask) for one sub-jaxpr."""
+        n_in = len(sub.invars)
+        no_copy = [False] * n_in
+        if prim == "pjit":
+            donated = (eqn.params.get("donated_invars")
+                       or [False] * len(eqn.invars))
+            mask = [
+                ((bool(d) and not ignore_donation) or dy)
+                for d, dy in zip(donated, dying)
+            ][:n_in]
+            return 0, mask + [False] * (n_in - len(mask)), no_copy
+        if prim == "scan":
+            nc = eqn.params.get("num_consts", 0)
+            # consts alias outer buffers; carry + x-slices are
+            # loop-internal (XLA while-loop in-place): donated always.
+            copies = [False] * nc + [True] * (n_in - nc)
+            return 0, list(dying[:nc]) + [True] * (n_in - nc), copies
+        if prim == "while":
+            cn = eqn.params.get("cond_nconsts", 0)
+            bn = eqn.params.get("body_nconsts", 0)
+            if name == "body_jaxpr":
+                copies = [False] * bn + [True] * (n_in - bn)
+                return (cn, list(dying[cn:cn + bn]) + [True] * (n_in - bn),
+                        copies)
+            return 0, list(dying[:cn]) + [True] * (n_in - cn), no_copy
+        if prim in ("cond", "switch"):
+            mask = list(dying[1:1 + n_in])
+            return 1, mask + [False] * (n_in - len(mask)), no_copy
+        # shard_map + generic call-like primitives: positional.
+        mask = list(dying[:n_in])
+        return 0, mask + [False] * (n_in - len(mask)), no_copy
+
+    out = []
+    for name, sub, _ in subs:
+        offset, mask, copies = donation_mask(name, sub)
+        p, a = _estimate(sub, mask, ps, ignore_donation)
+        if prim == "shard_map":
+            ps.shard_peaks.append((p, a))
+        covered, seen = 0, set()
+        for j, (outer_v, inner_v) in enumerate(
+            zip(eqn.invars[offset:], sub.invars)
+        ):
+            if (_is_var(outer_v) and outer_v in live
+                    and outer_v not in seen
+                    and (not copies[j] or last.get(outer_v) == i)
+                    and _aval_bytes(outer_v.aval)
+                    == _aval_bytes(inner_v.aval)):
+                covered += live[outer_v]
+                seen.add(outer_v)
+        out.append((covered, p))
+    return out
+
+
+def _top_level_donated(jaxpr) -> list[bool]:
+    """Donation inherited by the trace wrapper's inputs: an input is
+    effectively donated iff every use hands it to a pjit that donates
+    it — i.e. what the jitted entrypoint's donate_argnums say about
+    the buffer XLA actually receives."""
+    uses: dict = {}
+    for eqn in jaxpr.eqns:
+        for j, v in enumerate(eqn.invars):
+            if _is_var(v):
+                uses.setdefault(v, []).append((eqn, j))
+    def donates(e, j) -> bool:
+        d = e.params.get("donated_invars")
+        return (e.primitive.name == "pjit" and d is not None
+                and j < len(d) and bool(d[j]))
+
+    out = []
+    for v in jaxpr.invars:
+        vs = uses.get(v, [])
+        out.append(bool(vs) and all(donates(e, j) for e, j in vs))
+    return out
+
+
+def estimate_peak(closed_jaxpr, *,
+                  ignore_donation: bool = False) -> PeakReport:
+    """Estimated peak-HBM footprint of a traced program (see module
+    docstring for the cost model).  ``ignore_donation=True`` prices the
+    same program with every ``donate_argnums`` stripped — the *before*
+    number of the J3 donation fix."""
+    ps = _PeakState()
+    donated = (
+        [False] * len(closed_jaxpr.jaxpr.invars) if ignore_donation
+        else _top_level_donated(closed_jaxpr.jaxpr)
+    )
+    peak, at = _estimate(closed_jaxpr.jaxpr, donated, ps, ignore_donation)
+    if ps.shard_peaks:
+        chip, chip_at = max(ps.shard_peaks)
+        return PeakReport(total_bytes=peak, at=at,
+                          per_chip_bytes=chip, per_chip_at=chip_at)
+    return PeakReport(total_bytes=peak, at=at)
+
+
+# ---------------------------------------------------------------------------
+# J4: replication-taint analysis (the check_rep=False footgun)
+# ---------------------------------------------------------------------------
+
+
+def _device_varying_outputs(jaxpr, in_tainted: list[bool]) -> list[bool]:
+    """Which outputs of a shard_map body are device-varying: taint flows
+    from sharded inputs and ``axis_index``; replicating collectives
+    (psum/pmax/pmin/all_gather) clean their result; everything else
+    propagates.  Loop carries iterate to a fixpoint."""
+    taint: dict = dict(zip(jaxpr.invars, in_tainted))
+
+    def is_t(v) -> bool:
+        return _is_var(v) and taint.get(v, False)
+
+    def sub_out_taint(eqn) -> Optional[list[bool]]:
+        prim = eqn.primitive.name
+        subs = _sub_jaxprs(eqn)
+        if not subs:
+            return None
+        in_t = [is_t(v) for v in eqn.invars]
+        if prim == "scan":
+            sub = subs[0][1]
+            cur = list(in_t[:len(sub.invars)])
+            cur += [False] * (len(sub.invars) - len(cur))
+            nc = eqn.params.get("num_carry", 0)
+            ncon = eqn.params.get("num_consts", 0)
+            for _ in range(len(sub.invars) + 1):  # carry fixpoint
+                out_t = _device_varying_outputs(sub, cur)
+                nxt = list(cur)
+                for k in range(nc):
+                    nxt[ncon + k] = cur[ncon + k] or out_t[k]
+                if nxt == cur:
+                    break
+                cur = nxt
+            return out_t
+        if prim == "while":
+            cn = eqn.params.get("cond_nconsts", 0)
+            body = next(s for n, s, _ in subs if n == "body_jaxpr")
+            cur = list(in_t[cn:cn + len(body.invars)])
+            cur += [False] * (len(body.invars) - len(cur))
+            bn = eqn.params.get("body_nconsts", 0)
+            for _ in range(len(body.invars) + 1):
+                out_t = _device_varying_outputs(body, cur)
+                nxt = list(cur)
+                for k, t in enumerate(out_t):
+                    nxt[bn + k] = cur[bn + k] or t
+                if nxt == cur:
+                    break
+                cur = nxt
+            return out_t
+        if prim in ("cond", "switch"):
+            op_t = in_t[1:]
+            merged: Optional[list[bool]] = None
+            for _, sub, _ in subs:
+                cur = list(op_t[:len(sub.invars)])
+                cur += [False] * (len(sub.invars) - len(cur))
+                out_t = _device_varying_outputs(sub, cur)
+                merged = (out_t if merged is None else
+                          [a or b for a, b in zip(merged, out_t)])
+            return merged
+        # pjit and generic calls: positional passthrough.
+        sub = subs[0][1]
+        cur = list(in_t[:len(sub.invars)])
+        cur += [False] * (len(sub.invars) - len(cur))
+        return _device_varying_outputs(sub, cur)
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "axis_index":
+            out_t_all = [True] * len(eqn.outvars)
+        elif prim in _REPLICATING_PRIMS:
+            out_t_all = [False] * len(eqn.outvars)
+        else:
+            sub_t = sub_out_taint(eqn)
+            if sub_t is not None:
+                out_t_all = list(sub_t[:len(eqn.outvars)])
+                out_t_all += [any(sub_t)] * (
+                    len(eqn.outvars) - len(out_t_all)
+                )
+            else:
+                t = any(is_t(v) for v in eqn.invars)
+                out_t_all = [t] * len(eqn.outvars)
+        for o, t in zip(eqn.outvars, out_t_all):
+            if _is_var(o):
+                taint[o] = t
+    return [is_t(v) for v in jaxpr.outvars]
+
+
+# ---------------------------------------------------------------------------
+# The rule walk
+# ---------------------------------------------------------------------------
+
+
+class _Analyzer:
+    def __init__(self, program: str, rules: frozenset[str],
+                 j3_bytes: int, j5_bytes: int):
+        self.program = program
+        self.rules = rules
+        self.j3_bytes = j3_bytes
+        self.j5_bytes = j5_bytes
+        self.findings: list[Finding] = []
+        self.starts_x32 = True
+
+    def report(self, eqn, rule: str, message: str) -> None:
+        if rule not in self.rules:
+            return
+        self.findings.append(
+            Finding(self.program, rule, message,
+                    where=_src(eqn) if eqn is not None else "")
+        )
+
+    def run(self, closed_jaxpr) -> list[Finding]:
+        jaxpr = closed_jaxpr.jaxpr
+        self.starts_x32 = all(
+            str(getattr(v.aval, "dtype", "")) not in _64BIT_NAMES
+            for v in jaxpr.invars
+        )
+        self._check_consts(None, tuple(closed_jaxpr.consts), "<closure>")
+        self._walk(jaxpr, loop_depth=0, axis_sizes={}, at_top=True)
+        return self.findings
+
+    # -- J5 ---------------------------------------------------------------
+
+    def _check_consts(self, eqn, consts: tuple, where: str) -> None:
+        for c in consts:
+            nbytes = getattr(c, "nbytes", 0)
+            if nbytes >= self.j5_bytes:
+                shape = getattr(c, "shape", ())
+                dtype = getattr(c, "dtype", "?")
+                self.report(
+                    eqn, "J5",
+                    f"constant {dtype}{list(shape)} "
+                    f"({format_bytes(nbytes)}) baked into the {where} "
+                    "scope — pass it as an argument (or compute it with "
+                    "jnp ops) instead of closing over a host array",
+                )
+
+    # -- the recursive walk ----------------------------------------------
+
+    def _walk(self, jaxpr, loop_depth: int, axis_sizes: dict,
+              at_top: bool = False) -> None:
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            # J1: host callbacks under a scan/while body.
+            if prim in _CALLBACK_PRIMS and loop_depth > 0:
+                self.report(
+                    eqn, "J1",
+                    f"{prim} inside a scan/while body — one host "
+                    "round-trip per tick serializes the study (return "
+                    "the value from the scan instead)",
+                )
+            # J2: widening in an x32 program.
+            if self.starts_x32:
+                for o in eqn.outvars:
+                    name = str(getattr(o.aval, "dtype", ""))
+                    if name in _64BIT_NAMES:
+                        self.report(
+                            eqn, "J2",
+                            f"{prim} produces {name} in a program whose "
+                            "inputs are all <= 32-bit — silent x64 "
+                            "widening (check jax_enable_x64 and Python "
+                            "float/int promotion)",
+                        )
+                        break
+            # J3: undonated large inputs at the ENTRYPOINT jit boundary
+            # (nested library pjits — jnp.where, take_along_axis — are
+            # inlined by XLA; donation only exists at the top call).
+            if prim == "pjit" and at_top:
+                donated = eqn.params.get("donated_invars")
+                if donated is not None:
+                    for v, d in zip(eqn.invars, donated):
+                        nbytes = _aval_bytes(getattr(v, "aval", None))
+                        if not d and nbytes >= self.j3_bytes:
+                            self.report(
+                                eqn, "J3",
+                                f"input {v.aval} ({format_bytes(nbytes)}) "
+                                f"of jitted {eqn.params.get('name', '?')} "
+                                "is not donated — donate_argnums would "
+                                "let XLA reuse the buffer for the output "
+                                "state",
+                            )
+            # J4: collective consistency.
+            if prim in _COLLECTIVE_PRIMS:
+                self._check_collective(eqn, prim, axis_sizes)
+            if prim == "shard_map":
+                self._check_shard_map(eqn)
+            # Recurse.
+            sub_axis = dict(axis_sizes)
+            if prim == "shard_map":
+                mesh = eqn.params.get("mesh")
+                if mesh is not None:
+                    sub_axis.update(dict(getattr(mesh, "shape", {})))
+            sub_depth = loop_depth + (1 if prim in _LOOP_PRIMS else 0)
+            for _, sub, consts in _sub_jaxprs(eqn):
+                self._check_consts(eqn, consts, _src(eqn) or prim)
+                self._walk(sub, sub_depth, sub_axis)
+
+    def _check_collective(self, eqn, prim: str, axis_sizes: dict) -> None:
+        names = _axis_names(eqn.params)
+        for name in names:
+            if name not in axis_sizes:
+                self.report(
+                    eqn, "J4",
+                    f"{prim} over axis {name!r} which is not an axis of "
+                    "the enclosing shard_map mesh "
+                    f"({sorted(axis_sizes) or 'none'})",
+                )
+        if prim == "all_to_all" and names:
+            size = axis_sizes.get(names[0])
+            if size:
+                for key in ("split_axis", "concat_axis"):
+                    dim = eqn.params.get(key)
+                    if dim is None or not eqn.invars:
+                        continue
+                    shape = getattr(eqn.invars[0].aval, "shape", ())
+                    if dim < len(shape) and shape[dim] % size != 0:
+                        self.report(
+                            eqn, "J4",
+                            f"all_to_all {key}={dim} on {eqn.invars[0].aval}"
+                            f" is not divisible by axis {names[0]!r} size "
+                            f"{size} — the outbox plane must split evenly "
+                            "across the mesh",
+                        )
+
+    def _check_shard_map(self, eqn) -> None:
+        body = eqn.params.get("jaxpr")
+        out_names = eqn.params.get("out_names")
+        in_names = eqn.params.get("in_names")
+        if body is None or out_names is None or in_names is None:
+            return
+        in_tainted = [bool(names) for names in in_names]
+        in_tainted += [False] * (len(body.invars) - len(in_tainted))
+        try:
+            out_t = _device_varying_outputs(body, in_tainted)
+        except Exception:  # pragma: no cover - analysis must not crash
+            return
+        for k, (names, tainted) in enumerate(zip(out_names, out_t)):
+            if not names and tainted:
+                aval = getattr(eqn.outvars[k], "aval", "?")
+                self.report(
+                    eqn, "J4",
+                    f"shard_map output {k} ({aval}) has a replicated "
+                    "out_spec but derives from device-varying data with "
+                    "no reducing collective — with check_rep=False this "
+                    "silently returns device 0's copy (psum/pmax/"
+                    "all_gather it, or shard the out_spec)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def analyze_jaxpr(program: str, closed_jaxpr,
+                  rules: Optional[Iterable[str]] = None,
+                  budget_bytes: Optional[int] = None,
+                  j3_bytes: int = J3_DEFAULT_BYTES,
+                  j5_bytes: int = J5_DEFAULT_BYTES,
+                  ) -> tuple[list[Finding], PeakReport]:
+    """Run the rule engine over one traced program.  Returns (findings,
+    peak report); J6 fires when ``budget_bytes`` is given and the
+    per-chip estimate exceeds it."""
+    active = frozenset(rules) if rules is not None else frozenset(RULES)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {sorted(unknown)}; known: {sorted(RULES)}"
+        )
+    analyzer = _Analyzer(program, active, j3_bytes, j5_bytes)
+    findings = analyzer.run(closed_jaxpr)
+    peak = estimate_peak(closed_jaxpr)
+    if ("J6" in active and budget_bytes is not None
+            and peak.chip_bytes > budget_bytes):
+        findings.append(Finding(
+            program, "J6",
+            f"estimated peak HBM {format_bytes(peak.chip_bytes)} exceeds "
+            f"the per-chip budget {format_bytes(budget_bytes)} "
+            f"(peak at {peak.per_chip_at or peak.at})",
+        ))
+    return findings, peak
+
+
+def lint_programs(programs: dict,
+                  rules: Optional[Iterable[str]] = None,
+                  budget_gb: Optional[float] = DEFAULT_BUDGET_GB,
+                  j3_bytes: int = J3_DEFAULT_BYTES,
+                  j5_bytes: int = J5_DEFAULT_BYTES,
+                  ) -> tuple[list[Finding], dict[str, PeakReport]]:
+    """Trace and analyze a registry of :class:`~consul_tpu.sim.engine.
+    SimProgram` specs (or anything with ``.trace() -> ClosedJaxpr`` and
+    ``.budgeted``).  Returns all findings plus per-program peak
+    reports."""
+    budget_bytes = (
+        int(budget_gb * (1 << 30)) if budget_gb is not None else None
+    )
+    findings: list[Finding] = []
+    peaks: dict[str, PeakReport] = {}
+    for name, spec in programs.items():
+        traced = spec.trace()
+        per_program_budget = (
+            budget_bytes if getattr(spec, "budgeted", True) else None
+        )
+        found, peak = analyze_jaxpr(
+            name, traced, rules=rules, budget_bytes=per_program_budget,
+            j3_bytes=j3_bytes, j5_bytes=j5_bytes,
+        )
+        findings.extend(found)
+        peaks[name] = peak
+    return findings, peaks
+
+
+def peak_bytes_report(include=("big",)) -> dict[str, int]:
+    """name -> estimated peak bytes for the registered programs —
+    the cheap (abstract-eval only) memory axis bench.py records."""
+    from consul_tpu.sim.engine import jaxlint_registry
+
+    programs = jaxlint_registry(include=include)
+    return {
+        name: estimate_peak(spec.trace()).chip_bytes
+        for name, spec in programs.items()
+    }
+
+
+def _backend_initialized() -> bool:
+    """Whether JAX has already picked its backend (after which the
+    device-count forcing in :func:`main` can no longer take effect).
+    Merely having imported jax does NOT initialize the backend."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:  # pragma: no cover - conservative on old jax
+        return True
+
+
+def _load_fixture_programs(path: str) -> dict:
+    """Load ``JAXLINT_PROGRAMS`` from a Python file — the fixture hook
+    the CLI tests plant violations through."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_jaxlint_fixture", path)
+    if spec is None or spec.loader is None:
+        raise OSError(f"cannot import {path}")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    programs = getattr(module, "JAXLINT_PROGRAMS", None)
+    if not isinstance(programs, dict):
+        raise OSError(f"{path} defines no JAXLINT_PROGRAMS dict")
+    return programs
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="jaxpr-level program analysis for the simulation "
+                    "plane (traces the registered entrypoints "
+                    "abstractly; no device memory touched)",
+    )
+    parser.add_argument("--rules", default="",
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        dest="list_rules")
+    parser.add_argument("--budget-gb", type=float,
+                        default=DEFAULT_BUDGET_GB, dest="budget_gb",
+                        help="per-chip HBM budget for J6 (default: "
+                             f"{DEFAULT_BUDGET_GB}, one v5e chip)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--set", choices=("small", "big", "all"),
+                        default="all", dest="which",
+                        help="registry slice: canonical small-n, the "
+                             "1M-node configs, or both (default)")
+    parser.add_argument("--module", default="",
+                        help="lint JAXLINT_PROGRAMS from a Python file "
+                             "instead of the engine registry")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    # The sharded D=2 entries need >= 2 devices; force the 8-virtual-
+    # device CPU harness while the backend is still uninitialized
+    # (XLA reads these at first backend use, so an already-imported
+    # jax is fine; tracing is abstract — nothing executes).
+    import os
+
+    if not _backend_initialized():
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    try:
+        if args.module:
+            programs = _load_fixture_programs(args.module)
+        else:
+            from consul_tpu.sim.engine import jaxlint_registry
+
+            include = (("small", "big") if args.which == "all"
+                       else (args.which,))
+            programs = jaxlint_registry(include=include)
+            import jax
+
+            n_dev = len(jax.devices())
+            missing = [d for d in (1, 2) if d > n_dev]
+            if missing:
+                print(
+                    f"jaxlint: warning: only {n_dev} device(s) visible "
+                    f"— sharded D in {missing} registry entries were "
+                    "skipped (coverage loss; initialize with "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+                    " to lint them)", file=sys.stderr,
+                )
+        findings, peaks = lint_programs(
+            programs, rules=rules, budget_gb=args.budget_gb,
+        )
+    except (ValueError, OSError) as e:
+        print(f"jaxlint: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "programs": len(programs),
+            "peak_bytes": {n: p.chip_bytes for n, p in peaks.items()},
+        }))
+    else:
+        for f in findings:
+            print(f.format())
+        for name, p in sorted(peaks.items()):
+            chip = (" per-chip" if p.per_chip_bytes is not None else "")
+            print(f"jaxlint: {name}: peak{chip} "
+                  f"{format_bytes(p.chip_bytes)} (at {p.per_chip_at or p.at})",
+                  file=sys.stderr)
+    if findings:
+        print(f"jaxlint: {len(findings)} finding(s) in "
+              f"{len(programs)} program(s)", file=sys.stderr)
+        return 1
+    if args.format != "json":
+        print(f"jaxlint: clean ({len(programs)} program(s))",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

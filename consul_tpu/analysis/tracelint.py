@@ -39,7 +39,8 @@ Rules (``--list-rules`` prints this table):
                                 ``.item()``/``.tolist()``/
                                 ``np.asarray()`` on traced values
   R3  dtype-discipline          ``jnp.zeros``/``ones``/``full``/
-                                ``empty``/``arange``/``eye`` without an
+                                ``empty``/``arange``/``eye``/
+                                ``asarray``/``array`` without an
                                 explicit dtype, or any 64-bit dtype
                                 reference (``jnp.float64`` ...) —
                                 module-wide, traced or not
@@ -100,7 +101,10 @@ RULES: dict[str, str] = {
 
 # Array constructors that must pin a dtype, with the positional index at
 # which dtype may legally arrive (jnp.full((n,), NEVER, jnp.int32) is
-# fine: dtype is the third positional).
+# fine: dtype is the third positional).  jnp.asarray/jnp.array are the
+# R3 gap PR 5 closed: without an explicit dtype they inherit whatever
+# the operand (often a Python list or np array) promotes to — int64/
+# float64 on an x64 host plane, weak types under jit.
 _CTOR_DTYPE_POS = {
     "jax.numpy.zeros": 1,
     "jax.numpy.ones": 1,
@@ -108,6 +112,8 @@ _CTOR_DTYPE_POS = {
     "jax.numpy.full": 2,
     "jax.numpy.eye": 3,
     "jax.numpy.arange": 3,
+    "jax.numpy.asarray": 1,
+    "jax.numpy.array": 1,
 }
 
 _WIDE_DTYPES = frozenset(
@@ -168,6 +174,9 @@ class Violation:
 
     def format(self) -> str:
         return f"{self.path}:{self.line}:{self.col} {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -985,6 +994,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                         help="comma-separated rule ids (default: all)")
     parser.add_argument("--list-rules", action="store_true",
                         dest="list_rules")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="json: one machine-readable object with "
+                             "every violation (CI / bench.py consumers)")
     args = parser.parse_args(argv)
     if args.list_rules:
         for rule, desc in sorted(RULES.items()):
@@ -1003,6 +1016,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     except (ValueError, OSError) as e:
         print(f"tracelint: {e}", file=sys.stderr)
         return 2
+    if args.format == "json":
+        import json
+
+        print(json.dumps({
+            "violations": [v.to_json() for v in violations],
+            "files": len(files),
+        }))
+        return 1 if violations else 0
     for v in violations:
         print(v.format())
     if violations:

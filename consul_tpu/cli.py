@@ -208,6 +208,35 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: all)")
     sp.add_argument("--list-rules", action="store_true",
                     dest="list_rules", help="enumerate rules and exit")
+    sp.add_argument("--format", choices=["text", "json"], default="text",
+                    dest="format",
+                    help="json: machine-readable violations object")
+
+    sp = sub.add_parser(
+        "jaxlint", help="jaxpr-level analysis of the registered "
+                        "simulation entrypoints (rules J1-J6 + the "
+                        "peak-HBM budget gate)"
+    )
+    sp.set_defaults(fn=cmd_jaxlint)
+    sp.add_argument("--rules", default="",
+                    help="comma-separated rule ids, e.g. J1,J6 "
+                         "(default: all)")
+    sp.add_argument("--list-rules", action="store_true",
+                    dest="list_rules", help="enumerate rules and exit")
+    sp.add_argument("--budget-gb", type=float, default=None,
+                    dest="budget_gb",
+                    help="per-chip HBM budget for J6 (default: 16, "
+                         "one v5e chip)")
+    sp.add_argument("--format", choices=["text", "json"], default="text",
+                    dest="format",
+                    help="json: machine-readable findings object")
+    sp.add_argument("--set", choices=["small", "big", "all"],
+                    default="all", dest="which",
+                    help="registry slice: small-n configs, the 1M-node "
+                         "configs, or both (default)")
+    sp.add_argument("--module", default="",
+                    help="lint JAXLINT_PROGRAMS from a Python file "
+                         "instead of the engine registry")
 
     # simulator -----------------------------------------------------------
     sp = sub.add_parser(
@@ -984,7 +1013,35 @@ async def cmd_lint(args) -> int:
         argv.append("--list-rules")
     if args.rules:
         argv.extend(["--rules", args.rules])
+    if getattr(args, "format", "text") != "text":
+        argv.extend(["--format", args.format])
     return tracelint_main(argv)
+
+
+async def cmd_jaxlint(args) -> int:
+    """jaxpr-level lint over the registered simulation entrypoints
+    (consul_tpu.analysis.jaxlint): traces each program abstractly —
+    eval_shape states, make_jaxpr programs, no device memory — and
+    exits nonzero on any J1-J6 finding, mirroring ``cli lint``'s
+    contract.  Needs JAX; jaxlint.main forces 8 virtual CPU devices
+    when the backend is uninitialized so the sharded D=2 entries lint
+    on single-device hosts."""
+    from consul_tpu.analysis.jaxlint import main as jaxlint_main
+
+    argv = []
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    if args.budget_gb is not None:
+        argv.extend(["--budget-gb", str(args.budget_gb)])
+    if args.format != "text":
+        argv.extend(["--format", args.format])
+    if args.which != "all":
+        argv.extend(["--set", args.which])
+    if args.module:
+        argv.extend(["--module", args.module])
+    return jaxlint_main(argv)
 
 
 async def cmd_sim(args) -> int:

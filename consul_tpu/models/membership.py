@@ -179,10 +179,22 @@ class MembershipState(NamedTuple):
 
 
 def _schedule_array(n: int, pairs: tuple, default: int) -> jnp.ndarray:
-    arr = [default] * n
+    # Built from jnp ops (not a host list) so that under a trace this
+    # stays IN the program as a broadcast + static-index updates rather
+    # than baking an int32[n] constant into the executable — at n = 1M
+    # that constant is ~4 MB of HBM per program (jaxlint J5).  Node ids
+    # are validated on the host: .at[].set silently drops out-of-bounds
+    # scatters, which would turn a typoed id into a fault that never
+    # fires.
+    arr = jnp.full((n,), default, jnp.int32)
     for node, tick in pairs:
-        arr[node] = tick
-    return jnp.asarray(arr, jnp.int32)
+        if not -n <= node < n:
+            raise IndexError(
+                f"schedule entry ({node}, {tick}) is out of bounds for "
+                f"n={n}"
+            )
+        arr = arr.at[node].set(jnp.int32(tick))
+    return arr
 
 
 def membership_init(cfg: MembershipConfig) -> MembershipState:

@@ -243,7 +243,8 @@ def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track")
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track"),
+    donate_argnums=(0,),
 )
 def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
                             mesh: Mesh, track: tuple = ()):
@@ -254,7 +255,11 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
     [n]-wide, so dense sharding shards STATE and the probe/suspicion
     planes — scale itself belongs to the sparse model).  Returns
     ``(final_state, (outs..., overflow))`` with the same per-tick
-    counters as the unsharded scan."""
+    counters as the unsharded scan.
+
+    ``state`` is donated (jaxlint J3, same contract as the unsharded
+    scan): callers pass a fresh state positionally and read only the
+    returned one."""
     from consul_tpu.models.membership import (
         NEVER,
         RANK_ALIVE,
@@ -658,7 +663,8 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track")
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track"),
+    donate_argnums=(0,),
 )
 def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                                    steps: int, mesh: Mesh,

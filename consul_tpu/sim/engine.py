@@ -8,9 +8,10 @@ only O(ticks) scalars back to the host.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
-from typing import Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -137,7 +138,8 @@ def lifeguard_scan(state, key: jax.Array, cfg, steps: int):
     return jax.lax.scan(tick, state, keys)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"))
+@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"),
+                   donate_argnums=(0,))
 def membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
                     track: tuple = ()):
     """Run ``steps`` ticks of the full-membership sim.
@@ -146,6 +148,12 @@ def membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
     SUSPECT / DEAD; plus the global count of suspect cells (the
     false-positive pressure gauge) and the mean membership-list size
     (join/leave convergence).
+
+    ``state`` is donated (jaxlint J3): the four [n, n] planes dominate
+    the dense model's footprint, and donating the initial carry lets
+    XLA write the final state into the same buffers — callers pass a
+    freshly built state positionally and never reuse it after the
+    call (the kw/positional jit-cache convention is unchanged).
     """
     track_idx = jnp.asarray(track, jnp.int32) if track else jnp.zeros(
         (0,), jnp.int32
@@ -327,7 +335,8 @@ def run_membership(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"))
+@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"),
+                   donate_argnums=(0,))
 def sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
                            track: tuple = ()):
     """Sparse-model twin of :func:`membership_scan`: per tracked subject
@@ -338,7 +347,12 @@ def sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
     (ops/sortmerge.py), which permutes slot columns as it allocates —
     every per-slot reduction here is deliberately position-free
     (subject-id matching), so the counters are invariant to the row
-    order the sorted-row invariant imposes."""
+    order the sorted-row invariant imposes.
+
+    ``state`` is donated (jaxlint J3): the five [n, K] slot planes are
+    ~1.3 GB at the 1M-node config, and donation lets XLA reuse them
+    for the output state — same caller contract as
+    :func:`membership_scan`."""
     from consul_tpu.models.membership_sparse import sparse_membership_round
     from consul_tpu.models.membership import RANK_SUSPECT as _SUS
     from consul_tpu.models.membership import RANK_DEAD as _DEAD
@@ -490,3 +504,199 @@ def run_swim(
         dead_known=np.asarray(dead),
         wall_s=wall,
     )
+
+
+# ---------------------------------------------------------------------------
+# jaxlint entrypoint registry: name -> traced-program spec.
+#
+# Every jitted study entrypoint above, at two canonical abstract
+# configurations: "small" (the shapes the unit tests pin) and "big"
+# (the 1M-node north-star configs bench.py runs).  The specs carry NO
+# device arrays — state pytrees come from jax.eval_shape over the
+# model inits, so registering/tracing the 1M configs allocates nothing
+# (consul_tpu/analysis/jaxlint.py walks the traced jaxprs).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SimProgram:
+    """One registered simulation program for jaxpr-level analysis.
+
+    ``build()`` returns ``(fn, args)`` where ``fn`` closes over the
+    static configuration and ``args`` are abstract
+    ``ShapeDtypeStruct`` pytrees; :meth:`trace` turns it into the
+    ``ClosedJaxpr`` the rule engine walks.  ``per_chip`` marks sharded
+    programs whose J6 footprint is read from the shard_map body
+    (block shapes = per-device bytes); ``x64`` traces under
+    ``jax.experimental.enable_x64`` (fixture escape hatch — the real
+    registry never sets it)."""
+
+    name: str
+    entrypoint: str
+    build: Callable[[], tuple[Callable, tuple]]
+    n: int
+    devices: int = 1
+    per_chip: bool = False
+    budgeted: bool = True
+    x64: bool = False
+    note: str = ""
+
+    def trace(self) -> Any:
+        fn, args = self.build()
+        if self.x64:
+            from jax.experimental import enable_x64
+
+            with enable_x64():
+                return jax.make_jaxpr(fn)(*args)
+        return jax.make_jaxpr(fn)(*args)
+
+
+def _abstract_key() -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+
+def jaxlint_registry(include=("small", "big"),
+                     sharded_devices=(1, 2)) -> dict[str, SimProgram]:
+    """The jaxlint registry: dense/sparse/broadcast scans, their
+    sharded twins at D in ``sharded_devices``, the lifeguard scan, and
+    the swim/multidc companions, at small-n and 1M-node configs.
+
+    Sharded entries needing more devices than the process exposes are
+    skipped (the test harness and ``cli jaxlint`` force 8 virtual CPU
+    devices; a bare single-device process still lints the unsharded
+    plane).  The dense membership entries register at n=16384 — the
+    [n, n] representation's practical per-chip ceiling; n >= 1e5 is
+    exactly the regime the sparse model exists for.
+    """
+    from consul_tpu.models.lifeguard import LifeguardConfig, lifeguard_init
+    from consul_tpu.models.membership_sparse import (
+        SparseMembershipConfig,
+        sparse_membership_init,
+    )
+    from consul_tpu.parallel import make_mesh
+    from consul_tpu.protocol import LAN, WAN
+
+    programs: dict[str, SimProgram] = {}
+
+    def add(name: str, entrypoint: str, init, scan_call, n: int,
+            devices: int = 1, **kw) -> None:
+        if devices > len(jax.devices()):
+            return
+
+        def build(init=init, scan_call=scan_call):
+            state = jax.eval_shape(init)
+            return scan_call, (state, _abstract_key())
+
+        programs[name] = SimProgram(
+            name=name, entrypoint=entrypoint, build=build, n=n,
+            devices=devices, **kw,
+        )
+
+    def add_sharded(tag: str, d: int, bcfg, bsteps, mcfg, msteps, mtrack,
+                    scfg, ssteps, strack) -> None:
+        if d > len(jax.devices()):
+            return
+        mesh = make_mesh(jax.devices()[:d])
+        add(f"sharded_broadcast@{tag}/D{d}", "sharded_broadcast_scan",
+            lambda: broadcast_init(bcfg),
+            lambda s, k: sharded_broadcast_scan(s, k, bcfg, bsteps, mesh),
+            bcfg.n, devices=d, per_chip=True)
+        add(f"sharded_membership@{tag}/D{d}", "sharded_membership_scan",
+            lambda: membership_init(mcfg),
+            lambda s, k: sharded_membership_scan(
+                s, k, mcfg, msteps, mesh, mtrack),
+            mcfg.n, devices=d, per_chip=True)
+        add(f"sharded_sparse@{tag}/D{d}", "sharded_sparse_membership_scan",
+            lambda: sparse_membership_init(scfg),
+            lambda s, k: sharded_sparse_membership_scan(
+                s, k, scfg, ssteps, mesh, strack),
+            scfg.base.n, devices=d, per_chip=True)
+
+    if "small" in include:
+        mcfg = MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),))
+        bcfg = BroadcastConfig(n=64, fanout=3, delivery="edges")
+        scfg = SparseMembershipConfig(base=mcfg, k_slots=8)
+        swcfg = SwimConfig(n=64, subject=1, loss=0.05)
+        lgcfg = LifeguardConfig(n=64, subject=1, subject_alive=True)
+        mdcfg = MultiDCConfig(n=64, segments=8)
+        add("broadcast@small", "broadcast_scan",
+            lambda: broadcast_init(bcfg),
+            lambda s, k: broadcast_scan(s, k, bcfg, 8), bcfg.n)
+        add("membership@small", "membership_scan",
+            lambda: membership_init(mcfg),
+            lambda s, k: membership_scan(s, k, mcfg, 8, (3,)), mcfg.n)
+        add("sparse@small", "sparse_membership_scan",
+            lambda: sparse_membership_init(scfg),
+            lambda s, k: sparse_membership_scan(s, k, scfg, 8, (3,)),
+            mcfg.n)
+        add("swim@small", "swim_scan",
+            lambda: swim_init(swcfg),
+            lambda s, k: swim_scan(s, k, swcfg, 8), swcfg.n)
+        add("lifeguard@small", "lifeguard_scan",
+            lambda: lifeguard_init(lgcfg),
+            lambda s, k: lifeguard_scan(s, k, lgcfg, 8), lgcfg.n)
+        add("multidc@small", "multidc_scan",
+            lambda: multidc_init(mdcfg),
+            lambda s, k: multidc_scan(s, k, mdcfg, 8), mdcfg.n)
+        for d in sharded_devices:
+            add_sharded("small", d, bcfg, 8, mcfg, 8, (3,),
+                        scfg, 8, (3,))
+    if "big" in include:
+        # The north-star shapes bench.py measures: 1M nodes for the
+        # per-node-plane models (dense membership capped at its 16k
+        # [n, n] per-chip ceiling), and the sharded twins at 1M nodes
+        # PER CHIP (n = 1M x D, edges delivery — the multichip bench
+        # config) at the largest registered mesh.
+        mcfg1m = MembershipConfig(n=16384, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),))
+        bcfg1m = BroadcastConfig(n=1_000_000, fanout=4, profile=LAN,
+                                 delivery="aggregate")
+        scfg1m = SparseMembershipConfig(
+            base=MembershipConfig(n=1_000_000, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),)),
+            k_slots=64,
+        )
+        swcfg1m = SwimConfig(n=1_000_000, subject=42, loss=0.30,
+                             profile=WAN, delivery="aggregate")
+        lgcfg1m = LifeguardConfig(n=1_000_000, subject=42,
+                                  subject_alive=True, ack_late=0.02,
+                                  profile=WAN)
+        add("broadcast@1m", "broadcast_scan",
+            lambda: broadcast_init(bcfg1m),
+            lambda s, k: broadcast_scan(s, k, bcfg1m, 60), bcfg1m.n)
+        add("membership@16k", "membership_scan",
+            lambda: membership_init(mcfg1m),
+            lambda s, k: membership_scan(s, k, mcfg1m, 30, (42,)),
+            mcfg1m.n,
+            note="dense [n, n] ceiling: n >= 1e5 belongs to the sparse "
+                 "model")
+        add("sparse@1m", "sparse_membership_scan",
+            lambda: sparse_membership_init(scfg1m),
+            lambda s, k: sparse_membership_scan(s, k, scfg1m, 3, (42,)),
+            scfg1m.base.n)
+        add("swim@1m", "swim_scan",
+            lambda: swim_init(swcfg1m),
+            lambda s, k: swim_scan(s, k, swcfg1m, 450), swcfg1m.n)
+        add("lifeguard@1m", "lifeguard_scan",
+            lambda: lifeguard_init(lgcfg1m),
+            lambda s, k: lifeguard_scan(s, k, lgcfg1m, 160), lgcfg1m.n)
+        d = max(
+            (d for d in sharded_devices if d <= len(jax.devices())),
+            default=0,
+        )
+        if d:
+            add_sharded(
+                "1m_per_chip", d,
+                BroadcastConfig(n=1_000_000 * d, fanout=4, profile=LAN,
+                                delivery="edges"),
+                30,
+                mcfg1m, 30, (42,),
+                SparseMembershipConfig(
+                    base=MembershipConfig(n=1_000_000 * d, loss=0.01,
+                                          profile=LAN,
+                                          fail_at=((42, 5),)),
+                    k_slots=64,
+                ),
+                3, (42,),
+            )
+    return programs

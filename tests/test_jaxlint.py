@@ -1,0 +1,511 @@
+"""jaxlint: jaxpr-level rules J1-J6 + the peak-HBM footprint gate.
+
+Per rule: a planted-violation program the rule must fire on and a
+clean twin it must stay silent on.  Then the gates the CI story rides
+on: every registered entrypoint (sharded D in {1, 2} included) lints
+clean at default thresholds, the 1M-node configs fit the per-chip
+HBM budget, and the J3-driven ``donate_argnums`` fix shows a
+peak-bytes reduction of at least one full state copy in the
+estimator's before/after numbers.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.analysis.guards import ENGINE_ENTRYPOINTS
+from consul_tpu.analysis.jaxlint import (
+    RULES,
+    analyze_jaxpr,
+    eqn_count,
+    estimate_peak,
+    format_bytes,
+    lint_programs,
+)
+from consul_tpu.sim.engine import SimProgram, jaxlint_registry
+
+SDS = jax.ShapeDtypeStruct
+F32 = jnp.float32
+I32 = jnp.int32
+BUDGET_16GB = 16 << 30
+
+
+def _program(name, fn, *args, x64=False):
+    return SimProgram(name=name, entrypoint=name,
+                      build=lambda: (fn, tuple(args)), n=0, x64=x64)
+
+
+def _rules(program, **kw):
+    findings, _ = analyze_jaxpr(
+        program.name, program.trace(), **kw
+    )
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# Registry fixtures: trace once per module, share across tests.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_programs():
+    return jaxlint_registry(include=("small",))
+
+
+@pytest.fixture(scope="module")
+def small_traces(small_programs):
+    return {n: p.trace() for n, p in small_programs.items()}
+
+
+@pytest.fixture(scope="module")
+def big_programs():
+    return jaxlint_registry(include=("big",))
+
+
+@pytest.fixture(scope="module")
+def big_traces(big_programs):
+    return {n: p.trace() for n, p in big_programs.items()}
+
+
+# ---------------------------------------------------------------------------
+# Rule fixtures: fire on the planted violation, silent on the twin.
+# ---------------------------------------------------------------------------
+
+
+class TestJ1HostCallbackInScan:
+    def test_fires_on_debug_print_in_scan(self):
+        def bad(c, xs):
+            def tick(carry, x):
+                jax.debug.print("tick {}", carry)
+                return carry + x, carry
+
+            return jax.lax.scan(tick, c, xs)
+
+        assert "J1" in _rules(_program("j1bad", bad, SDS((), F32),
+                                       SDS((4,), F32)))
+
+    def test_fires_on_pure_callback_in_scan(self):
+        def bad(c, xs):
+            def tick(carry, x):
+                y = jax.pure_callback(
+                    lambda v: v, jax.ShapeDtypeStruct((), np.float32),
+                    carry,
+                )
+                return carry + y, carry
+
+            return jax.lax.scan(tick, c, xs)
+
+        assert "J1" in _rules(_program("j1cb", bad, SDS((), F32),
+                                       SDS((4,), F32)))
+
+    def test_silent_on_plain_scan_and_toplevel_callback(self):
+        def clean(c, xs):
+            final, ys = jax.lax.scan(
+                lambda carry, x: (carry + x, carry), c, xs
+            )
+            # A host callback OUTSIDE the loop is one round-trip per
+            # study, not per tick — J1 leaves it alone.
+            jax.debug.print("done {}", final)
+            return final, ys
+
+        assert _rules(_program("j1clean", clean, SDS((), F32),
+                               SDS((4,), F32))) == []
+
+
+class TestJ2DtypeWidening:
+    def test_fires_on_f64_widening(self):
+        def bad(x):
+            return x.astype(jnp.float64) * 2.0
+
+        assert "J2" in _rules(_program("j2bad", bad, SDS((8,), F32),
+                                       x64=True))
+
+    def test_silent_when_x32(self):
+        def clean(x):
+            return x.astype(jnp.float32) * 2.0
+
+        assert _rules(_program("j2clean", clean, SDS((8,), I32))) == []
+
+    def test_silent_when_program_starts_x64(self):
+        # Inputs already 64-bit: deliberately an x64 program, not a
+        # silent widening — J2 stays quiet.
+        def passthrough(x):
+            return x + 1.0
+
+        assert "J2" not in _rules(
+            _program("j2x64in", passthrough,
+                     SDS((8,), jnp.float64), x64=True)
+        )
+
+
+class TestJ3UndonatedLargeBuffer:
+    BIG = SDS((32 << 20,), F32)  # 128 MiB, abstract — nothing allocated
+
+    def test_fires_on_undonated_large_input(self):
+        f = jax.jit(lambda x: x * 2.0)
+        assert "J3" in _rules(_program("j3bad", lambda x: f(x), self.BIG))
+
+    def test_silent_when_donated(self):
+        f = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+        assert _rules(_program("j3clean", lambda x: f(x), self.BIG)) == []
+
+    def test_silent_below_threshold(self):
+        f = jax.jit(lambda x: x * 2.0)
+        assert _rules(
+            _program("j3small", lambda x: f(x), SDS((1024,), F32))
+        ) == []
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+class TestJ4CollectiveConsistency:
+    def _mesh(self):
+        from consul_tpu.parallel import make_mesh
+
+        return make_mesh(jax.devices()[:2])
+
+    def test_fires_on_unreduced_replicated_output(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        # The check_rep=False footgun: a local sum returned through a
+        # replicated out_spec silently yields device 0's partial.
+        bad = shard_map(
+            lambda x: jnp.sum(x), mesh=self._mesh(),
+            in_specs=(P("nodes"),), out_specs=P(), check_rep=False,
+        )
+        assert "J4" in _rules(_program("j4bad", bad, SDS((16,), F32)))
+
+    def test_silent_when_psummed(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        clean = shard_map(
+            lambda x: jax.lax.psum(jnp.sum(x), "nodes"),
+            mesh=self._mesh(),
+            in_specs=(P("nodes"),), out_specs=P(), check_rep=False,
+        )
+        assert _rules(_program("j4clean", clean, SDS((16,), F32))) == []
+
+    def test_silent_on_sharded_output(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        # Device-varying data under a SHARDED out_spec is the normal
+        # case, not a violation.
+        clean = shard_map(
+            lambda x: x * 2.0, mesh=self._mesh(),
+            in_specs=(P("nodes"),), out_specs=P("nodes"),
+            check_rep=False,
+        )
+        assert _rules(_program("j4shard", clean, SDS((16,), F32))) == []
+
+
+class TestJ5BakedConstant:
+    def test_fires_on_closure_captured_host_array(self):
+        w = np.ones((1 << 19,), np.float32)  # 2 MiB > the 1 MiB default
+
+        def bad(x):
+            return x * w
+
+        assert "J5" in _rules(_program("j5bad", bad,
+                                       SDS((1 << 19,), F32)))
+
+    def test_silent_when_computed_in_program(self):
+        def clean(x):
+            return x * jnp.ones((1 << 19,), F32)
+
+        assert _rules(_program("j5clean", clean,
+                               SDS((1 << 19,), F32))) == []
+
+
+class TestJ6HbmBudget:
+    def _prog(self):
+        f = jax.jit(lambda x: x * 2.0)
+        return _program("j6", lambda x: f(x), SDS((1 << 20,), F32))
+
+    def test_fires_over_budget(self):
+        findings, peak = analyze_jaxpr(
+            "j6", self._prog().trace(), budget_bytes=1 << 20,
+        )
+        assert "J6" in [f.rule for f in findings]
+        assert peak.total_bytes > 1 << 20
+
+    def test_silent_under_budget(self):
+        findings, _ = analyze_jaxpr(
+            "j6", self._prog().trace(), budget_bytes=BUDGET_16GB,
+        )
+        assert findings == []
+
+    def test_every_rule_has_a_fixture(self):
+        # The classes above cover the whole table.
+        covered = {"J1", "J2", "J3", "J4", "J5", "J6"}
+        assert covered == set(RULES)
+
+
+# ---------------------------------------------------------------------------
+# The estimator.
+# ---------------------------------------------------------------------------
+
+
+class TestPeakEstimator:
+    N = 4096
+
+    def _scan_program(self, donate):
+        kw = {"donate_argnums": (0,)} if donate else {}
+        f = jax.jit(
+            lambda s, ks: jax.lax.scan(
+                lambda c, k: (c + 1.0, jnp.sum(c)), s, ks
+            ),
+            **kw,
+        )
+        return _program("scan", lambda s, ks: f(s, ks),
+                        SDS((self.N,), F32), SDS((8,), F32))
+
+    def test_donation_saves_exactly_one_state_copy(self):
+        donated = estimate_peak(self._scan_program(True).trace())
+        undonated = estimate_peak(self._scan_program(False).trace())
+        assert undonated.total_bytes - donated.total_bytes == self.N * 4
+
+    def test_ignore_donation_reproduces_undonated_peak(self):
+        tr = self._scan_program(True).trace()
+        before = estimate_peak(tr, ignore_donation=True)
+        undonated = estimate_peak(self._scan_program(False).trace())
+        assert before.total_bytes == undonated.total_bytes
+
+    def test_peak_at_least_inputs_plus_outputs(self):
+        tr = self._scan_program(False).trace()
+        # state in (N) + state out (N) + keys/ys noise.
+        assert estimate_peak(tr).total_bytes >= 2 * self.N * 4
+
+    def test_format_bytes(self):
+        assert format_bytes(512) == "512 B"
+        assert format_bytes(4 << 20) == "4.00 MiB"
+        assert format_bytes(16 << 30) == "16.00 GiB"
+
+
+# ---------------------------------------------------------------------------
+# The repo gates.
+# ---------------------------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_registry_covers_every_engine_entrypoint(self, small_programs):
+        covered = {p.entrypoint for p in small_programs.values()}
+        assert covered == set(ENGINE_ENTRYPOINTS)
+
+    def test_registry_covers_sharded_d1_and_d2(self, small_programs):
+        for d in (1, 2):
+            for model in ("broadcast", "membership", "sparse"):
+                assert f"sharded_{model}@small/D{d}" in small_programs
+
+    def test_small_registry_zero_findings(self, small_programs,
+                                          small_traces):
+        findings = []
+        for name, tr in small_traces.items():
+            found, _ = analyze_jaxpr(name, tr, budget_bytes=BUDGET_16GB)
+            findings += found
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_big_registry_zero_findings_within_budget(self, big_traces):
+        """The acceptance gate: every 1M-node config — dense ceiling,
+        sparse, and the sharded per-chip twins — lints clean INCLUDING
+        the 16 GB per-chip J6 budget."""
+        findings = []
+        for name, tr in big_traces.items():
+            found, _ = analyze_jaxpr(name, tr, budget_bytes=BUDGET_16GB)
+            findings += found
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_big_registry_reports_1m_peaks(self, big_traces):
+        for name in ("broadcast@1m", "sparse@1m", "swim@1m",
+                     "membership@16k"):
+            assert estimate_peak(big_traces[name]).total_bytes > 0
+        sharded = [n for n in big_traces if n.startswith("sharded_")]
+        assert sharded, "big registry lost its per-chip entries"
+        for name in sharded:
+            peak = estimate_peak(big_traces[name])
+            assert peak.per_chip_bytes is not None, name
+            assert 0 < peak.per_chip_bytes <= BUDGET_16GB, name
+
+    def test_lint_programs_end_to_end(self, small_programs):
+        findings, peaks = lint_programs(
+            small_programs, budget_gb=16.0,
+        )
+        assert findings == []
+        assert set(peaks) == set(small_programs)
+
+
+class TestDonationPins:
+    """The J3-driven donate_argnums fix, pinned via the estimator's
+    before/after peak-bytes delta (the satellite acceptance)."""
+
+    def test_dense_membership_donation_saves_a_state_copy(self,
+                                                          big_traces):
+        tr = big_traces["membership@16k"]
+        after = estimate_peak(tr).total_bytes
+        before = estimate_peak(tr, ignore_donation=True).total_bytes
+        # Four [n, n] int32 planes dominate the dense state.
+        n = 16384
+        assert before - after >= int(0.99 * 4 * n * n * 4)
+
+    def test_sparse_membership_donation_saves_a_state_copy(self,
+                                                           big_traces):
+        tr = big_traces["sparse@1m"]
+        after = estimate_peak(tr).total_bytes
+        before = estimate_peak(tr, ignore_donation=True).total_bytes
+        # Five [n, K] int32 slot planes dominate the sparse state.
+        assert before - after >= int(0.99 * 5 * 1_000_000 * 64 * 4)
+
+    def test_sharded_twins_donation_visible_per_chip(self, big_traces):
+        for name in big_traces:
+            if not (name.startswith("sharded_membership")
+                    or name.startswith("sharded_sparse")):
+                continue
+            after = estimate_peak(big_traces[name])
+            before = estimate_peak(big_traces[name],
+                                   ignore_donation=True)
+            assert before.per_chip_bytes > after.per_chip_bytes, name
+
+    def test_undonated_entrypoints_have_zero_delta(self, big_traces):
+        for name in ("swim@1m", "broadcast@1m", "lifeguard@1m"):
+            tr = big_traces[name]
+            assert (estimate_peak(tr, ignore_donation=True).total_bytes
+                    == estimate_peak(tr).total_bytes), name
+
+    def test_donation_is_wired_on_the_jitted_entrypoint(self,
+                                                        small_traces):
+        from consul_tpu.analysis.jaxlint import _top_level_donated
+
+        donated = _top_level_donated(small_traces["membership@small"].jaxpr)
+        # 9 MembershipState leaves donated, the PRNG key not.
+        assert sum(donated) == 9
+        assert donated[-1] is False
+
+
+class TestGoldenProgramSize:
+    """Accidental program bloat (an unrolled loop sneaking into a
+    round) fails tier-1 loudly instead of surfacing as a compile-time
+    regression.  Counts include every sub-jaxpr equation."""
+
+    PINS = {
+        "broadcast@small": 123,
+        "membership@small": 882,
+        "sparse@small": 2731,
+    }
+    RTOL = 0.2
+
+    @pytest.mark.parametrize("name", sorted(PINS))
+    def test_eqn_count_pinned(self, name, small_traces):
+        expected = self.PINS[name]
+        got = eqn_count(small_traces[name])
+        lo, hi = int(expected * (1 - self.RTOL)), int(
+            expected * (1 + self.RTOL)
+        )
+        assert lo <= got <= hi, (
+            f"{name}: {got} equations vs pinned {expected} "
+            f"(allowed [{lo}, {hi}]) — program size shifted; if "
+            "intentional, update the pin"
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (mirrors cli lint: nonzero on findings, file:line-style
+# provenance, --format json for CI).
+# ---------------------------------------------------------------------------
+
+
+_FIXTURE_MODULE = """\
+import jax
+import jax.numpy as jnp
+from consul_tpu.sim.engine import SimProgram
+
+_SCALAR = jax.ShapeDtypeStruct((), jnp.float32)
+_VEC = jax.ShapeDtypeStruct((16,), jnp.float32)
+
+def _j1(c, xs):
+    def tick(carry, x):
+        jax.debug.print("tick {}", carry)
+        return carry + x, carry
+    return jax.lax.scan(tick, c, xs)
+
+def _j2(x):
+    return x.astype(jnp.float64) * 2.0
+
+def _j4_build():
+    from consul_tpu.parallel import make_mesh
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = shard_map(
+        lambda x: jnp.sum(x), mesh=make_mesh(jax.devices()[:2]),
+        in_specs=(P("nodes"),), out_specs=P(), check_rep=False,
+    )
+    return fn, (_VEC,)
+
+JAXLINT_PROGRAMS = {
+    "planted@j1": SimProgram(
+        name="planted@j1", entrypoint="planted",
+        build=lambda: (_j1, (_SCALAR,
+                             jax.ShapeDtypeStruct((4,), jnp.float32))),
+        n=4),
+    "planted@j2": SimProgram(
+        name="planted@j2", entrypoint="planted",
+        build=lambda: (_j2, (_VEC,)), n=16, x64=True),
+}
+if len(jax.devices()) >= 2:
+    JAXLINT_PROGRAMS["planted@j4"] = SimProgram(
+        name="planted@j4", entrypoint="planted", build=_j4_build, n=16)
+"""
+
+
+class TestCli:
+    def _run(self, argv):
+        from consul_tpu.cli import build_parser
+
+        args = build_parser().parse_args(argv)
+        return asyncio.run(args.fn(args))
+
+    def test_list_rules(self, capsys):
+        assert self._run(["jaxlint", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in RULES:
+            assert rule in out
+
+    def test_planted_violations_exit_nonzero(self, tmp_path, capsys):
+        # The acceptance fixture: planted J1, J2, and J4 violations
+        # all surface through the CLI with a nonzero exit.
+        fixture = tmp_path / "planted.py"
+        fixture.write_text(_FIXTURE_MODULE)
+        assert self._run(["jaxlint", "--module", str(fixture)]) == 1
+        out = capsys.readouterr().out
+        assert "planted@j1" in out and "J1" in out
+        assert "planted@j2" in out and "J2" in out
+        if len(jax.devices()) >= 2:
+            assert "planted@j4" in out and "J4" in out
+
+    def test_planted_violation_json(self, tmp_path, capsys):
+        fixture = tmp_path / "planted.py"
+        fixture.write_text(_FIXTURE_MODULE)
+        assert self._run(["jaxlint", "--module", str(fixture),
+                          "--format", "json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        rules = {f["rule"] for f in payload["findings"]}
+        expected = {"J1", "J2"} | (
+            {"J4"} if len(jax.devices()) >= 2 else set()
+        )
+        assert rules == expected
+        assert payload["peak_bytes"]["planted@j1"] > 0
+
+    def test_real_repo_small_set_clean(self, capsys):
+        # The acceptance gate's CLI half: zero findings, exit 0 on the
+        # real registry (the big set is covered by TestRepoGate).
+        assert self._run(["jaxlint", "--set", "small"]) == 0
+
+    def test_rule_filter_rejects_unknown(self, capsys):
+        assert self._run(["jaxlint", "--rules", "J99",
+                          "--set", "small"]) == 2

@@ -213,3 +213,23 @@ class TestAwareness:
             membership_init(cfg), jax.random.PRNGKey(6), cfg, 30, ()
         )
         assert np.asarray(final.awareness).max() == 0
+
+
+class TestScheduleValidation:
+    def test_out_of_bounds_fail_at_raises_at_init(self):
+        """A typoed node id must fail loudly at init — jnp's
+        .at[].set silently drops out-of-bounds scatters, which would
+        turn the fault schedule into a no-op and measure a
+        failure-free cluster."""
+        import pytest
+
+        cfg = MembershipConfig(n=48, fail_at=((99, 5),))
+        state = membership_init(cfg)
+        with pytest.raises(IndexError, match=r"\(99, 5\).*n=48"):
+            membership_scan(state, jax.random.PRNGKey(0), cfg, 4, ())
+
+    def test_out_of_bounds_join_at_raises(self):
+        import pytest
+
+        with pytest.raises(IndexError, match="out of bounds"):
+            membership_init(MembershipConfig(n=48, join_at=((-49, 3),)))

@@ -113,6 +113,24 @@ import jax.numpy as jnp
 def keep(x):
     return x.astype(jnp.float32)
 """),
+    ("R3", """
+import jax.numpy as jnp
+def init(pairs):
+    return jnp.asarray([t for _, t in pairs])
+""", """
+import jax.numpy as jnp
+def init(pairs):
+    return jnp.asarray([t for _, t in pairs], jnp.int32)
+"""),
+    ("R3", """
+import jax.numpy as jnp
+def init(xs):
+    return jnp.array(xs)
+""", """
+import jax.numpy as jnp
+def init(xs):
+    return jnp.array(xs, dtype=jnp.float32)
+"""),
     ("R4", """
 import jax, time
 @jax.jit
@@ -479,6 +497,35 @@ class TestRepoGate:
         out = capsys.readouterr().out
         for rule in RULES:
             assert rule in out
+
+    def test_cli_lint_format_json(self, tmp_path, capsys):
+        # The machine-readable contract CI and bench.py consume.
+        import json
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax.numpy as jnp\n"
+            "def init(n: int):\n"
+            "    return jnp.zeros((n,))\n"
+        )
+        from consul_tpu.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["lint", str(bad), "--format", "json"]
+        )
+        assert asyncio.run(args.fn(args)) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["files"] == 1
+        assert [v["rule"] for v in payload["violations"]] == ["R3"]
+        assert payload["violations"][0]["line"] == 3
+
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        args = build_parser().parse_args(
+            ["lint", str(clean), "--format", "json"]
+        )
+        assert asyncio.run(args.fn(args)) == 0
+        assert json.loads(capsys.readouterr().out)["violations"] == []
 
     def test_module_entrypoint(self):
         # python -m consul_tpu.analysis.tracelint defaults to the
