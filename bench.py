@@ -154,33 +154,53 @@ def _run_multichip() -> dict:
         # chip, exact per-message path, in-process (8M aggregate on a
         # v5e-8).  Forced host devices on a CPU container must NOT take
         # this branch — 8M in-process edges would run for hours; they
-        # get the small-n subprocess validation below instead.
+        # get the small-n subprocess validation below instead.  BOTH
+        # exchange backends run at the same shapes, each with the
+        # standalone exchange-vs-merge wall split, so the ring
+        # kernel's overlap win is measured, not assumed.
         from consul_tpu.parallel import make_mesh
+        from consul_tpu.parallel.shard import exchange_phase_walls
 
         mesh = make_mesh()
         ndev = int(mesh.devices.size)
         cfg = BroadcastConfig(
             n=1_000_000 * ndev, fanout=4, profile=LAN, delivery="edges"
         )
-        rep = run_broadcast(cfg, steps=30, seed=0, mesh=mesh, warmup=True)
+        backends = {}
+        for ex in ("alltoall", "ring"):
+            rep = run_broadcast(cfg, steps=30, seed=0, mesh=mesh,
+                                warmup=True, exchange=ex)
+            backends[ex] = {
+                "rounds_per_sec": round(rep.rounds_per_sec, 2),
+                "overflow": rep.overflow,
+                **exchange_phase_walls(cfg, mesh, ex),
+            }
+            if ex == "alltoall":
+                t99_ms = rep.summary()["t99_ms"]
         return {"multichip": {
             "devices": ndev,
             "nodes_aggregate": cfg.n,
             "nodes_per_device": cfg.n // ndev,
-            "rounds_per_sec": round(rep.rounds_per_sec, 2),
-            "overflow": rep.overflow,
-            "t99_ms": rep.summary()["t99_ms"],
+            "rounds_per_sec": backends["alltoall"]["rounds_per_sec"],
+            "overflow": backends["alltoall"]["overflow"],
+            "exchange_backend": "alltoall",
+            "exchange_backends": backends,
+            "t99_ms": t99_ms,
             "host_devices_forced": False,
         }}
     # Single-device container: validate the plane over 8 forced host
     # devices at small n, in a subprocess (XLA_FLAGS must be set before
     # the child's first backend use — impossible in THIS process).
+    # --exchange both: the child times all_to_all AND the Pallas ring
+    # kernel (interpret mode) at identical shapes, with per-round
+    # exchange/merge wall splits in "exchange_backends".
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     out = subprocess.run(
         [sys.executable, "-m", "consul_tpu.parallel.shard",
-         "--devices", "8", "--n", "4096", "--steps", "30"],
+         "--devices", "8", "--n", "4096", "--steps", "30",
+         "--exchange", "both"],
         capture_output=True, text=True, timeout=600, check=True, env=env,
     )
     return {"multichip": json.loads(out.stdout.strip().splitlines()[-1])}

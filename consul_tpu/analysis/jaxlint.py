@@ -68,6 +68,13 @@ ordered) tracking the live-buffer set:
   donation is worth one copy of the state — the before/after delta
   the J3 fix pins in tests.
 
+``pallas_call`` equations (the ring-exchange DMA kernel,
+``ops/ring_exchange.py``) are OPAQUE to every rule: the kernel body is
+a Mosaic program over memory-space refs and DMA primitives, so no rule
+recurses into it (no false J1/J2/J4 hits), J6 prices it as declared
+out_shapes + scratch operands (semaphores, VMEM), and the replication
+taint treats any tainted input as tainting every output.
+
 ``shard_map`` bodies operate on per-device block shapes, so their
 recursive peak IS the per-chip estimate for the sharded entrypoints
 (replicated full-population draws included, matching the
@@ -115,6 +122,17 @@ _LOOP_PRIMS = frozenset({"scan", "while"})
 # feeders of a replicated out_spec); all_to_all/ppermute stay
 # device-varying.
 _REPLICATING_PRIMS = frozenset({"psum", "pmax", "pmin", "all_gather"})
+# Pallas kernels are Mosaic-level programs: their body jaxprs operate on
+# memory-space refs (HBM/VMEM/semaphores) with DMA and device-id
+# primitives the XLA-level rules have no business judging — the ring
+# exchange kernel (ops/ring_exchange.py) legitimately calls axis_index
+# and remote-DMA ops inside its body.  Every rule treats the body as
+# OPAQUE: no recursion (so no false J1/J2/J4 hits inside), J6 counts
+# the declared out_shapes plus the scratch operands (semaphores,
+# VMEM buffers), and the replication taint treats the call like any
+# other device-varying computation (any tainted input taints every
+# output).
+_OPAQUE_PRIMS = frozenset({"pallas_call"})
 _COLLECTIVE_PRIMS = _REPLICATING_PRIMS | frozenset({
     "all_to_all", "ppermute", "pshuffle", "reduce_scatter", "axis_index",
 })
@@ -217,6 +235,21 @@ def _sub_jaxprs(eqn) -> list[tuple[str, Any, tuple]]:
                 if hasattr(b, "jaxpr") and hasattr(b.jaxpr, "eqns"):
                     out.append((f"{name}[{i}]", b.jaxpr, tuple(b.consts)))
     return out
+
+
+def _pallas_inner_bytes(eqn) -> int:
+    """J6 footprint of one opaque ``pallas_call``: the declared
+    out_shapes plus the scratch operands (DMA semaphores, VMEM
+    buffers) — the trailing ``num_scratch_operands`` refs of the
+    kernel jaxpr, per the GridMapping contract."""
+    total = sum(_aval_bytes(o.aval) for o in eqn.outvars)
+    body = eqn.params.get("jaxpr")
+    gm = eqn.params.get("grid_mapping")
+    n_scratch = int(getattr(gm, "num_scratch_operands", 0) or 0)
+    if body is not None and n_scratch:
+        for v in body.invars[len(body.invars) - n_scratch:]:
+            total += _aval_bytes(getattr(v, "aval", None))
+    return total
 
 
 def _axis_names(params: dict) -> tuple[str, ...]:
@@ -345,6 +378,17 @@ def _inner_peaks(eqn, i, last, live: dict, ps: _PeakState,
     subs = _sub_jaxprs(eqn)
     if not subs:
         return []
+    if prim in _OPAQUE_PRIMS:
+        # Opaque kernel: operands are read in place (ANY/HBM refs, no
+        # copy), so the whole working set is outer-live + declared
+        # out_shapes + scratch.  ``covered`` cancels the operand bytes
+        # against the outer live set the caller adds back.
+        covered, seen = 0, set()
+        for v in eqn.invars:
+            if _is_var(v) and v in live and v not in seen:
+                covered += live[v]
+                seen.add(v)
+        return [(covered, covered + _pallas_inner_bytes(eqn))]
 
     def donation_mask(name: str, sub) -> tuple[int, list[bool], list[bool]]:
         """(offset of sub invars into eqn.invars, donated mask,
@@ -460,7 +504,11 @@ def _device_varying_outputs(jaxpr, in_tainted: list[bool]) -> list[bool]:
     def sub_out_taint(eqn) -> Optional[list[bool]]:
         prim = eqn.primitive.name
         subs = _sub_jaxprs(eqn)
-        if not subs:
+        # Opaque kernels (pallas_call) return their results through out
+        # refs, not jaxpr outvars, so positional passthrough would read
+        # an EMPTY outvar list and mark every output replicated; fall
+        # through to the generic any-tainted-input rule instead.
+        if not subs or prim in _OPAQUE_PRIMS:
             return None
         in_t = [is_t(v) for v in eqn.invars]
         if prim == "scan":
@@ -629,6 +677,12 @@ class _Analyzer:
                 self._check_collective(eqn, prim, axis_sizes)
             if prim == "shard_map":
                 self._check_shard_map(eqn)
+            # Opaque kernel bodies (pallas_call) are Mosaic programs —
+            # refs, DMA ops, device ids — not XLA code; none of the
+            # J-rules apply inside (J6 prices them via
+            # _pallas_inner_bytes instead).
+            if prim in _OPAQUE_PRIMS:
+                continue
             # Recurse.
             sub_axis = dict(axis_sizes)
             if prim == "shard_map":

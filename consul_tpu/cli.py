@@ -254,6 +254,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "CPU containers force host devices with "
                          "XLA_FLAGS=--xla_force_host_platform_device_"
                          "count=D)")
+    sp.add_argument("--exchange", default="",
+                    choices=("", "alltoall", "ring"),
+                    help="outbox transport of the sharded plane "
+                         "(requires --devices): 'alltoall' = one XLA "
+                         "collective per round, 'ring' = the Pallas "
+                         "make_async_remote_copy DMA kernel "
+                         "(consul_tpu/ops/ring_exchange.py); backends "
+                         "are bit-equal")
 
     # Like the reference, version tolerates (and ignores) the global
     # client flags so scripted `cli ... -http-addr X` loops can include
@@ -1060,7 +1068,8 @@ async def cmd_sim(args) -> int:
         print("Error: scenario name required (or --list)", file=sys.stderr)
         return 1
     out = run_scenario(args.scenario, seed=args.seed,
-                       devices=args.devices or None)
+                       devices=args.devices or None,
+                       exchange=args.exchange or None)
     print(json.dumps(out, indent=2, default=str))
     return 0
 

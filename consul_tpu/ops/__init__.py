@@ -23,8 +23,10 @@ from consul_tpu.ops.sortmerge import (
     row_locate,
     sort_slot_rows,
 )
+from consul_tpu.ops.ring_exchange import ring_exchange
 
 __all__ = [
+    "ring_exchange",
     "merge_deliveries",
     "row_locate",
     "sort_slot_rows",

@@ -1,0 +1,161 @@
+"""Ring-DMA outbox exchange: the Pallas twin of ``lax.all_to_all``.
+
+The multichip plane (``consul_tpu/parallel/shard.py``) routes every
+cross-shard message through fixed per-destination outbox planes shaped
+``[D, budget]`` and, until this module, exchanged them with ONE
+``lax.all_to_all`` per round — which serializes pack → exchange →
+merge and left the headline dense-1M metric flat at ~1000 rounds/s
+(BENCH_r02–r05).  This kernel re-expresses the exchange as D−1 ring
+hops of ``pltpu.make_async_remote_copy`` over the 1-D ``nodes`` mesh:
+
+  hop h ∈ {1..D−1}:  shard ``me`` DMAs its outbox row ``(me+h) % D``
+                     straight into row ``me`` of that shard's inbox —
+                     the rotated-pairwise schedule, so every hop is a
+                     single remote copy of one contiguous
+                     ``[C, budget]`` row block and total traffic
+                     equals the all_to_all it replaces.
+
+Send/recv DMA semaphores are **double-buffered** (two slots, hop h on
+slot ``h % 2``): hop h+1's remote copy is started *before* waiting on
+hop h, so consecutive hops overlap on the wire, and the kernel as a
+whole runs concurrently with whatever the surrounding program schedules
+next to it — in the sharded scans that is the LOCAL delivery work
+(the broadcast/dense models' local scatter has no data dependence on
+the inbox, so XLA is free to hide the remote copies behind it; the
+sparse model's single sort-merge call keeps the exactness ladder and
+takes the inbox as one stream).  This is the comm/compute-overlap
+discipline the SWIM dissemination-time analysis assumes and that the
+tuneable-gossip family (PAPERS.md) exploits to keep per-round cost
+constant as fanout grows.
+
+Exactness: the kernel writes inbox row ``s`` with exactly what shard
+``s`` addressed to us, i.e. the SAME layout ``lax.all_to_all`` yields
+— so ``exchange="ring"`` is bit-equal to ``exchange="alltoall"`` at
+every D and the D == 1 equality pins ride through unchanged
+(tests/test_shard.py pins ring == all_to_all for all three sharded
+models).
+
+Portability: on non-TPU backends the kernel runs under
+``pl.pallas_call(interpret=True)`` automatically, so the identical
+code path (remote-copy semantics included — the interpreter emulates
+the inter-device DMAs) is testable on the CPU containers tier-1 runs
+in.  On a real TPU the kernel starts with a barrier against every peer
+(``pltpu.get_barrier_semaphore``, shared ``collective_id``) so no
+shard's DMA can land in an inbox a neighbour has not allocated yet;
+the interpreter serializes devices and neither supports nor needs the
+barrier, so it is gated on ``interpret``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from consul_tpu.parallel.mesh import NODE_AXIS
+
+# Every ring kernel in a program shares one barrier id: the exchanges
+# are issued sequentially (one per tick inside the scan), never
+# concurrently, so a single collective id is safe and keeps Mosaic's
+# cross-program barrier bookkeeping trivial.
+COLLECTIVE_ID = 1
+
+
+def _ring_kernel(n_shards: int, barrier: bool, axis_name: str,
+                 in_ref, out_ref, send_sem, recv_sem, local_sem):
+    """D−1 double-buffered remote copies + the local row.
+
+    ``in_ref``/``out_ref`` are ``[D, C, budget]`` int32 refs in ANY
+    (HBM) memory space; hop h's copy moves the contiguous
+    ``[C, budget]`` row block ``(me+h) % D`` of the local outbox into
+    row ``me`` of the destination shard's inbox."""
+    me = jax.lax.axis_index(axis_name)
+
+    if barrier:
+        # Real-TPU entry barrier: signal every peer we will DMA to,
+        # wait for every peer that will DMA to us (D-1 of each).
+        bar = pltpu.get_barrier_semaphore()
+        for h in range(1, n_shards):
+            pltpu.semaphore_signal(
+                bar, inc=1,
+                device_id=jax.lax.rem(me + h, n_shards),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+        pltpu.semaphore_wait(bar, n_shards - 1)
+
+    # Inbox row `me` is what we addressed to ourselves (all -1 slots:
+    # pack_outbox only packs remote-destined messages) — copied locally
+    # so the result layout is bit-identical to all_to_all's.
+    local = pltpu.make_async_copy(
+        in_ref.at[me], out_ref.at[me], local_sem
+    )
+    local.start()
+
+    def hop(h: int):
+        dst = jax.lax.rem(me + h, n_shards)
+        return pltpu.make_async_remote_copy(
+            src_ref=in_ref.at[dst],
+            dst_ref=out_ref.at[me],
+            send_sem=send_sem.at[h % 2],
+            recv_sem=recv_sem.at[h % 2],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    # Double-buffered hop pipeline: start hop h+1 before waiting on
+    # hop h, so two remote copies are in flight at any moment.  The
+    # hop count is static (mesh size), so the loop unrolls at trace
+    # time — no scalar loop machinery inside the kernel.
+    if n_shards > 1:
+        hop(1).start()
+    for h in range(1, n_shards):
+        if h + 1 < n_shards:
+            hop(h + 1).start()
+        cur = hop(h)
+        cur.wait_send()
+        cur.wait_recv()
+    local.wait()
+
+
+def ring_exchange(planes: tuple, axis_name: str = NODE_AXIS, *,
+                  interpret: bool | None = None) -> tuple:
+    """Exchange per-destination outbox planes around the mesh ring.
+
+    ``planes`` — int32 ``[D, budget]`` arrays from ``pack_outbox``
+    (row d = messages addressed to shard d).  Returns one ``[D*budget]``
+    inbox per plane, row d = what shard d addressed to us — the exact
+    output contract (layout included) of the all_to_all path in
+    ``parallel/shard.py:exchange_outbox``.
+
+    ``interpret=None`` auto-selects ``pl.pallas_call(interpret=True)``
+    off-TPU so the identical kernel is testable on CPU containers."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_shards, budget = (int(d) for d in planes[0].shape)
+    # One [D, C, budget] box: a hop moves all C payload columns of a
+    # destination row in ONE contiguous DMA instead of C small ones.
+    box = jnp.stack([p.astype(jnp.int32) for p in planes], axis=1)
+    out = pl.pallas_call(
+        functools.partial(
+            _ring_kernel, n_shards, not interpret, axis_name
+        ),
+        out_shape=jax.ShapeDtypeStruct(box.shape, jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),   # send, double-buffered
+            pltpu.SemaphoreType.DMA((2,)),   # recv, double-buffered
+            pltpu.SemaphoreType.DMA,         # local self-row copy
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=COLLECTIVE_ID
+        ),
+    )(box)
+    return tuple(
+        out[:, c, :].reshape(n_shards * budget)
+        for c in range(len(planes))
+    )

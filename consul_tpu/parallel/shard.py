@@ -23,8 +23,13 @@ Gossiping"):
      c x the Poissonized mean arrivals per destination,
      :func:`outbox_budget`); misses are counted into ``overflow`` —
      never silent, same exactness-ladder discipline as the sparse
-     model's compacted push/pull — and exchanged with ONE
-     ``lax.all_to_all`` per round.
+     model's compacted push/pull — and exchanged once per round
+     through the backend seam :func:`exchange_outbox`
+     (``exchange="alltoall"``: one ``lax.all_to_all``;
+     ``exchange="ring"``: the Pallas ``make_async_remote_copy`` ring
+     kernel, ``ops/ring_exchange.py``, whose double-buffered DMA hops
+     overlap each other and the local delivery work).  Backends are
+     bit-equal by construction.
   3. **Merge.**  Inbound arrivals join the local stream and land
      through the same delivery kernels the single-chip models use —
      the sparse plane's sort-merge kernel (``ops/sortmerge.py``)
@@ -123,10 +128,32 @@ def pack_outbox(dest: jax.Array, ok: jax.Array, cols: tuple,
     return packed, dropped
 
 
-def exchange_outbox(planes: tuple, axis_name: str = NODE_AXIS) -> tuple:
-    """One ``all_to_all`` per payload plane: row d of each [D, budget]
-    outbox goes to shard d; the result flattens to the [D*budget] inbox
-    (row d = what shard d addressed to us, -1 slots empty)."""
+def exchange_outbox(planes: tuple, axis_name: str = NODE_AXIS,
+                    backend: str = "alltoall") -> tuple:
+    """Move row d of each [D, budget] outbox plane to shard d; the
+    result flattens to the [D*budget] inbox (row d = what shard d
+    addressed to us, -1 slots empty).
+
+    ``backend`` selects the transport — identical results by
+    construction, pinned by tests/test_shard.py:
+
+      alltoall  one ``lax.all_to_all`` per payload plane (XLA's
+                collective; the baseline)
+      ring      the Pallas ``make_async_remote_copy`` ring kernel
+                (``ops/ring_exchange.py``): D−1 double-buffered DMA
+                hops that overlap each other and whatever local work
+                XLA schedules alongside — interpret-mode on non-TPU
+                backends, so the same code path runs everywhere
+    """
+    if backend == "ring":
+        from consul_tpu.ops.ring_exchange import ring_exchange
+
+        return ring_exchange(planes, axis_name)
+    if backend != "alltoall":
+        raise ValueError(
+            f"unknown exchange backend {backend!r}; "
+            "choose 'alltoall' or 'ring'"
+        )
     return tuple(
         jax.lax.all_to_all(p, axis_name, 0, 0, tiled=True).reshape(-1)
         for p in planes
@@ -143,13 +170,17 @@ def _rows(x: jax.Array, start: jax.Array, blk: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps", "mesh"))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange")
+)
 def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
-                           mesh: Mesh):
+                           mesh: Mesh, exchange: str = "alltoall"):
     """Sharded twin of ``sim.engine.broadcast_scan``: returns
     ``(final_state, (infected[steps], overflow))`` with every per-node
     plane block-sharded over the mesh and ``overflow`` the total outbox
-    budget misses (0 at D == 1 by construction)."""
+    budget misses (0 at D == 1 by construction).  ``exchange`` selects
+    the outbox transport (:func:`exchange_outbox`); backends are
+    bit-equal, so the choice is purely a perf knob."""
     from consul_tpu.models.broadcast import BroadcastState
     from consul_tpu.ops import bernoulli_mask, deliver_or, sample_peers
 
@@ -186,7 +217,9 @@ def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
             (ob_recv,), dropped = pack_outbox(
                 dest, okf & (dest != me), (recv,), d_shards, budget
             )
-            (ib_recv,) = exchange_outbox((ob_recv,))
+            (ib_recv,) = exchange_outbox(
+                (ob_recv,), backend=exchange
+            )
             got_in = ib_recv >= 0
             new_knows = deliver_or(
                 new_knows, jnp.where(got_in, ib_recv - start, blk), got_in
@@ -243,11 +276,12 @@ def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track"),
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange"),
     donate_argnums=(0,),
 )
 def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
-                            mesh: Mesh, track: tuple = ()):
+                            mesh: Mesh, track: tuple = (),
+                            exchange: str = "alltoall"):
     """Sharded twin of ``sim.engine.membership_scan``: each device owns
     ``n/D`` observer ROWS of every [n, n] plane.  Gossip scatters route
     through the outbox; the push/pull row exchange gathers the budgeted
@@ -395,7 +429,9 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
             dest, ok3 & (dest != me), (recv, subj3, val3, sus3),
             d_shards, budget,
         )
-        ib_recv, ib_subj, ib_val, ib_sus = exchange_outbox(packed)
+        ib_recv, ib_subj, ib_val, ib_sus = exchange_outbox(
+            packed, backend=exchange
+        )
         got_in = ib_recv >= 0
         flat_in = jnp.where(
             got_in, (ib_recv - start) * n + ib_subj, blk * n
@@ -663,12 +699,13 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track"),
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange"),
     donate_argnums=(0,),
 )
 def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                                    steps: int, mesh: Mesh,
-                                   track: tuple = ()):
+                                   track: tuple = (),
+                                   exchange: str = "alltoall"):
     """Sharded twin of ``sim.engine.sparse_membership_scan``: each
     device owns ``n/D`` observer rows of the [n, K] slot planes; the
     whole inbound stream — local gossip, compacted push/pull, and the
@@ -889,7 +926,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             d_shards, budget,
         )
         ib_recv, ib_subj, ib_val, ib_sus, ib_alloc = exchange_outbox(
-            packed
+            packed, backend=exchange
         )
         ib_ok = ib_recv >= 0
         recv_l = jnp.concatenate([
@@ -1133,6 +1170,75 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
 # ---------------------------------------------------------------------------
 
 
+def exchange_phase_walls(cfg, mesh: Mesh, backend: str,
+                         iters: int = 20) -> dict:
+    """Per-round wall-clock split of one broadcast-shaped gossip round:
+    the pack+exchange program and the local delivery scatter, each
+    timed standalone at the round's exact shapes.  This is how the
+    overlap win of the ring backend is *measured* instead of assumed —
+    ``exchange_wall_s`` is what the round pays when the transport does
+    NOT hide behind the merge, ``merge_wall_s`` is the local work it
+    can hide behind."""
+    import time
+
+    import numpy as np
+
+    from consul_tpu.ops import deliver_or, sample_peers
+
+    n, fanout = cfg.n, cfg.fanout
+    d_shards = int(mesh.devices.size)
+    blk = block_size(n, mesh)
+    budget = outbox_budget(blk * fanout, d_shards)
+
+    def ex_body(recv, ok):
+        me = jax.lax.axis_index(NODE_AXIS)
+        r = recv.reshape(-1)
+        o = ok.reshape(-1)
+        dest = r // blk
+        packed, dropped = pack_outbox(
+            dest, o & (dest != me), (r,), d_shards, budget
+        )
+        (ib,) = exchange_outbox(packed, backend=backend)
+        return (jnp.sum(ib, dtype=jnp.int32) + dropped)[None]
+
+    def mg_body(knows, recv, ok):
+        me = jax.lax.axis_index(NODE_AXIS)
+        r = recv.reshape(-1)
+        o = ok.reshape(-1)
+        local = o & (r // blk == me)
+        return deliver_or(
+            knows, jnp.where(local, r - me * blk, blk), local
+        )
+
+    spec2 = P(NODE_AXIS, None)
+    run_ex = jax.jit(shard_map(
+        ex_body, mesh=mesh, in_specs=(spec2, spec2),
+        out_specs=P(NODE_AXIS), check_rep=False,
+    ))
+    run_mg = jax.jit(shard_map(
+        mg_body, mesh=mesh, in_specs=(P(NODE_AXIS), spec2, spec2),
+        out_specs=P(NODE_AXIS), check_rep=False,
+    ))
+
+    key = jax.random.PRNGKey(7)
+    recv = sample_peers(key, n, fanout)
+    ok = jnp.ones((n, fanout), bool)
+    knows = jnp.zeros((n,), bool)
+
+    def timed(fn, *args):
+        np.asarray(fn(*args))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / iters
+
+    return {
+        "exchange_wall_s": round(timed(run_ex, recv, ok), 6),
+        "merge_wall_s": round(timed(run_mg, knows, recv, ok), 6),
+    }
+
+
 def main(argv=None) -> int:
     """Emit one multichip datapoint as a JSON line: the sharded
     broadcast study over ``--devices`` mesh devices at ``--n``
@@ -1156,6 +1262,11 @@ def main(argv=None) -> int:
                         help="aggregate nodes across the mesh")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--exchange", default="both",
+                        choices=("alltoall", "ring", "both"),
+                        help="outbox transport(s) to measure "
+                             "(default: both, so the ring/all_to_all "
+                             "comparison ships in one datapoint)")
     args = parser.parse_args(argv)
 
     forced = False
@@ -1184,25 +1295,43 @@ def main(argv=None) -> int:
     mesh = mesh_for(args.devices)
     cfg = BroadcastConfig(n=args.n, fanout=4, delivery="edges")
     key = jax.random.PRNGKey(args.seed)
-    # Warmup compiles the program; the timed pass is steady-state.
-    _, (infected, ov) = sharded_broadcast_scan(
-        broadcast_init(cfg), key, cfg, args.steps, mesh
+    backends = (
+        ("alltoall", "ring") if args.exchange == "both"
+        else (args.exchange,)
     )
-    np.asarray(infected)
-    t0 = time.perf_counter()
-    _, (infected, ov) = sharded_broadcast_scan(
-        broadcast_init(cfg), key, cfg, args.steps, mesh
-    )
-    infected = np.asarray(infected)
-    wall = time.perf_counter() - t0
+    per_backend: dict = {}
+    for ex in backends:
+        # Warmup compiles the program; the timed pass is steady-state.
+        _, (infected, ov) = sharded_broadcast_scan(
+            broadcast_init(cfg), key, cfg, args.steps, mesh, ex
+        )
+        np.asarray(infected)
+        t0 = time.perf_counter()
+        _, (infected, ov) = sharded_broadcast_scan(
+            broadcast_init(cfg), key, cfg, args.steps, mesh, ex
+        )
+        infected = np.asarray(infected)
+        wall = time.perf_counter() - t0
+        per_backend[ex] = {
+            "rounds_per_sec": (
+                round(args.steps / wall, 2) if wall > 0 else None
+            ),
+            "infected_final": int(infected[-1]),
+            "overflow": int(np.asarray(ov)),
+            # The measured split the overlap claim rides on.
+            **exchange_phase_walls(cfg, mesh, ex),
+        }
+    head = per_backend[backends[0]]
     print(json.dumps({
         "devices": int(mesh.devices.size),
         "nodes_aggregate": cfg.n,
         "nodes_per_device": cfg.n // int(mesh.devices.size),
         "rounds": args.steps,
-        "rounds_per_sec": round(args.steps / wall, 2) if wall > 0 else None,
-        "infected_final": int(infected[-1]),
-        "overflow": int(np.asarray(ov)),
+        "rounds_per_sec": head["rounds_per_sec"],
+        "infected_final": head["infected_final"],
+        "overflow": head["overflow"],
+        "exchange_backend": backends[0],
+        "exchange_backends": per_backend,
         "host_devices_forced": forced,
     }))
     return 0

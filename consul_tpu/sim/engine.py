@@ -196,6 +196,19 @@ def _timed(make_state, scan_fn, key, cfg, steps, warmup: bool):
     return final, out, wall
 
 
+def _check_exchange(exchange: str, mesh, sharded: bool = False) -> None:
+    """The exchange backend is a multichip-plane knob: asking for a
+    non-default transport without a mesh (or on the legacy GSPMD
+    ``sharded=True`` path, which has no outbox) would silently ignore
+    it, so reject it loudly instead."""
+    if exchange != "alltoall" and (mesh is None or sharded):
+        raise ValueError(
+            f"exchange={exchange!r} requires mesh= without sharded= "
+            "(the outbox transport only exists on the explicit "
+            "multi-chip plane)"
+        )
+
+
 def run_broadcast(
     cfg: BroadcastConfig,
     steps: int,
@@ -204,12 +217,17 @@ def run_broadcast(
     sharded: bool = False,
     mesh=None,
     warmup: bool = True,
+    exchange: str = "alltoall",
 ) -> BroadcastReport:
     """``mesh=`` alone selects the explicit multi-chip plane
     (consul_tpu/parallel/shard.py: per-device node blocks, outbox
     message routing, D == 1 bit-equal to the unsharded scan) and fills
     ``report.overflow``; ``sharded=True`` keeps the legacy GSPMD
-    placement path (shard_state over the unsharded program)."""
+    placement path (shard_state over the unsharded program).
+    ``exchange`` picks the outbox transport (``"alltoall"`` |
+    ``"ring"``, bit-equal; see parallel/shard.py:exchange_outbox)."""
+    _check_exchange(exchange, mesh, sharded)
+
     def make_state():
         st = broadcast_init(cfg, origin=origin)
         return shard_state(st, mesh or make_mesh()) if sharded else st
@@ -220,7 +238,7 @@ def run_broadcast(
         # positional call shapes separately, and tests/benches call the
         # sharded scans positionally.
         def scan(st, k, c, s):
-            return sharded_broadcast_scan(st, k, c, s, mesh)
+            return sharded_broadcast_scan(st, k, c, s, mesh, exchange)
 
         _, (infected, ov), wall = _timed(
             make_state, scan, key, cfg, steps, warmup
@@ -284,11 +302,15 @@ def run_membership(
     sharded: bool = False,
     mesh=None,
     warmup: bool = True,
+    exchange: str = "alltoall",
 ):
     """Full-membership study; ``track`` selects the subject columns whose
     detection curves come back per tick.  ``mesh=`` alone selects the
-    explicit multi-chip plane (see :func:`run_broadcast`)."""
+    explicit multi-chip plane, ``exchange`` its outbox transport (see
+    :func:`run_broadcast`)."""
     from consul_tpu.sim.metrics import MembershipReport
+
+    _check_exchange(exchange, mesh, sharded)
 
     def make_state():
         st = membership_init(cfg)
@@ -299,7 +321,9 @@ def run_membership(
         track_t = tuple(track)
 
         def scan(st, k, c, s):  # positional statics: see run_broadcast
-            return sharded_membership_scan(st, k, c, s, mesh, track_t)
+            return sharded_membership_scan(
+                st, k, c, s, mesh, track_t, exchange
+            )
 
         _, (sus, dead, sus_cells, known, ov), wall = _timed(
             make_state, scan, key, cfg, steps, warmup
@@ -404,6 +428,7 @@ def run_membership_sparse(
     track: tuple = (),
     warmup: bool = True,
     mesh=None,
+    exchange: str = "alltoall",
 ):
     """Top-K sparse membership study (models/membership_sparse.py): the
     n ≥ 10⁵ regime the dense model's O(N²) state cannot reach, delivered
@@ -411,17 +436,19 @@ def run_membership_sparse(
 
     ``mesh=`` shards the observer rows over the device mesh
     (consul_tpu/parallel/shard.py); the returned overflow then also
-    counts outbox budget misses."""
+    counts outbox budget misses.  ``exchange`` picks the outbox
+    transport (see :func:`run_broadcast`)."""
     from consul_tpu.models.membership_sparse import sparse_membership_init
     from consul_tpu.sim.metrics import MembershipReport
 
+    _check_exchange(exchange, mesh)
     key = jax.random.PRNGKey(seed)
     if mesh is not None:
         track_t = tuple(track)
 
         def scan(st, k, c, s):  # positional statics: see run_broadcast
             return sharded_sparse_membership_scan(
-                st, k, c, s, mesh, track_t
+                st, k, c, s, mesh, track_t, exchange
             )
     else:
         scan = functools.partial(sparse_membership_scan, track=tuple(track))
@@ -593,24 +620,35 @@ def jaxlint_registry(include=("small", "big"),
         )
 
     def add_sharded(tag: str, d: int, bcfg, bsteps, mcfg, msteps, mtrack,
-                    scfg, ssteps, strack) -> None:
+                    scfg, ssteps, strack,
+                    exchanges: tuple = ("alltoall",)) -> None:
         if d > len(jax.devices()):
             return
         mesh = make_mesh(jax.devices()[:d])
-        add(f"sharded_broadcast@{tag}/D{d}", "sharded_broadcast_scan",
-            lambda: broadcast_init(bcfg),
-            lambda s, k: sharded_broadcast_scan(s, k, bcfg, bsteps, mesh),
-            bcfg.n, devices=d, per_chip=True)
-        add(f"sharded_membership@{tag}/D{d}", "sharded_membership_scan",
-            lambda: membership_init(mcfg),
-            lambda s, k: sharded_membership_scan(
-                s, k, mcfg, msteps, mesh, mtrack),
-            mcfg.n, devices=d, per_chip=True)
-        add(f"sharded_sparse@{tag}/D{d}", "sharded_sparse_membership_scan",
-            lambda: sparse_membership_init(scfg),
-            lambda s, k: sharded_sparse_membership_scan(
-                s, k, scfg, ssteps, mesh, strack),
-            scfg.base.n, devices=d, per_chip=True)
+        for ex in exchanges:
+            # The alltoall entries keep their historical names; the
+            # ring twins (the Pallas make_async_remote_copy kernel,
+            # ops/ring_exchange.py) get a /ring suffix so jaxlint's
+            # zero-findings gates walk the pallas_call program too.
+            sfx = "" if ex == "alltoall" else f"/{ex}"
+            add(f"sharded_broadcast@{tag}/D{d}{sfx}",
+                "sharded_broadcast_scan",
+                lambda: broadcast_init(bcfg),
+                lambda s, k, ex=ex: sharded_broadcast_scan(
+                    s, k, bcfg, bsteps, mesh, ex),
+                bcfg.n, devices=d, per_chip=True)
+            add(f"sharded_membership@{tag}/D{d}{sfx}",
+                "sharded_membership_scan",
+                lambda: membership_init(mcfg),
+                lambda s, k, ex=ex: sharded_membership_scan(
+                    s, k, mcfg, msteps, mesh, mtrack, ex),
+                mcfg.n, devices=d, per_chip=True)
+            add(f"sharded_sparse@{tag}/D{d}{sfx}",
+                "sharded_sparse_membership_scan",
+                lambda: sparse_membership_init(scfg),
+                lambda s, k, ex=ex: sharded_sparse_membership_scan(
+                    s, k, scfg, ssteps, mesh, strack, ex),
+                scfg.base.n, devices=d, per_chip=True)
 
     if "small" in include:
         mcfg = MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),))
@@ -639,8 +677,13 @@ def jaxlint_registry(include=("small", "big"),
             lambda: multidc_init(mdcfg),
             lambda s, k: multidc_scan(s, k, mdcfg, 8), mdcfg.n)
         for d in sharded_devices:
+            # Both exchange backends at small-n: the ring twins put the
+            # Pallas ring kernel's traced program under every jaxlint
+            # gate (the big set stays alltoall-only — the 1M ring
+            # programs are identical modulo the pallas_call eqn, and
+            # big traces cost ~5 s each).
             add_sharded("small", d, bcfg, 8, mcfg, 8, (3,),
-                        scfg, 8, (3,))
+                        scfg, 8, (3,), exchanges=("alltoall", "ring"))
     if "big" in include:
         # The north-star shapes bench.py measures: 1M nodes for the
         # per-node-plane models (dense membership capped at its 16k

@@ -40,7 +40,8 @@ def dev3(seed: int = 0) -> dict:
     return {"scenario": "dev3", **rep.summary()}
 
 
-def probe1k(seed: int = 0, devices: int = None) -> dict:
+def probe1k(seed: int = 0, devices: int = None,
+            exchange: str = "alltoall") -> dict:
     """BASELINE config 2: 1k nodes, SWIM probe/ack, 1% induced failure.
 
     1% of 1000 = 10 CONCURRENT crashes in one full-membership program
@@ -49,7 +50,8 @@ def probe1k(seed: int = 0, devices: int = None) -> dict:
     the dynamics 10 independent single-subject universes can't show.
 
     ``devices`` shards the observer rows over the first D devices
-    (``cli sim probe1k --devices D``)."""
+    (``cli sim probe1k --devices D``); ``exchange`` picks the outbox
+    transport (``--exchange ring`` = the Pallas DMA kernel)."""
     from consul_tpu.parallel import mesh_for
 
     failed = tuple(range(0, 1000, 100))  # 10 spread-out subjects
@@ -59,7 +61,8 @@ def probe1k(seed: int = 0, devices: int = None) -> dict:
     )
     rep = run_membership(cfg, steps=300, seed=seed, track=failed,
                          warmup=False,
-                         mesh=mesh_for(devices) if devices else None)
+                         mesh=mesh_for(devices) if devices else None,
+                         exchange=exchange)
     first_sus = [rep.first_detection_ms(i) for i in range(len(failed))]
     live = cfg.n - len(failed)
     conv = [rep.dead_converged(i, live) for i in range(len(failed))]
@@ -75,29 +78,36 @@ def probe1k(seed: int = 0, devices: int = None) -> dict:
             [(c + 1) * rep.tick_ms for c in conv if c is not None]
         )) if any(c is not None for c in conv) else None,
         "sim_rounds_per_sec": rep.rounds_per_sec,
-        **({"devices": devices, "shard_overflow": rep.overflow}
+        **({"devices": devices, "exchange_backend": exchange,
+            "shard_overflow": rep.overflow}
            if devices else {}),
     }
 
 
-def event100k(seed: int = 0, devices: int = None) -> dict:
+def event100k(seed: int = 0, devices: int = None,
+              exchange: str = "alltoall") -> dict:
     """BASELINE config 3: 100k-node event broadcast, LAN, fanout 4.
 
     ``devices`` runs the exact per-message path sharded over the first
-    D devices (``cli sim event100k --devices D``) — the outbox/
-    all_to_all plane, with budget misses reported as shard_overflow."""
+    D devices (``cli sim event100k --devices D``) — the outbox plane,
+    with budget misses reported as shard_overflow; ``exchange`` picks
+    the transport (all_to_all collective or the Pallas ring kernel)."""
     from consul_tpu.parallel import mesh_for
 
     if devices:
         cfg = BroadcastConfig(n=100_000, fanout=4, profile=LAN,
                               delivery="edges")
         rep = run_broadcast(cfg, steps=100, seed=seed,
-                            mesh=mesh_for(devices))
+                            mesh=mesh_for(devices), exchange=exchange)
         return {"scenario": "event100k", **rep.summary(),
-                "devices": devices, "shard_overflow": rep.overflow}
+                "devices": devices, "exchange_backend": exchange,
+                "shard_overflow": rep.overflow}
     cfg = BroadcastConfig(n=100_000, fanout=4, profile=LAN,
                           delivery="aggregate")
-    rep = run_broadcast(cfg, steps=100, seed=seed)
+    # exchange threads through so a non-default transport without a
+    # mesh is rejected by the engine, not silently dropped (same
+    # loud-never-silent contract as probe1k).
+    rep = run_broadcast(cfg, steps=100, seed=seed, exchange=exchange)
     return {"scenario": "event100k", **rep.summary()}
 
 
@@ -214,11 +224,14 @@ SCENARIOS: dict[str, Callable[..., dict]] = {
 }
 
 
-def run_scenario(name: str, seed: int = 0, devices: int = None) -> dict:
+def run_scenario(name: str, seed: int = 0, devices: int = None,
+                 exchange: str = None) -> dict:
     """Run a preset by name.  ``devices`` shards the node axis over the
     first D mesh devices for the scenarios that support it (probe1k,
     event100k); asking it of any other preset is an error, not a silent
-    single-chip run."""
+    single-chip run.  ``exchange`` picks the outbox transport of the
+    sharded plane and therefore requires ``devices`` — same
+    loud-never-silent contract."""
     import inspect
 
     try:
@@ -227,10 +240,16 @@ def run_scenario(name: str, seed: int = 0, devices: int = None) -> dict:
         raise ValueError(
             f"unknown scenario {name!r}; choose from {sorted(SCENARIOS)}"
         ) from None
+    if exchange and not devices:
+        raise ValueError(
+            "--exchange selects the sharded plane's outbox transport "
+            "and requires --devices"
+        )
     if devices:
         if "devices" not in inspect.signature(fn).parameters:
             raise ValueError(
                 f"scenario {name!r} does not support --devices"
             )
-        return fn(seed=seed, devices=devices)
+        return fn(seed=seed, devices=devices,
+                  **({"exchange": exchange} if exchange else {}))
     return fn(seed=seed)

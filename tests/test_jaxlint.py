@@ -247,6 +247,104 @@ class TestJ6HbmBudget:
         assert covered == set(RULES)
 
 
+class TestPallasTraversal:
+    """``pallas_call`` eqns (the ring-exchange DMA kernel,
+    ops/ring_exchange.py) are OPAQUE to the rule walk: no false J4
+    hits on the ring collective's in-kernel axis_index/DMA ops, J6
+    prices the declared out_shapes + scratch operands, and the
+    replication taint still flows through the call (any tainted input
+    taints every output)."""
+
+    def _mesh(self):
+        from consul_tpu.parallel import make_mesh
+
+        return make_mesh(jax.devices()[:2])
+
+    @staticmethod
+    def _ring(x):
+        from consul_tpu.ops.ring_exchange import ring_exchange
+
+        (ib,) = ring_exchange((x,), interpret=True)
+        return ib
+
+    def test_clean_on_ring_collective(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        # The real usage shape: per-device [D, budget] outbox in, the
+        # inbox staying sharded.  Zero findings — in particular no J4
+        # from the kernel-internal axis_index / remote-DMA primitives.
+        clean = shard_map(
+            lambda x: self._ring(x).reshape(x.shape),
+            mesh=self._mesh(), in_specs=(P("nodes", None),),
+            out_specs=P("nodes", None), check_rep=False,
+        )
+        prog = _program("ring_clean", clean, SDS((4, 8), I32))
+        findings, peak = analyze_jaxpr(
+            "ring_clean", prog.trace(), budget_bytes=BUDGET_16GB
+        )
+        assert findings == []
+        assert peak.chip_bytes > 0
+
+    def test_j4_fires_on_replicated_pallas_output(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        # The planted violation: the kernel's inbox is device-varying
+        # (it came FROM device-varying outbox planes), so returning a
+        # local reduction of it through a replicated out_spec is the
+        # check_rep=False footgun.  Without the opaque-taint rule the
+        # kernel's empty outvar list would launder the taint away.
+        bad = shard_map(
+            lambda x: jnp.sum(self._ring(x), dtype=I32),
+            mesh=self._mesh(), in_specs=(P("nodes", None),),
+            out_specs=P(), check_rep=False,
+        )
+        assert "J4" in _rules(_program("ring_j4", bad, SDS((4, 8), I32)))
+
+    def test_j6_counts_declared_out_shapes(self):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kern(in_ref, out_ref, sem):
+            copy = pltpu.make_async_copy(in_ref, out_ref.at[0], sem)
+            copy.start()
+            copy.wait()
+
+        def fan_out(x):
+            return pl.pallas_call(
+                kern,
+                out_shape=SDS((1024, *x.shape), I32),  # 256 MiB out
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.DMA],
+                interpret=True,
+            )(x)
+
+        prog = _program("pallas_j6", fan_out, SDS((256, 256), I32))
+        traced = prog.trace()
+        findings, peak = analyze_jaxpr(
+            "pallas_j6", traced, budget_bytes=64 << 20
+        )
+        assert "J6" in [f.rule for f in findings]
+        # The kernel body is opaque: the declared out_shape must be
+        # priced even though nothing inside the body allocates it.
+        assert peak.total_bytes >= 1024 * 256 * 256 * 4
+        clean, _ = analyze_jaxpr(
+            "pallas_j6", traced, budget_bytes=BUDGET_16GB
+        )
+        assert clean == []
+
+    def test_registry_covers_ring_backend(self, small_programs):
+        # The ring twins keep the Pallas program under every jaxlint
+        # gate (zero-findings small/big walks above).
+        for model in ("broadcast", "membership", "sparse"):
+            for d in (1, 2):
+                assert (
+                    f"sharded_{model}@small/D{d}/ring" in small_programs
+                )
+
+
 # ---------------------------------------------------------------------------
 # The estimator.
 # ---------------------------------------------------------------------------

@@ -123,10 +123,13 @@ def _numpy_router(recv, val, ok, d_shards, blk, budget):
 
 
 class TestOutboxRouter:
-    # (d_shards, budget): a tight budget that forces overflow on a
-    # 2-mesh, and a roomy one on the widest routing (4-mesh).
+    # (d_shards, budget) x exchange backend: a tight budget that forces
+    # overflow on a 2-mesh, and a roomy one on the widest routing
+    # (4-mesh — three ring hops, so the ring kernel's double-buffered
+    # slots genuinely cycle).  Both transports must route identically.
+    @pytest.mark.parametrize("backend", ["alltoall", "ring"])
     @pytest.mark.parametrize("d_shards,budget", [(2, 3), (4, 64)])
-    def test_pack_exchange_matches_numpy(self, d_shards, budget):
+    def test_pack_exchange_matches_numpy(self, d_shards, budget, backend):
         n, a_len = 64, 120
         blk = n // d_shards
         mesh = _mesh(d_shards)
@@ -141,7 +144,7 @@ class TestOutboxRouter:
             packed, dropped = pack_outbox(
                 dest, remote, (r, v), d_shards, budget
             )
-            ib_r, ib_v = exchange_outbox(packed)
+            ib_r, ib_v = exchange_outbox(packed, backend=backend)
             return (
                 ib_r[None], ib_v[None],
                 jax.lax.psum(dropped, NODE_AXIS)[None],
@@ -192,6 +195,12 @@ class TestOutboxRouter:
         assert outbox_budget(8000, 8) == 2000       # 2 * 8000/8
         assert outbox_budget(100, 8) == 64          # floor
         assert outbox_budget(16, 8, floor=64) == 16  # never above stream
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="exchange backend"):
+            exchange_outbox(
+                (jnp.zeros((2, 4), jnp.int32),), backend="carrier-pigeon"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -330,6 +339,93 @@ class TestD2:
         for a, b in zip(o1, o2):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         _assert_state_equal(f1, f2)
+
+
+# ---------------------------------------------------------------------------
+# Ring exchange backend (ops/ring_exchange.py): the Pallas
+# make_async_remote_copy kernel, interpret mode on this CPU mesh, must
+# be BIT-EQUAL to the all_to_all transport — same inbox layout by
+# construction, so the whole D == 1 / D == 2 exactness ladder rides
+# through unchanged.  The alltoall twins reuse the programs compiled by
+# the pin classes above (same cfg/steps/mesh tuples).
+# ---------------------------------------------------------------------------
+
+
+class TestRingBackend:
+    @pytest.mark.parametrize("d", [1, 2])
+    def test_broadcast_matches_alltoall(self, d):
+        key = jax.random.PRNGKey(3)
+        f1, (inf1, ov1) = sharded_broadcast_scan(
+            broadcast_init(BCAST_CFG), key, BCAST_CFG, BCAST_STEPS,
+            _mesh(d),
+        )
+        f2, (inf2, ov2) = sharded_broadcast_scan(
+            broadcast_init(BCAST_CFG), key, BCAST_CFG, BCAST_STEPS,
+            _mesh(d), "ring",
+        )
+        np.testing.assert_array_equal(np.asarray(inf1), np.asarray(inf2))
+        _assert_state_equal(f1, f2)
+        assert int(ov2) == int(ov1) == 0
+
+    @pytest.mark.parametrize("d", [1, 2])
+    def test_membership_dense_matches_alltoall(self, d):
+        key = jax.random.PRNGKey(9)
+        f1, o1 = sharded_membership_scan(
+            membership_init(DENSE_CFG), key, DENSE_CFG, DENSE_STEPS,
+            _mesh(d), DENSE_TRACK,
+        )
+        f2, o2 = sharded_membership_scan(
+            membership_init(DENSE_CFG), key, DENSE_CFG, DENSE_STEPS,
+            _mesh(d), DENSE_TRACK, "ring",
+        )
+        for a, b in zip(o1, o2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        _assert_state_equal(f1, f2)
+        assert int(o2[-1]) == 0  # overflow ladder unchanged
+
+    @pytest.mark.parametrize("d", [1, 2])
+    def test_membership_sparse_matches_alltoall(self, d):
+        key = jax.random.PRNGKey(4)
+        f1, o1 = sharded_sparse_membership_scan(
+            sparse_membership_init(SPARSE_CFG), key, SPARSE_CFG,
+            SPARSE_STEPS, _mesh(d), SPARSE_TRACK,
+        )
+        f2, o2 = sharded_sparse_membership_scan(
+            sparse_membership_init(SPARSE_CFG), key, SPARSE_CFG,
+            SPARSE_STEPS, _mesh(d), SPARSE_TRACK, "ring",
+        )
+        for a, b in zip(o1, o2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        _assert_state_equal(f1, f2)
+        assert int(f2.overflow) == int(f1.overflow)
+
+    def test_engine_exchange_requires_mesh(self):
+        from consul_tpu.sim.engine import run_broadcast
+
+        # exchange is a multichip-plane knob: asking for the ring
+        # transport without a mesh must fail loudly, never silently
+        # run the unsharded scan.
+        with pytest.raises(ValueError, match="requires mesh"):
+            run_broadcast(BCAST_CFG, steps=2, exchange="ring")
+
+    @pytest.mark.slow
+    def test_broadcast_multihop_long_horizon(self):
+        # D = 4: three ring hops per round over a long horizon — the
+        # double-buffered send/recv slots wrap repeatedly and the
+        # full epidemic still matches all_to_all bit-for-bit.
+        import dataclasses
+
+        cfg = dataclasses.replace(BCAST_CFG, retransmit_mult=2)
+        key = jax.random.PRNGKey(11)
+        f1, (inf1, ov1) = sharded_broadcast_scan(
+            broadcast_init(cfg), key, cfg, 60, _mesh(4)
+        )
+        f2, (inf2, ov2) = sharded_broadcast_scan(
+            broadcast_init(cfg), key, cfg, 60, _mesh(4), "ring"
+        )
+        np.testing.assert_array_equal(np.asarray(inf1), np.asarray(inf2))
+        _assert_state_equal(f1, f2)
+        assert int(ov1) == int(ov2) == 0
 
 
 # ---------------------------------------------------------------------------

@@ -20,6 +20,8 @@ within 5%".  The SWIM paper (Das, Gupta, Motivala 2002, §5) predicts:
 All runs are fixed-seed, so the 5% assertions are deterministic.
 """
 
+import functools
+
 import jax
 import numpy as np
 import pytest
@@ -29,9 +31,14 @@ from consul_tpu.models.swim import SwimConfig, swim_init
 from consul_tpu.sim.engine import broadcast_scan, swim_scan
 
 
+@functools.lru_cache(maxsize=None)
 def _first_detection_periods(n: int, seeds: int, seed0: int = 0) -> np.ndarray:
     """Detection time in probe periods for ``seeds`` independent
-    universes (vmapped over the PRNG key), for one crashed subject."""
+    universes (vmapped over the PRNG key), for one crashed subject.
+
+    Cached per (n, seeds, seed0): the mean and CDF tests read the SAME
+    400-universe run, so the ~30s simulation is paid once per session
+    (the returned array is marked read-only to keep the cache safe)."""
     cfg = SwimConfig(n=n, subject=7, fail_at_tick=0)
     P = cfg.probe_interval_ticks
     steps = 30 * P
@@ -48,7 +55,9 @@ def _first_detection_periods(n: int, seeds: int, seed0: int = 0) -> np.ndarray:
     # tick, i.e. at the END of the period containing the failed probe —
     # the paper's accounting.  first_tick/P is therefore the period
     # count, starting at 1.
-    return first_tick / P
+    periods = first_tick / P
+    periods.setflags(write=False)
+    return periods
 
 
 def geometric_p(n: int) -> float:

@@ -449,6 +449,16 @@ class TestRepoGate:
         ), "ops/sortmerge.py left the linted trees"
         assert lint_paths([target]) == []
 
+    def test_ring_exchange_kernel_is_covered_and_clean(self):
+        # The Pallas ring-DMA exchange kernel is the newest traced
+        # code; pin it into the zero-violations gate by name so a
+        # future tree reshuffle can't silently drop it from LINT_TREES.
+        target = PKG_ROOT / "ops" / "ring_exchange.py"
+        assert any(
+            target.is_relative_to(tree) for tree in LINT_TREES
+        ), "ops/ring_exchange.py left the linted trees"
+        assert lint_paths([target]) == []
+
     def test_parallel_plane_is_covered_and_clean(self):
         # The sharded multi-chip plane (shard_map rounds + outbox
         # collectives) is traced code end to end; pin consul_tpu/
@@ -617,13 +627,15 @@ class TestTraceGuard:
             assert retrace_guard[name].traces <= 1
 
     @pytest.mark.single_trace(
-        entrypoints=("sharded_broadcast_scan",), max_traces=2
+        entrypoints=("sharded_broadcast_scan",), max_traces=4
     )
     def test_sharded_entrypoint_one_trace_per_mesh(self, retrace_guard):
-        # Resharding discipline: a distinct mesh is a distinct static
-        # signature (one program per D), but repeating a mesh already
-        # compiled must NOT retrace — D ∈ {1, 2} on four runs stays at
-        # exactly two programs.
+        # Resharding discipline: a distinct (mesh, exchange backend)
+        # pair is a distinct static signature (one program per combo),
+        # but repeating a combo already compiled must NOT retrace —
+        # D ∈ {1, 2} x {alltoall, ring} on eight runs stays at exactly
+        # four programs (in particular the exchange-backend flag never
+        # retraces per round or per call).
         from consul_tpu.models.broadcast import (
             BroadcastConfig,
             broadcast_init,
@@ -635,12 +647,14 @@ class TestTraceGuard:
 
         cfg = BroadcastConfig(n=64, fanout=3)
         key = jax.random.PRNGKey(0)
-        for d in (1, 2, 1, 2):
-            mesh = make_mesh(jax.devices()[:d])
-            sharded_broadcast_scan(
-                broadcast_init(cfg), key, cfg, 4, mesh
-            )
-        assert retrace_guard["sharded_broadcast_scan"].traces == 2
+        for _ in range(2):
+            for d in (1, 2):
+                mesh = make_mesh(jax.devices()[:d])
+                for exchange in ("alltoall", "ring"):
+                    sharded_broadcast_scan(
+                        broadcast_init(cfg), key, cfg, 4, mesh, exchange
+                    )
+        assert retrace_guard["sharded_broadcast_scan"].traces == 4
 
     @pytest.mark.single_trace(entrypoints=("sparse_membership_scan",))
     def test_sparse_entrypoint_holds_single_trace(self, retrace_guard):
