@@ -142,6 +142,62 @@ def _sparse_phase_times(mcfg, rounds_per_sec: float) -> dict:
     }
 
 
+def _sweep_max_u(budget_bytes: int = 16 << 30) -> dict:
+    """Max universes per chip under the J6 16 GB gate, per sweepable
+    model at its bench shape: per-universe bytes from the U=8 vs U=1
+    estimator delta (abstract traces, no device memory), then
+    max_U = (budget - fixed) / per_universe.  U is the knob that blows
+    the HBM budget first — this is the table that says how far each
+    sweep can scale before it must shard."""
+    import jax
+
+    from consul_tpu.analysis.jaxlint import estimate_peak
+    from consul_tpu.models import SparseMembershipConfig
+    from consul_tpu.models.lifeguard import LifeguardConfig
+    from consul_tpu.models.membership import MembershipConfig
+    from consul_tpu.sweep.universe import abstract_sweep_program
+
+    shapes = {
+        "swim@4096": ("swim",
+                      SwimConfig(n=4096, subject=7, fail_at_tick=0,
+                                 loss=0.05),
+                      10, ("loss",), ()),
+        "lifeguard@1024": ("lifeguard",
+                           LifeguardConfig(n=1024, subject=7,
+                                           subject_alive=False,
+                                           fail_at_tick=40, loss=0.40,
+                                           ack_late=0.15,
+                                           delivery="aggregate"),
+                           10, ("loss",), ()),
+        "membership@16k": ("membership",
+                           MembershipConfig(n=16384, loss=0.01,
+                                            profile=LAN,
+                                            fail_at=((42, 5),)),
+                           3, ("loss",), (42,)),
+        "sparse@100k": ("sparse",
+                        SparseMembershipConfig(
+                            base=MembershipConfig(n=100_000, loss=0.01,
+                                                  profile=LAN,
+                                                  fail_at=((42, 5),)),
+                            k_slots=64),
+                        3, ("base.loss",), (42,)),
+    }
+    rows = {}
+    for label, (model, cfg, steps, knobs, track) in shapes.items():
+        peaks = {}
+        for u in (1, 8):
+            fn, args = abstract_sweep_program(model, cfg, steps, u,
+                                              knobs, track)
+            peaks[u] = estimate_peak(jax.make_jaxpr(fn)(*args)).chip_bytes
+        per_u = max((peaks[8] - peaks[1]) / 7.0, 1.0)
+        fixed = max(peaks[1] - per_u, 0.0)
+        rows[label] = {
+            "per_universe_bytes": int(per_u),
+            "max_u_per_chip": int((budget_bytes - fixed) // per_u),
+        }
+    return rows
+
+
 def _run_multichip() -> dict:
     """The sharded-plane datapoint (consul_tpu/parallel/shard.py)."""
     import subprocess
@@ -370,6 +426,56 @@ def main() -> None:
 
     lifeguard = section("lifeguard_1m", _lifeguard, {})
 
+    # Universe sweeps (consul_tpu/sweep): hundreds of (seed, knob,
+    # fault) universes per compiled program.  Three numbers start the
+    # batched-throughput trajectory: universes/sec on the U=256 seed
+    # sweep (error bars from ONE program), the robustness/latency
+    # Pareto frontier from the fanout x suspicion-scale grid, and the
+    # max-U-per-chip table from jaxlint's J6 estimator (U is the knob
+    # that blows the 16 GB budget first).
+    def _sweep():
+        try:
+            import numpy as _np
+
+            from consul_tpu.sim.engine import run_sweep
+            from consul_tpu.sweep.presets import seed_sweep, tuning_grid
+
+            out = {}
+            rep = run_sweep(seed_sweep(universes=256), warmup=True)
+            fs = rep.metrics["first_suspect_ms"]
+            fs = fs[~_np.isnan(fs)]
+            out.update({
+                "sweep_universes": rep.U,
+                "sweep_n": rep.n,
+                "sweep_steps": rep.steps,
+                "universes_per_sec": round(rep.universes_per_sec, 2),
+                "sweep_rounds_per_sec_per_universe": round(
+                    rep.rounds_per_sec_per_universe, 2),
+                "sweep_rounds_per_sec_aggregate": round(
+                    rep.rounds_per_sec, 1),
+                # The error-bar payoff: first-detection stats over 256
+                # independent seed universes.
+                "first_suspect_ms_mean": round(float(fs.mean()), 1),
+                "first_suspect_ms_p95": round(
+                    float(_np.percentile(fs, 95)), 1),
+                "first_suspect_defined": int(fs.size),
+            })
+            tun = run_sweep(tuning_grid(), warmup=True)
+            frontier = tun.frontier(x="false_dead_mean",
+                                    y="detect_t90_ms")
+            out["sweep_grid_universes"] = tun.U
+            out["sweep_frontier_points"] = len(frontier)
+            out["sweep_frontier"] = frontier
+            try:
+                out["sweep_max_u_per_chip"] = _sweep_max_u()
+            except Exception as e:  # noqa: BLE001 - keep the datapoints
+                out["sweep_max_u_error"] = str(e)[:200]
+            return out
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"sweep_error": str(e)[:200]}
+
+    sweep = section("sweep", _sweep, {})
+
     # The multichip datapoint: the sharded plane across real devices,
     # or its forced-host-device validation on single-chip containers —
     # replaces the dryrun-only multichip story.
@@ -457,6 +563,7 @@ def main() -> None:
                     # multichip block is where the mesh earns its keep.
                     "nodes_per_chip": N,
                     **lifeguard,
+                    **sweep,
                     **membership,
                     **multichip,
                     **jaxlint_peaks,

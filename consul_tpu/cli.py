@@ -263,6 +263,26 @@ def build_parser() -> argparse.ArgumentParser:
                          "(consul_tpu/ops/ring_exchange.py); backends "
                          "are bit-equal")
 
+    sp = sub.add_parser(
+        "sweep", help="run a universe-sweep preset: U (seed, knob, "
+                      "fault) universes vmapped into ONE XLA program "
+                      "(consul_tpu/sweep)"
+    )
+    sp.set_defaults(fn=cmd_sweep)
+    sp.add_argument("preset", nargs="?", default="",
+                    help="preset name (see --list)")
+    sp.add_argument("--list", action="store_true", dest="list_presets",
+                    help="enumerate sweep presets and exit")
+    sp.add_argument("--universes", type=int, default=None,
+                    help="universe count U (seed presets only; grid "
+                         "presets derive U from their ladders)")
+    sp.add_argument("-seed", type=int, default=0)
+    sp.add_argument("--frontier-x", default="", dest="frontier_x",
+                    help="robustness metric of the Pareto frontier "
+                         "(default: preset-appropriate)")
+    sp.add_argument("--frontier-y", default="", dest="frontier_y",
+                    help="latency metric of the Pareto frontier")
+
     # Like the reference, version tolerates (and ignores) the global
     # client flags so scripted `cli ... -http-addr X` loops can include
     # it (sdk/testutil TestServer drives every command the same way).
@@ -1070,6 +1090,91 @@ async def cmd_sim(args) -> int:
     out = run_scenario(args.scenario, seed=args.seed,
                        devices=args.devices or None,
                        exchange=args.exchange or None)
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+async def cmd_sweep(args) -> int:
+    """Run (or enumerate) the universe-sweep presets — like ``cli
+    sim``, the JAX import stays local so every other subcommand remains
+    accelerator-free.  The summary JSON carries universes/sec, the
+    per-universe metric stats, and the robustness/latency Pareto
+    frontier when the preset defines both axes."""
+    from consul_tpu.sweep.presets import PRESETS, make_preset
+
+    if args.list_presets:
+        for name in sorted(PRESETS):
+            doc = (PRESETS[name].__doc__ or "").strip().splitlines()
+            print(f"{name:<12} {doc[0].strip() if doc else ''}")
+        return 0
+    if not args.preset:
+        print("Error: preset name required (or --list)", file=sys.stderr)
+        return 1
+    universe = make_preset(args.preset, universes=args.universes,
+                           seed=args.seed)
+
+    # Explicitly requested axes are validated against the entrypoint's
+    # static metric superset (frontier.ENTRYPOINT_METRICS) BEFORE the
+    # sweep runs — a typo must not cost a multi-minute batched
+    # program.  Only the DEFAULT axes may fall back silently when a
+    # preset doesn't define them.
+    from consul_tpu.sweep.frontier import ENTRYPOINT_METRICS
+
+    known = ENTRYPOINT_METRICS[universe.entrypoint]
+    for requested in (args.frontier_x, args.frontier_y):
+        if requested and requested not in known:
+            print(
+                f"Error: unknown frontier metric {requested!r} for "
+                f"{universe.entrypoint!r} sweeps "
+                f"(have: {', '.join(sorted(known))})",
+                file=sys.stderr,
+            )
+            return 1
+    from consul_tpu.sim.engine import run_sweep
+
+    # No warmup run: the CLI's deliverable is the study summary, not a
+    # steady-state timing number (bench.py pays the warm second call
+    # where universes_per_sec is the metric) — don't silently double
+    # the wall-clock of a multi-minute sweep.
+    report = run_sweep(universe, warmup=False)
+    out = report.summary()
+    import numpy as np
+
+    def _defined(name):
+        return name in report.metrics and not np.all(
+            np.isnan(np.asarray(report.metrics[name], np.float64))
+        )
+
+    fx = args.frontier_x or (
+        "false_dead_mean" if _defined("false_dead_mean") else ""
+    )
+    fy = args.frontier_y or (
+        "detect_t90_ms" if _defined("detect_t90_ms")
+        else "first_suspect_ms"
+    )
+    if fx and _defined(fx) and _defined(fy):
+        out["frontier"] = report.frontier(x=fx, y=fy)
+        out["frontier_axes"] = [fx, fy]
+    elif args.frontier_x or args.frontier_y:
+        # An EXPLICIT axis request is never silently dropped: say which
+        # half of the pair this study failed to provide.  _defined
+        # catches both an absent key and an emitted-but-all-NaN metric
+        # (e.g. false_dead_mean when the subject crashes at tick 0) —
+        # either would otherwise read as "no Pareto points".
+        bad = next((m for m in (fx, fy) if m and not _defined(m)), None)
+        what = (
+            f"metric {bad!r} is not defined for this study"
+            if bad else
+            "no robustness axis is defined for this study "
+            "(pass --frontier-x)"
+        )
+        have = [m for m in sorted(report.metrics) if _defined(m)]
+        print(
+            f"Error: cannot build the requested frontier: {what} "
+            f"(defined: {', '.join(have)})",
+            file=sys.stderr,
+        )
+        return 1
     print(json.dumps(out, indent=2, default=str))
     return 0
 

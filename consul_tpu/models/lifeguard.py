@@ -133,7 +133,8 @@ def lifeguard_round(
 
     # Fault environment this tick (all pure in (tick, key)).
     loss_t = combine_loss(
-        jnp.float32(cfg.loss), extra_loss_at(cfg.faults, t)
+        # asarray: cfg.loss may be a traced per-universe knob.
+        jnp.asarray(cfg.loss, jnp.float32), extra_loss_at(cfg.faults, t)
     )                                             # f32 scalar
     send_ok = degraded_send_ok(cfg.faults, n)     # f32[n], folds to const
     online = online_mask(cfg.faults, k_churn, t, n)
@@ -267,7 +268,8 @@ def lifeguard_round(
     # score-0 observer (and always with lifeguard off) it is a failure.
     k_hard, k_late = jax.random.split(k_pfail)
     p_late = combine_loss(
-        jnp.float32(cfg.ack_late), degraded_late(cfg.faults, n)
+        # asarray: ack_late is a sweepable rate knob.
+        jnp.asarray(cfg.ack_late, jnp.float32), degraded_late(cfg.faults, n)
     )
     ack_is_late = jax.random.uniform(k_late, (n,)) < p_late
     rescued = jnp.bool_(cfg.lifeguard) & (state.awareness >= 1)
@@ -368,7 +370,8 @@ def lifeguard_round(
         timeout_ticks = jnp.maximum(
             timeout_ticks,
             awareness_scaled_timeout(
-                jnp.float32(lo), awareness.astype(jnp.float32)
+                # asarray: lo carries suspicion_scale, a sweepable knob.
+                jnp.asarray(lo, jnp.float32), awareness.astype(jnp.float32)
             ),
         )
     elapsed = (t - suspect_since).astype(jnp.float32)

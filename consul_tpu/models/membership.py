@@ -117,6 +117,10 @@ class MembershipConfig:
     probe_enabled: bool = True            # off = anti-entropy-only studies
     push_pull_enabled: bool = True
     leave_grace_ticks: int = 10           # leaver keeps gossiping this long
+    # Suspicion-timeout bounds multiplier (see SwimConfig
+    # .suspicion_scale): rate-like, sweepable as a traced per-universe
+    # scalar; 1.0 is bit-identical to the unscaled reference bounds.
+    suspicion_scale: float = 1.0
 
     def __post_init__(self):
         if self.fanout is None:
@@ -153,7 +157,8 @@ class MembershipConfig:
             self.profile.probe_interval_ms,
         )
         g = self.profile.gossip_interval_ms
-        return lo_ms / g, hi_ms / g
+        s = self.suspicion_scale  # may be traced (universe sweeps)
+        return lo_ms * s / g, hi_ms * s / g
 
     @property
     def probe_fail_prob_alive(self) -> float:
@@ -227,7 +232,9 @@ def _lifeguard_timeout_ticks(cfg: MembershipConfig, confirms: jax.Array) -> jax.
     lo, hi = cfg.suspicion_bounds_ticks
     k = cfg.confirmations_k
     if k < 1:
-        return jnp.full(confirms.shape, lo, jnp.float32)
+        # broadcast_to (not full): lo may be a traced scalar when
+        # suspicion_scale rides a universe sweep.
+        return jnp.broadcast_to(jnp.asarray(lo, jnp.float32), confirms.shape)
     frac = jnp.log(confirms.astype(jnp.float32) + 1.0) / math.log(k + 1.0)
     raw = hi - frac * (hi - lo)
     return jnp.maximum(jnp.ceil(raw), lo)
@@ -437,7 +444,10 @@ def membership_round(
         )
         target_up = participates[ptarget]
         p_fail = jnp.where(
-            target_up, jnp.float32(cfg.probe_fail_prob_alive), 1.0
+            # asarray: the probability derives from cfg.loss, which may
+            # be a traced per-universe knob.
+            target_up, jnp.asarray(cfg.probe_fail_prob_alive, jnp.float32),
+            1.0,
         )
         failed = probing & bernoulli_mask(k_pfail, (n,), p_fail)
         # Lifeguard health score: failed probes degrade, acked probes

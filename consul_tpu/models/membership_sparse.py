@@ -561,7 +561,9 @@ def sparse_membership_round(
         )
         target_up = participates[ptarget]
         p_fail = jnp.where(
-            target_up, jnp.float32(base.probe_fail_prob_alive), 1.0
+            # asarray: derives from base.loss, sweepable as a traced knob.
+            target_up, jnp.asarray(base.probe_fail_prob_alive, jnp.float32),
+            1.0
         )
         failed = probing & bernoulli_mask(k_pfail, (n,), p_fail)
         can_pend = failed & (state.probe_pending_at == NEVER)

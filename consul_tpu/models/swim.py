@@ -97,6 +97,14 @@ class SwimConfig:
     # Poissonized arrival counts (see BroadcastConfig.delivery — identical
     # reasoning; message classes here are suspect/dead/refute).
     delivery: str = "edges"
+    # Multiplies both suspicion-timeout bounds (min and max): the
+    # tunable-family knob of "Robust and Tuneable Family of Gossiping
+    # Algorithms" — larger = more refute headroom (fewer false
+    # positives), smaller = faster declarations.  Rate-like (never
+    # feeds a shape), so universe sweeps (consul_tpu/sweep) may pass a
+    # traced per-universe scalar here; 1.0 reproduces the reference
+    # bounds bit-exactly.
+    suspicion_scale: float = 1.0
 
     def __post_init__(self):
         if self.delivery not in ("edges", "aggregate"):
@@ -135,7 +143,12 @@ class SwimConfig:
             self.profile.probe_interval_ms,
         )
         g = self.profile.gossip_interval_ms
-        return lo_ms / g, hi_ms / g
+        s = self.suspicion_scale
+        # s == 1.0 multiplies exactly (IEEE), so the default bounds are
+        # bit-identical to the unscaled reference formula; a traced s
+        # (universe sweeps) turns the bounds into traced scalars that
+        # flow through the jnp timeout math below.
+        return lo_ms * s / g, hi_ms * s / g
 
     @property
     def probe_fail_prob_alive(self) -> float:
@@ -194,7 +207,11 @@ def _lifeguard_timeout_ticks(cfg: SwimConfig, confirmations: jax.Array) -> jax.A
     lo, hi = cfg.suspicion_bounds_ticks
     k = cfg.confirmations_k
     if k < 1:
-        return jnp.full_like(confirmations, lo, dtype=jnp.float32)
+        # broadcast_to (not full_like): lo may be a traced scalar when
+        # suspicion_scale rides a universe sweep.
+        return jnp.broadcast_to(
+            jnp.asarray(lo, jnp.float32), confirmations.shape
+        )
     frac = jnp.log(confirmations.astype(jnp.float32) + 1.0) / math.log(k + 1.0)
     raw = hi - frac * (hi - lo)
     # Reference floors at ms precision; a tick is coarser than a ms, so
@@ -409,7 +426,10 @@ def swim_round(state: SwimState, key: jax.Array, cfg: SwimConfig) -> SwimState:
     # Probes of a crashed subject always fail; of a live subject, fail
     # only with probe_fail_prob_alive (loss on every path).
     p_fail = jnp.where(
-        subject_dead_now, 1.0, jnp.float32(cfg.probe_fail_prob_alive)
+        subject_dead_now, 1.0,
+        # asarray (not jnp.float32): the probability is derived from
+        # cfg.loss, which may be a traced per-universe knob.
+        jnp.asarray(cfg.probe_fail_prob_alive, jnp.float32),
     )
     probe_failed = probed_f & bernoulli_mask(k_pfail, (n,), p_fail) & is_probe_tick
     # Failed probes mature into suspicion at the end of the probe cycle

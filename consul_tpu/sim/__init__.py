@@ -19,6 +19,7 @@ from consul_tpu.sim.engine import (
     run_lifeguard,
     run_membership,
     run_multidc,
+    run_sweep,
     run_swim,
     broadcast_scan,
     lifeguard_scan,
@@ -53,6 +54,7 @@ __all__ = [
     "MembershipReport",
     "run_broadcast",
     "run_multidc",
+    "run_sweep",
     "run_swim",
     "broadcast_scan",
     "multidc_scan",

@@ -55,9 +55,14 @@ from consul_tpu.sim.metrics import (
 )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
-def broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int):
-    """Run ``steps`` gossip ticks; returns (final_state, infected[steps])."""
+def _broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int):
+    """Run ``steps`` gossip ticks; returns (final_state, infected[steps]).
+
+    Unjitted impl: the public :data:`broadcast_scan` wraps it with cfg
+    and steps static; the universe-sweep plane (consul_tpu/sweep) vmaps
+    it with traced knob fields inside cfg, which a static jit argument
+    could never carry (tracers don't hash).  Same split for every scan
+    entrypoint below."""
 
     def tick(carry, k):
         nxt = broadcast_round(carry, k, cfg)
@@ -65,6 +70,9 @@ def broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int):
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
+
+
+broadcast_scan = jax.jit(_broadcast_scan, static_argnames=("cfg", "steps"))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "steps"))
@@ -85,9 +93,9 @@ def multidc_scan(state, key: jax.Array, cfg: MultiDCConfig, steps: int):
     return jax.lax.scan(tick, state, keys)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
-def swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int):
-    """Run ``steps`` ticks; returns (final_state, (suspecting, dead_known))."""
+def _swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int):
+    """Run ``steps`` ticks; returns (final_state, (suspecting, dead_known)).
+    Unjitted impl of :data:`swim_scan` (see :func:`_broadcast_scan`)."""
 
     def tick(carry, k):
         nxt = swim_round(carry, k, cfg)
@@ -100,8 +108,10 @@ def swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int):
     return jax.lax.scan(tick, state, keys)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
-def lifeguard_scan(state, key: jax.Array, cfg, steps: int):
+swim_scan = jax.jit(_swim_scan, static_argnames=("cfg", "steps"))
+
+
+def _lifeguard_scan(state, key: jax.Array, cfg, steps: int):
     """Run ``steps`` fault-injected ticks of the Lifeguard model;
     returns (final_state, (suspecting, dead_known, fp_events, refutes,
     mean_awareness)).
@@ -138,10 +148,11 @@ def lifeguard_scan(state, key: jax.Array, cfg, steps: int):
     return jax.lax.scan(tick, state, keys)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"),
-                   donate_argnums=(0,))
-def membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
-                    track: tuple = ()):
+lifeguard_scan = jax.jit(_lifeguard_scan, static_argnames=("cfg", "steps"))
+
+
+def _membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
+                     track: tuple = ()):
     """Run ``steps`` ticks of the full-membership sim.
 
     Per tick, for each tracked subject j: how many OTHER nodes view j
@@ -175,6 +186,12 @@ def membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
+
+
+membership_scan = jax.jit(
+    _membership_scan, static_argnames=("cfg", "steps", "track"),
+    donate_argnums=(0,),
+)
 
 
 def _timed(make_state, scan_fn, key, cfg, steps, warmup: bool):
@@ -359,10 +376,8 @@ def run_membership(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps", "track"),
-                   donate_argnums=(0,))
-def sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
-                           track: tuple = ()):
+def _sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
+                            track: tuple = ()):
     """Sparse-model twin of :func:`membership_scan`: per tracked subject
     j, how many observers hold a SUSPECT / DEAD slot for j, plus the
     global suspect-slot count and mean known-membership size.
@@ -419,6 +434,12 @@ def sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
+
+
+sparse_membership_scan = jax.jit(
+    _sparse_membership_scan, static_argnames=("cfg", "steps", "track"),
+    donate_argnums=(0,),
+)
 
 
 def run_membership_sparse(
@@ -506,6 +527,43 @@ def run_lifeguard(
         mean_awareness=np.asarray(aware),
         wall_s=wall,
     )
+
+
+def run_sweep(universe, warmup: bool = True):
+    """Run a universe sweep (consul_tpu/sweep): ONE jitted program
+    advances all U universes — stacked carries, per-universe PRNG keys,
+    knob values as vmapped [U] arrays — and the stacked per-tick
+    counters reduce host-side into a SweepReport (FP rate, flaps,
+    detection-latency quantiles, Pareto frontier).
+
+    The sweep program is cached per (entrypoint, U) — both positional-
+    static, like every engine entrypoint — so repeated calls with new
+    seeds or knob VALUES never retrace.  The stacked carry is donated
+    (same J3 rationale as membership_scan: at U x state it dominates
+    the footprint).  U=1 is bit-equal to the unbatched entrypoint.
+    """
+    # Lazy: sweep imports this module's unjitted scan impls.
+    from consul_tpu.sweep.frontier import summarize_sweep
+    from consul_tpu.sweep.universe import make_sweep, stacked_init
+
+    sweep = make_sweep(universe.entrypoint, universe.U)
+    keys = universe.keys()
+    values = universe.knob_arrays()
+
+    def call():
+        return sweep(
+            stacked_init(universe), keys, values, universe.cfg,
+            universe.steps, universe.knobs, universe.track,
+        )
+
+    if warmup:
+        _, outs = call()
+        jax.tree_util.tree_map(np.asarray, outs)
+    t0 = time.perf_counter()
+    _final, outs = call()
+    outs = jax.tree_util.tree_map(np.asarray, outs)
+    wall = time.perf_counter() - t0
+    return summarize_sweep(universe, outs, wall)
 
 
 def run_swim(
@@ -742,4 +800,57 @@ def jaxlint_registry(include=("small", "big"),
                 ),
                 3, (42,),
             )
+
+    # Universe-sweep twins (consul_tpu/sweep): the vmapped programs at
+    # U in {1, 8}, each with a live rate knob so every zero-findings
+    # gate walks the traced knob-rebuild path, not just the batching.
+    # U is the knob that blows the J6 budget first — the big set pins
+    # the batched sparse footprint at 100k nodes so the estimator's
+    # ~linear-in-U scaling (and the max-U-per-chip table it implies)
+    # stays measured.
+    from consul_tpu.sweep.universe import abstract_sweep_program
+
+    def add_sweep(tag: str, model: str, cfg, steps: int, U: int,
+                  knobs: tuple, track: tuple, n: int) -> None:
+        def build(model=model, cfg=cfg, steps=steps, U=U, knobs=knobs,
+                  track=track):
+            return abstract_sweep_program(model, cfg, steps, U, knobs,
+                                          track)
+
+        programs[f"sweep_{model}@{tag}/U{U}"] = SimProgram(
+            name=f"sweep_{model}@{tag}/U{U}", entrypoint="sweep_scan",
+            build=build, n=n,
+        )
+
+    if "small" in include:
+        sw_small = (
+            ("swim", SwimConfig(n=64, subject=1, loss=0.05), 8,
+             ("loss",), (), 64),
+            ("lifeguard", LifeguardConfig(n=64, subject=1,
+                                          subject_alive=True), 8,
+             ("loss", "ack_late"), (), 64),
+            ("broadcast", BroadcastConfig(n=64, fanout=3,
+                                          delivery="edges"), 8,
+             ("loss",), (), 64),
+            ("membership", MembershipConfig(n=48, loss=0.05,
+                                            fail_at=((3, 2),)), 8,
+             ("loss", "suspicion_scale"), (3,), 48),
+            ("sparse", SparseMembershipConfig(
+                base=MembershipConfig(n=48, loss=0.05,
+                                      fail_at=((3, 2),)),
+                k_slots=8), 8,
+             ("base.loss",), (3,), 48),
+        )
+        for model, cfg, steps, knobs, track, n in sw_small:
+            for u in (1, 8):
+                add_sweep("small", model, cfg, steps, u, knobs, track, n)
+    if "big" in include:
+        scfg100k = SparseMembershipConfig(
+            base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),)),
+            k_slots=64,
+        )
+        for u in (1, 8):
+            add_sweep("100k", "sparse", scfg100k, 3, u,
+                      ("base.loss",), (42,), 100_000)
     return programs

@@ -36,13 +36,31 @@ import jax
 import jax.numpy as jnp
 
 
+def _concrete(x) -> bool:
+    """True for host scalars; False for traced values (universe-sweep
+    knobs, consul_tpu/sweep): evaluation-time short-circuits and
+    validation apply only to values known before tracing."""
+    return isinstance(x, (int, float, bool))
+
+
+def _static_zero(x) -> bool:
+    """Statically known to contribute nothing — safe to skip at trace
+    time.  A traced value is never skipped (its run-time value decides)."""
+    return _concrete(x) and x <= 0.0
+
+
 @dataclasses.dataclass(frozen=True)
 class LossRamp:
     """Piecewise-constant extra loss: ``pieces`` is a sorted tuple of
     (start_tick, loss); loss is 0 before the first piece and each piece
-    holds until the next one starts (the last piece holds forever)."""
+    holds until the next one starts (the last piece holds forever).
+
+    ``scale`` multiplies every piece's loss (clipped back to [0, 1]) —
+    the severity knob of a fault-matrix sweep: one static ramp shape,
+    a per-universe traced severity."""
 
     pieces: tuple[tuple[int, float], ...]
+    scale: float = 1.0
 
     def __post_init__(self):
         starts = [s for s, _ in self.pieces]
@@ -51,6 +69,8 @@ class LossRamp:
         for _, p in self.pieces:
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"loss {p} outside [0, 1]")
+        if _concrete(self.scale) and self.scale < 0.0:
+            raise ValueError(f"scale {self.scale} must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +150,9 @@ def extra_loss_at(sched: FaultSchedule, tick: jax.Array) -> jax.Array:
         losses = jnp.asarray(
             [0.0] + [p for _, p in ramp.pieces], jnp.float32
         )
+        losses = jnp.clip(
+            losses * jnp.asarray(ramp.scale, jnp.float32), 0.0, 1.0
+        )
         idx = jnp.searchsorted(starts, tick, side="right")
         keep = keep * (1.0 - losses[idx])
     return 1.0 - keep
@@ -152,7 +175,7 @@ def degraded_send_ok(sched: FaultSchedule, n: int) -> jax.Array:
     A node in several DegradedSets drops independently per set."""
     ok = jnp.ones((n,), jnp.float32)
     for d in sched.degraded:
-        if d.frac <= 0.0:
+        if _static_zero(d.frac):
             continue
         ok = ok * jnp.where(_members(d, n), 1.0 - d.drop, 1.0)
     return ok
@@ -162,7 +185,7 @@ def degraded_mask(sched: FaultSchedule, n: int) -> jax.Array:
     """bool[n]: nodes degraded by ANY set (for reporting)."""
     mask = jnp.zeros((n,), bool)
     for d in sched.degraded:
-        if d.frac <= 0.0:
+        if _static_zero(d.frac):
             continue
         mask = mask | _members(d, n)
     return mask
@@ -174,7 +197,7 @@ def degraded_late(sched: FaultSchedule, n: int) -> jax.Array:
     late processes across sets combine like drops."""
     keep = jnp.ones((n,), jnp.float32)
     for d in sched.degraded:
-        if d.frac <= 0.0 or d.late <= 0.0:
+        if _static_zero(d.frac) or _static_zero(d.late):
             continue
         keep = keep * jnp.where(_members(d, n), 1.0 - d.late, 1.0)
     return 1.0 - keep
@@ -191,7 +214,10 @@ def partition_severity_at(partition: Partition, tick: jax.Array) -> jax.Array:
     """float32 scalar: the partition's drop severity at ``tick`` (0
     outside its window — healed)."""
     active = (tick >= partition.start) & (tick < partition.heal)
-    return jnp.where(active, jnp.float32(partition.severity), 0.0)
+    # asarray: severity is a sweepable per-universe knob.
+    return jnp.where(
+        active, jnp.asarray(partition.severity, jnp.float32), 0.0
+    )
 
 
 def edge_block_prob(

@@ -1,0 +1,51 @@
+"""Universe sweeps: the whole protocol family as ONE XLA program.
+
+Every study used to run one (seed, config, fault schedule) per
+compiled program; this package wraps the scan entrypoints of
+``consul_tpu.sim.engine`` in ``jax.vmap`` over a leading *universe*
+axis of size U, so one jitted program advances hundreds of universes
+concurrently — seeds for real error bars, protocol knobs (probe
+fanout, suspicion-timeout scale, loss) for tuning curves, and
+fault-schedule severities for coverage matrices.  "Robust and
+Tuneable Family of Gossiping Algorithms" (PAPERS.md) is the blueprint:
+map the tunable family in one sweep and publish the
+robustness/latency frontier.
+
+  universe.py   the :class:`Universe` spec (per-universe PRNG keys,
+                vmapped-array knobs vs positional-static structure,
+                stacked fault-schedule severities) and
+                :func:`make_sweep` — one compiled program per
+                (entrypoint, U), knob *values* never retrace
+  frontier.py   per-universe metric reduction into a
+                :class:`SweepReport` + Pareto-frontier extraction
+  presets.py    seed sweeps, knob grids, fault-severity matrices
+"""
+
+from consul_tpu.sweep.universe import (
+    SWEEP_ENTRYPOINTS,
+    Universe,
+    apply_knobs,
+    make_sweep,
+    stacked_init,
+    validate_knob,
+)
+from consul_tpu.sweep.frontier import (
+    SweepReport,
+    pareto_mask,
+    summarize_sweep,
+)
+from consul_tpu.sweep.presets import PRESETS, make_preset
+
+__all__ = [
+    "SWEEP_ENTRYPOINTS",
+    "Universe",
+    "apply_knobs",
+    "make_sweep",
+    "stacked_init",
+    "validate_knob",
+    "SweepReport",
+    "pareto_mask",
+    "summarize_sweep",
+    "PRESETS",
+    "make_preset",
+]

@@ -1,0 +1,147 @@
+"""Sweep presets: seed sweeps, knob grids, fault-severity matrices.
+
+Each preset is a factory returning a :class:`Universe`; ``cli sweep
+--preset NAME`` and bench.py's sweep section run them through
+``sim.engine.run_sweep``.  Three families:
+
+  seeds4k      U independent seeds of the flagship swim crash study —
+               real error bars on first-detection time from ONE
+               compiled program (the acceptance sweep: U=256 at
+               n=4096, per-node dense state).
+  tuning       the fanout × suspicion-scale Lifeguard grid: the
+               "Robust and Tuneable Family of Gossiping Algorithms"
+               experiment — every grid point is one universe, and the
+               Pareto frontier over (fp_rate, detection latency) is
+               the published tuning curve.
+  faultmatrix  severity ladders of the three fault primitives
+               (LossRamp scale × DegradedSet drop × Partition
+               severity) crossed into a coverage matrix over the
+               Lifeguard FP study.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+from consul_tpu.models.lifeguard import LifeguardConfig
+from consul_tpu.models.swim import SwimConfig
+from consul_tpu.sim.faults import (
+    DegradedSet,
+    FaultSchedule,
+    LossRamp,
+    Partition,
+)
+from consul_tpu.sweep.universe import Universe
+
+
+def seed_sweep(universes=None, seed=0, n=4096, steps=60,
+               loss=0.05) -> Universe:
+    """U-seed error-bar sweep of the swim crash study (exact edges
+    delivery): one batched program, U first-detection samples.  The
+    per-universe keys fold one base key in per universe index
+    (prefix-stable), so U=64 reads the same universes as the first 64
+    of U=256."""
+    cfg = SwimConfig(n=n, subject=7, fail_at_tick=0, loss=loss,
+                     delivery="edges")
+    return Universe(
+        entrypoint="swim", cfg=cfg, steps=steps,
+        split_from=seed,
+        universes=256 if universes is None else universes,
+    )
+
+
+def tuning_grid(universes=None, seed=0, n=1024,
+                fanouts=(2, 3, 4, 6), scales=(0.05, 0.15, 0.5, 1.5),
+                loss=0.40, ack_late=0.15, fail_at=120,
+                steps=None) -> Universe:
+    """Fanout × suspicion-scale Lifeguard grid: a crash study under
+    heavy loss and WAN tail latency, so every universe yields BOTH a
+    robustness cost (false-DEAD views of the still-live subject before
+    the crash — sub-1.0 scales expire suspicions before the delayed
+    refutes land) and a detection latency (after it) — the two
+    frontier axes.  Aggregate delivery: fanout enters as a Poisson
+    rate, which is what makes it sweepable at all (see validate_knob).
+    One shared seed across the grid isolates the knob effect."""
+    if universes is not None:
+        raise ValueError(
+            "tuning is a grid preset: U = len(fanouts) x len(scales), "
+            "not --universes"
+        )
+    cfg = LifeguardConfig(
+        n=n, subject=7, subject_alive=False, fail_at_tick=fail_at,
+        loss=loss, ack_late=ack_late, delivery="aggregate",
+    )
+    if steps is None:
+        # Enough horizon for the slowest universe to declare the
+        # subject dead: crash tick + the max-scaled minimum suspicion
+        # bound (confirmations drive the timeout toward the minimum)
+        # plus one unscaled bound of dissemination margin.
+        lo, _hi = cfg.suspicion_bounds_ticks
+        steps = (fail_at + int(math.ceil(lo * max(scales)))
+                 + int(math.ceil(lo)) + 60)
+    grid = list(itertools.product(fanouts, scales))
+    return Universe(
+        entrypoint="lifeguard", cfg=cfg, steps=steps,
+        # One shared key: universes differ ONLY in their knob point, so
+        # the grid isolates the knob effect from sampling noise.
+        seeds=(seed,) * len(grid),
+        knobs=("profile.gossip_nodes", "suspicion_scale"),
+        values=(
+            tuple(f for f, _ in grid),
+            tuple(s for _, s in grid),
+        ),
+    )
+
+
+def fault_matrix(universes=None, seed=0, n=192, steps=80,
+                 rungs=(0.0, 0.45, 0.9)) -> Universe:
+    """Severity coverage matrix: a static fault-schedule SHAPE (one
+    loss ramp, one degraded set, one partition) whose severities ride
+    as per-universe knobs — every (ramp, drop, partition) rung
+    combination is one universe of the Lifeguard FP study."""
+    if universes is not None:
+        raise ValueError(
+            "faultmatrix is a grid preset: U = len(rungs)^3, not "
+            "--universes"
+        )
+    faults = FaultSchedule(
+        ramps=(LossRamp(pieces=((10, 0.35),)),),
+        degraded=(DegradedSet(frac=0.12, drop=0.5, late=0.25, seed=1),),
+        partitions=(Partition(start=20, heal=45, segments=2,
+                              severity=0.5),),
+    )
+    cfg = LifeguardConfig(
+        n=n, subject=7, subject_alive=True, loss=0.02, ack_late=0.05,
+        delivery="aggregate", faults=faults,
+    )
+    grid = list(itertools.product(rungs, repeat=3))
+    return Universe(
+        entrypoint="lifeguard", cfg=cfg, steps=steps,
+        seeds=(seed,) * len(grid),
+        knobs=(
+            "faults.ramps[0].scale",
+            "faults.degraded[0].drop",
+            "faults.partitions[0].severity",
+        ),
+        values=tuple(
+            tuple(g[i] for g in grid) for i in range(3)
+        ),
+    )
+
+
+PRESETS: dict = {
+    "seeds4k": seed_sweep,
+    "tuning": tuning_grid,
+    "faultmatrix": fault_matrix,
+}
+
+
+def make_preset(name: str, universes=None, seed: int = 0) -> Universe:
+    """Build a preset's Universe (``--universes`` overrides U for seed
+    presets; grid presets derive U from their ladders and reject it)."""
+    if name not in PRESETS:
+        raise ValueError(
+            f"unknown sweep preset {name!r} (have: {sorted(PRESETS)})"
+        )
+    return PRESETS[name](universes=universes, seed=seed)

@@ -1,0 +1,470 @@
+"""The :class:`Universe` spec and the batched-scan builder.
+
+A universe is one (seed, knob values, fault severities) point of a
+study family.  The spec splits a swept configuration into
+
+  * positional-static structure — the base config object, steps, the
+    tracked-subject tuple, and the entrypoint/U choice: anything that
+    feeds array shapes or trace-time constants.  These hash into the
+    jit cache exactly like the unswept entrypoints (the established
+    positional-static-args discipline), so one program serves every
+    knob value.
+  * vmapped-array knobs — rate-like config fields (loss,
+    suspicion_scale, ack_late, aggregate-mode fanout, fault-schedule
+    severities) passed as [U] arrays and rebuilt into per-universe
+    config objects INSIDE the trace via :func:`apply_knobs`.  The
+    models consume them through ordinary jnp arithmetic, so a traced
+    scalar flows where a Python float used to fold.
+  * per-universe PRNG keys — an explicit seed tuple (U independent
+    PRNGKeys; U=1 with seed s is bit-equal to the unbatched run at
+    seed s) or a ``split_from`` base key folded in per universe
+    (prefix-stable: the first U keys of a larger sweep are identical).
+
+The config-stacking footgun is rejected loudly: a field that feeds
+shapes (n, k_slots, piggyback, profile tick counts, ...) silently
+vmapped would retrace per universe — :func:`validate_knob` refuses it
+with the reason, at :class:`Universe` construction time, never at
+trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.models.broadcast import broadcast_init
+from consul_tpu.models.membership import membership_init
+from consul_tpu.models.swim import swim_init
+from consul_tpu.sim import engine
+
+# Final-field names that feed array shapes or trace-time structure
+# anywhere in the model family: vmapping one of these would compile a
+# distinct program per universe (or crash at trace time), so the
+# validator rejects them by name with the reason.
+_SHAPE_FIELDS = frozenset({
+    # array extents / budgets
+    "n", "k_slots", "piggyback", "stage_width", "segments", "seg_size",
+    "bridges_per_segment", "indirect_checks", "udp_buffer_size",
+    "event_buffer_size", "query_buffer_size", "max_user_event_size",
+    # schedule structure (host-validated scatter indices)
+    "fail_at", "leave_at", "join_at", "pieces", "subject",
+    "fail_at_tick", "start", "heal", "end", "seed", "leave_grace_ticks",
+    # trace-time constants and branch selectors
+    "delivery", "profile", "base", "faults", "lifeguard",
+    "subject_alive", "probe_enabled", "push_pull_enabled", "name",
+    "probe_interval_ms", "probe_timeout_ms", "gossip_interval_ms",
+    "push_pull_interval_ms", "gossip_to_the_dead_ms",
+    "suspicion_mult", "suspicion_max_timeout_mult",
+    "awareness_max_multiplier", "retransmit_mult",
+})
+
+# Fault-schedule severity fields sweepable through "faults.…" paths
+# (sim/faults.py evaluators consume them as jnp arithmetic).
+_FAULT_KNOB_FIELDS = frozenset({
+    "drop", "late", "frac", "severity", "p_offline", "scale",
+})
+
+# Knobs that are integer-valued in the models (transmission counts);
+# everything else stacks as float32.
+_INT_KNOB_FIELDS = frozenset({"fanout", "gossip_nodes"})
+
+
+@dataclasses.dataclass(frozen=True)
+class _EntrypointSpec:
+    """One sweepable scan entrypoint: its init, its unjitted impl, and
+    the knob paths legal for it."""
+
+    name: str
+    init: Callable[[Any], Any]
+    call: Callable  # (state, key, cfg, steps, track) -> (final, outs)
+    base_cfg: Callable[[Any], Any]  # cfg -> the profile/n-carrying config
+    knob_paths: frozenset
+    aggregate_only: frozenset  # legal only under delivery="aggregate"
+    fault_paths: bool = False  # "faults.…" severity paths legal
+
+
+def _sparse_init(cfg):
+    from consul_tpu.models.membership_sparse import sparse_membership_init
+
+    return sparse_membership_init(cfg)
+
+
+def _lifeguard_init(cfg):
+    from consul_tpu.models.lifeguard import lifeguard_init
+
+    return lifeguard_init(cfg)
+
+
+SWEEP_ENTRYPOINTS: dict = {
+    "swim": _EntrypointSpec(
+        name="swim",
+        init=swim_init,
+        call=lambda s, k, c, steps, track: engine._swim_scan(s, k, c, steps),
+        base_cfg=lambda c: c,
+        knob_paths=frozenset({"loss", "suspicion_scale"}),
+        aggregate_only=frozenset({"profile.gossip_nodes"}),
+    ),
+    "lifeguard": _EntrypointSpec(
+        name="lifeguard",
+        init=_lifeguard_init,
+        call=lambda s, k, c, steps, track: engine._lifeguard_scan(
+            s, k, c, steps),
+        base_cfg=lambda c: c,
+        knob_paths=frozenset({"loss", "suspicion_scale", "ack_late"}),
+        aggregate_only=frozenset({"profile.gossip_nodes"}),
+        fault_paths=True,
+    ),
+    "broadcast": _EntrypointSpec(
+        name="broadcast",
+        init=lambda cfg: broadcast_init(cfg, origin=0),
+        call=lambda s, k, c, steps, track: engine._broadcast_scan(
+            s, k, c, steps),
+        base_cfg=lambda c: c,
+        knob_paths=frozenset({"loss"}),
+        aggregate_only=frozenset({"fanout"}),
+    ),
+    "membership": _EntrypointSpec(
+        name="membership",
+        init=membership_init,
+        call=lambda s, k, c, steps, track: engine._membership_scan(
+            s, k, c, steps, track),
+        base_cfg=lambda c: c,
+        knob_paths=frozenset({"loss", "suspicion_scale"}),
+        aggregate_only=frozenset(),
+    ),
+    "sparse": _EntrypointSpec(
+        name="sparse",
+        init=_sparse_init,
+        call=lambda s, k, c, steps, track: engine._sparse_membership_scan(
+            s, k, c, steps, track),
+        base_cfg=lambda c: c.base,
+        knob_paths=frozenset({"base.loss", "base.suspicion_scale"}),
+        aggregate_only=frozenset(),
+    ),
+}
+
+
+_SEGMENT_RE = re.compile(r"^([A-Za-z_][A-Za-z0-9_]*)(?:\[([0-9]+)\])?$")
+
+
+def _path_segments(path: str) -> list:
+    """'faults.degraded[0].drop' -> [('faults', None), ('degraded', 0),
+    ('drop', None)]; raises on malformed paths."""
+    segments = []
+    for raw in path.split("."):
+        m = _SEGMENT_RE.match(raw)
+        if m is None:
+            raise ValueError(f"malformed knob path segment {raw!r} in "
+                             f"{path!r}")
+        name, idx = m.group(1), m.group(2)
+        segments.append((name, None if idx is None else int(idx)))
+    return segments
+
+
+def _resolve_path(cfg, path: str):
+    """(owner object, final field name) of a knob path, validating that
+    every segment exists on the base config."""
+    segments = _path_segments(path)
+    obj = cfg
+    for name, idx in segments[:-1]:
+        if not hasattr(obj, name):
+            raise ValueError(
+                f"knob path {path!r}: {type(obj).__name__} has no field "
+                f"{name!r}"
+            )
+        obj = getattr(obj, name)
+        if idx is not None:
+            if idx >= len(obj):
+                raise ValueError(
+                    f"knob path {path!r}: index [{idx}] out of range "
+                    f"(len {len(obj)})"
+                )
+            obj = obj[idx]
+    final, fidx = segments[-1]
+    if fidx is not None:
+        raise ValueError(
+            f"knob path {path!r} must end on a field, not an index"
+        )
+    if not hasattr(obj, final):
+        raise ValueError(
+            f"knob path {path!r}: {type(obj).__name__} has no field "
+            f"{final!r}"
+        )
+    return obj, final
+
+
+def _replace_path(obj, segments, value):
+    """Functional update of a nested frozen-dataclass/tuple path."""
+    (name, idx), rest = segments[0], segments[1:]
+    cur = getattr(obj, name)
+    if idx is None:
+        new = value if not rest else _replace_path(cur, rest, value)
+        return dataclasses.replace(obj, **{name: new})
+    item = cur[idx]
+    new_item = value if not rest else _replace_path(item, rest, value)
+    return dataclasses.replace(
+        obj, **{name: cur[:idx] + (new_item,) + cur[idx + 1:]}
+    )
+
+
+def apply_knobs(cfg, knobs: tuple, values: tuple):
+    """Rebuild ``cfg`` with each knob path set to its (possibly traced)
+    per-universe value — called INSIDE the vmapped trace, one scalar
+    per knob per universe."""
+    for path, value in zip(knobs, values):
+        cfg = _replace_path(cfg, _path_segments(path), value)
+    return cfg
+
+
+def knob_dtype(path: str):
+    """Stacking dtype of a knob: int32 for transmission-count knobs
+    (fanout), float32 for every rate."""
+    final = _path_segments(path)[-1][0]
+    return jnp.int32 if final in _INT_KNOB_FIELDS else jnp.float32
+
+
+def validate_knob(entrypoint: str, cfg, path: str) -> None:
+    """Reject non-sweepable knob paths loudly, at Universe construction
+    time.
+
+    The config-stacking footgun this guards: a field that feeds array
+    shapes or trace-time structure (n, k_slots, piggyback, profile tick
+    counts, schedule tuples, …), silently vmapped, would compile a
+    distinct program per universe — the exact retrace explosion the
+    sweep exists to avoid.  Rate-like fields (loss, suspicion_scale,
+    ack_late, fault severities, aggregate-mode fanout) are the
+    sweepable family.
+    """
+    spec = SWEEP_ENTRYPOINTS[entrypoint]
+    owner, final = _resolve_path(cfg, path)
+    base = spec.base_cfg(cfg)
+    # Dense/sparse membership gossip is always the exact per-message
+    # scatter, i.e. edges-shaped.
+    delivery = getattr(base, "delivery", "edges")
+    allowed = set(spec.knob_paths)
+    if delivery == "aggregate":
+        allowed |= set(spec.aggregate_only)
+
+    if path in allowed:
+        return
+    if spec.fault_paths and path.startswith("faults.") and (
+        final in _FAULT_KNOB_FIELDS
+    ):
+        return
+    if path in spec.aggregate_only or final in _INT_KNOB_FIELDS:
+        if spec.aggregate_only:
+            if delivery == "aggregate":
+                # Already in aggregate mode: the PATH is wrong, not the
+                # mode — name the knob that enters as a rate.
+                raise ValueError(
+                    f"knob {path!r} is not the aggregate-mode "
+                    f"transmission knob for {entrypoint!r}; fanout "
+                    "enters as a Poisson arrival rate only via "
+                    f"{sorted(spec.aggregate_only)}"
+                )
+            raise ValueError(
+                f"knob {path!r} feeds the [n, fanout] gossip-target "
+                f"shapes under delivery={delivery!r}; fanout is only "
+                "sweepable under delivery='aggregate', where it enters "
+                "as a Poisson arrival rate via "
+                f"{sorted(spec.aggregate_only)}"
+            )
+        # Dense/sparse membership has no aggregate mode — don't send
+        # the user hunting for a config field that doesn't exist.
+        raise ValueError(
+            f"knob {path!r} feeds the [n, fanout] gossip-target "
+            f"shapes; transmission-count knobs are not sweepable for "
+            f"{entrypoint!r} (sweepable: {sorted(allowed)})"
+        )
+    if final in _SHAPE_FIELDS:
+        raise ValueError(
+            f"knob {path!r}: field {final!r} of {type(owner).__name__} "
+            "feeds array shapes or trace-time structure; a vmapped "
+            "sweep over it would retrace per universe — sweep "
+            "rate-like knobs instead (sweepable for "
+            f"{entrypoint!r}: {sorted(allowed)}"
+            + (", faults.*.{%s}" % "/".join(sorted(_FAULT_KNOB_FIELDS))
+               if spec.fault_paths else "") + ")"
+        )
+    raise ValueError(
+        f"unknown or unsweepable knob {path!r} for entrypoint "
+        f"{entrypoint!r} (sweepable: {sorted(allowed)})"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Universe:
+    """Positional-static structure + per-universe axes of one sweep.
+
+    ``seeds`` stacks one independent PRNGKey per universe (U = len);
+    ``split_from``/``universes`` instead folds one base key in per
+    universe (prefix-stable, the error-bar mode).  ``values`` carries one
+    U-tuple per knob path in ``knobs``; every path is validated against
+    the shape-feeding denylist at construction.
+    """
+
+    entrypoint: str
+    cfg: Any
+    steps: int
+    seeds: tuple = ()
+    split_from: Optional[int] = None
+    universes: int = 0
+    knobs: tuple = ()
+    values: tuple = ()   # one U-length tuple of scalars per knob
+    track: tuple = ()
+
+    def __post_init__(self):
+        if self.entrypoint not in SWEEP_ENTRYPOINTS:
+            raise ValueError(
+                f"unknown sweep entrypoint {self.entrypoint!r} "
+                f"(have: {sorted(SWEEP_ENTRYPOINTS)})"
+            )
+        if (self.split_from is None) == (not self.seeds):
+            raise ValueError(
+                "exactly one of seeds=(…) or split_from=/universes= "
+                "must be given"
+            )
+        if self.seeds and self.universes:
+            raise ValueError(
+                f"seeds= fixes U=len(seeds)={len(self.seeds)}; "
+                f"universes={self.universes} would be silently ignored "
+                "— pass exactly one seed mode"
+            )
+        if self.split_from is not None and self.universes < 1:
+            raise ValueError("universes must be >= 1 with split_from")
+        if len(self.knobs) != len(self.values):
+            raise ValueError(
+                f"{len(self.knobs)} knobs but {len(self.values)} value "
+                "rows"
+            )
+        if len(set(self.knobs)) != len(self.knobs):
+            raise ValueError(f"duplicate knob paths in {self.knobs}")
+        for path, row in zip(self.knobs, self.values):
+            validate_knob(self.entrypoint, self.cfg, path)
+            if len(row) != self.U:
+                raise ValueError(
+                    f"knob {path!r} has {len(row)} values for U="
+                    f"{self.U} universes"
+                )
+        if self.track and self.entrypoint not in ("membership", "sparse"):
+            raise ValueError(
+                f"track= only applies to membership/sparse, not "
+                f"{self.entrypoint!r}"
+            )
+
+    @property
+    def U(self) -> int:
+        return len(self.seeds) if self.seeds else self.universes
+
+    def keys(self) -> jax.Array:
+        """uint32[U, 2] stacked per-universe PRNG keys.
+
+        ``split_from`` mode derives key u as ``fold_in(base, u)`` —
+        U-INDEPENDENT, so the first 64 universes of a U=256 sweep ARE
+        the U=64 sweep's universes (``jax.random.split(base, U)``
+        would not be: its keys depend on U)."""
+        if self.seeds:
+            return jnp.stack(
+                [jax.random.PRNGKey(s) for s in self.seeds]
+            )
+        base = jax.random.PRNGKey(self.split_from)
+        return jax.vmap(
+            lambda u: jax.random.fold_in(base, u)
+        )(jnp.arange(self.U, dtype=jnp.uint32))
+
+    def knob_arrays(self) -> tuple:
+        """One [U] device array per knob, at the knob's dtype."""
+        return tuple(
+            jnp.asarray(row, knob_dtype(path))
+            for path, row in zip(self.knobs, self.values)
+        )
+
+
+def stacked_init(universe: Universe):
+    """The [U, …] initial carry: the per-universe init state broadcast
+    over the universe axis.  Valid because no sweepable knob reaches an
+    init (validate_knob keeps tx-budget/shape fields static), so every
+    universe starts from the same state; the stacked copy is a real
+    buffer (donated to the sweep program)."""
+    spec = SWEEP_ENTRYPOINTS[universe.entrypoint]
+    state = spec.init(universe.cfg)
+    U = universe.U
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (U,) + x.shape), state
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_sweep(entrypoint: str, U: int):
+    """The batched scan program for (entrypoint, U) — both positional-
+    static, mirroring the engine's jit-cache discipline.
+
+    Returns ONE jitted callable per (entrypoint, U) (lru-cached, so
+    repeated calls share the jit cache and the knob *values* never
+    retrace — only a new U or entrypoint compiles a new program):
+
+        sweep(stacked_state, keys, values, cfg, steps, knobs, track)
+          -> (stacked_final, stacked_outs)
+
+    ``stacked_state`` is donated (the [U, …] carry dominates the
+    footprint exactly as the unbatched carries do — jaxlint J3);
+    ``values`` is a tuple of [U] knob arrays matching the static
+    ``knobs`` path tuple; ``cfg``/``steps``/``track`` are the static
+    structure.  Per universe, :func:`apply_knobs` rebuilds the config
+    with that universe's traced knob scalars and runs the unjitted
+    scan impl; U=1 is bit-equal to the unbatched entrypoint (pinned
+    per model in tests/test_sweep.py).
+    """
+    if entrypoint not in SWEEP_ENTRYPOINTS:
+        raise ValueError(
+            f"unknown sweep entrypoint {entrypoint!r} "
+            f"(have: {sorted(SWEEP_ENTRYPOINTS)})"
+        )
+    if U < 1:
+        raise ValueError(f"U must be >= 1, got {U}")
+    spec = SWEEP_ENTRYPOINTS[entrypoint]
+
+    def _sweep_scan(stacked_state, keys, values, cfg, steps,
+                    knobs=(), track=()):
+        if keys.shape[0] != U:
+            raise ValueError(
+                f"this sweep program is built for U={U}, got "
+                f"{keys.shape[0]} keys"
+            )
+
+        def one(state, key, vals):
+            ucfg = apply_knobs(cfg, knobs, vals)
+            return spec.call(state, key, ucfg, steps, track)
+
+        return jax.vmap(one)(stacked_state, keys, tuple(values))
+
+    _sweep_scan.__name__ = f"sweep_{entrypoint}_U{U}"
+    return jax.jit(
+        _sweep_scan, static_argnames=("cfg", "steps", "knobs", "track"),
+        donate_argnums=(0,),
+    )
+
+
+def abstract_sweep_program(entrypoint: str, cfg, steps: int, U: int,
+                           knobs: tuple = (), track: tuple = ()):
+    """(fn, abstract args) of the batched program — the jaxlint-
+    registry build shape (sim/engine.py jaxlint_registry) and the
+    bench max-U-per-chip estimator both trace it: eval_shape states,
+    zero device memory."""
+    spec = SWEEP_ENTRYPOINTS[entrypoint]
+    sweep = make_sweep(entrypoint, U)
+    state = jax.eval_shape(lambda: spec.init(cfg))
+    stacked = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct((U,) + s.shape, s.dtype), state
+    )
+    keys = jax.ShapeDtypeStruct((U, 2), jnp.uint32)
+    values = tuple(
+        jax.ShapeDtypeStruct((U,), knob_dtype(p)) for p in knobs
+    )
+    fn = lambda s, k, v: sweep(s, k, v, cfg, steps, knobs, track)  # noqa: E731
+    return fn, (stacked, keys, values)

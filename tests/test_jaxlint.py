@@ -394,12 +394,23 @@ class TestPeakEstimator:
 class TestRepoGate:
     def test_registry_covers_every_engine_entrypoint(self, small_programs):
         covered = {p.entrypoint for p in small_programs.values()}
-        assert covered == set(ENGINE_ENTRYPOINTS)
+        # The vmapped universe-sweep programs (consul_tpu/sweep) ride
+        # the registry under their own entrypoint tag.
+        assert covered == set(ENGINE_ENTRYPOINTS) | {"sweep_scan"}
 
     def test_registry_covers_sharded_d1_and_d2(self, small_programs):
         for d in (1, 2):
             for model in ("broadcast", "membership", "sparse"):
                 assert f"sharded_{model}@small/D{d}" in small_programs
+
+    def test_registry_covers_sweep_u1_and_u8(self, small_programs):
+        # Every sweepable model's vmapped program at U in {1, 8}, so
+        # the zero-findings walks above cover the batched plane (and
+        # the traced knob-rebuild path) for the whole family.
+        for model in ("swim", "lifeguard", "broadcast", "membership",
+                      "sparse"):
+            for u in (1, 8):
+                assert f"sweep_{model}@small/U{u}" in small_programs
 
     def test_small_registry_zero_findings(self, small_programs,
                                           small_traces):
@@ -483,6 +494,56 @@ class TestDonationPins:
         # 9 MembershipState leaves donated, the PRNG key not.
         assert sum(donated) == 9
         assert donated[-1] is False
+
+
+class TestSweepFootprint:
+    """J6 over the batched plane (consul_tpu/sweep): U multiplies the
+    per-universe state planes, so U is the knob that blows the 16 GB
+    gate first.  Pin the sparse@100k x U=8 footprint and the
+    estimator's ~linear-in-U scaling — the two numbers bench.py's
+    max-U-per-chip table rides on."""
+
+    N, K = 100_000, 64
+
+    def _peak_at(self, u):
+        from consul_tpu.models import SparseMembershipConfig
+        from consul_tpu.models.membership import MembershipConfig
+        from consul_tpu.protocol import LAN
+        from consul_tpu.sweep.universe import abstract_sweep_program
+
+        # The big registry's exact sparse@100k shape.
+        cfg = SparseMembershipConfig(
+            base=MembershipConfig(n=self.N, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),)),
+            k_slots=self.K,
+        )
+        fn, args = abstract_sweep_program(
+            "sparse", cfg, 3, u, ("base.loss",), (42,)
+        )
+        return estimate_peak(jax.make_jaxpr(fn)(*args)).chip_bytes
+
+    def test_batched_footprint_pinned_at_u8(self, big_traces):
+        # The registry big set carries the U in {1, 8} twins; U=8 must
+        # cost at least 7 extra copies of the five [n, K] slot planes
+        # over U=1 (the carry is the stacked state) while staying
+        # inside the 16 GB J6 budget the zero-findings gate enforces.
+        p1 = estimate_peak(big_traces["sweep_sparse@100k/U1"]).chip_bytes
+        p8 = estimate_peak(big_traces["sweep_sparse@100k/U8"]).chip_bytes
+        planes = 5 * self.N * self.K * 4
+        assert p8 - p1 >= int(0.99 * 7 * planes), (p1, p8)
+        assert p8 <= BUDGET_16GB
+
+    def test_estimator_scales_linearly_in_u(self, big_traces):
+        # Three points U in {1, 4, 8}: the U=4 peak predicted from the
+        # (U=1, U=8) line must match the traced U=4 peak within 5% —
+        # the linear model behind max_u = (budget - fixed) / per_u.
+        p1 = estimate_peak(big_traces["sweep_sparse@100k/U1"]).chip_bytes
+        p8 = estimate_peak(big_traces["sweep_sparse@100k/U8"]).chip_bytes
+        per_u = (p8 - p1) / 7.0
+        assert per_u > 0
+        p4 = self._peak_at(4)
+        predicted = p1 + 3.0 * per_u
+        assert abs(p4 - predicted) / p4 < 0.05, (p1, p4, p8, predicted)
 
 
 class TestGoldenProgramSize:

@@ -26,7 +26,7 @@ from consul_tpu.analysis import (
 PKG_ROOT = pathlib.Path(consul_tpu.__file__).resolve().parent
 LINT_TREES = [
     PKG_ROOT / "models", PKG_ROOT / "sim", PKG_ROOT / "ops",
-    PKG_ROOT / "parallel",
+    PKG_ROOT / "parallel", PKG_ROOT / "sweep",
 ]
 
 
@@ -474,6 +474,21 @@ class TestRepoGate:
             v.format() for v in violations
         )
 
+    def test_sweep_plane_is_covered_and_clean(self):
+        # The universe-sweep subsystem (vmapped batched scans + the
+        # traced knob-rebuild path) is traced code; pin consul_tpu/
+        # sweep/ into the gate BY NAME so a tree reshuffle can't
+        # silently drop the newest traced subsystem from LINT_TREES.
+        target = PKG_ROOT / "sweep"
+        assert any(
+            target == tree or target.is_relative_to(tree)
+            for tree in LINT_TREES
+        ), "consul_tpu/sweep left the linted trees"
+        violations = lint_paths([target])
+        assert violations == [], "\n".join(
+            v.format() for v in violations
+        )
+
     def test_cli_lint_clean_exits_zero(self):
         from consul_tpu.cli import build_parser
 
@@ -655,6 +670,42 @@ class TestTraceGuard:
                         broadcast_init(cfg), key, cfg, 4, mesh, exchange
                     )
         assert retrace_guard["sharded_broadcast_scan"].traces == 4
+
+    def test_sweep_builder_one_program_per_entrypoint_u(self):
+        # The universe-sweep discipline (consul_tpu/sweep): make_sweep
+        # compiles exactly ONE program per (entrypoint, U) across
+        # repeated calls — knob VALUES and seeds never retrace, only a
+        # new U (or entrypoint) does.
+        from consul_tpu.analysis.guards import TraceGuard
+        from consul_tpu.models.swim import SwimConfig
+        from consul_tpu.sweep import Universe
+        from consul_tpu.sweep.universe import make_sweep, stacked_init
+
+        cfg = SwimConfig(n=48, subject=1, loss=0.05)
+        guards = {
+            u: TraceGuard(make_sweep("swim", u), max_traces=1,
+                          name=f"sweep_swim_U{u}")
+            for u in (1, 4)
+        }
+        for seed in (0, 1):
+            for loss_base in (0.0, 0.3):
+                for u in (1, 4):
+                    uni = Universe(
+                        entrypoint="swim", cfg=cfg, steps=3,
+                        seeds=tuple(range(seed, seed + u)),
+                        knobs=("loss",),
+                        values=(tuple(loss_base + 0.01 * i
+                                      for i in range(u)),),
+                    )
+                    make_sweep("swim", u)(
+                        stacked_init(uni), uni.keys(),
+                        uni.knob_arrays(), cfg, 3, uni.knobs, (),
+                    )
+        for u, guard in guards.items():
+            guard.check()
+            assert guard.traces == 1, (u, guard.traces)
+        # make_sweep itself is the cache: same wrapper per (e, U).
+        assert make_sweep("swim", 4) is guards[4]._fn
 
     @pytest.mark.single_trace(entrypoints=("sparse_membership_scan",))
     def test_sparse_entrypoint_holds_single_trace(self, retrace_guard):
