@@ -34,9 +34,11 @@ ENGINE_ENTRYPOINTS = (
     "lifeguard_scan",
     "membership_scan",
     "sparse_membership_scan",
+    "streamcast_scan",
     "sharded_broadcast_scan",
     "sharded_membership_scan",
     "sharded_sparse_membership_scan",
+    "sharded_streamcast_scan",
 )
 
 

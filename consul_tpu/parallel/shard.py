@@ -1166,6 +1166,259 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
 
 
 # ---------------------------------------------------------------------------
+# Sharded streamcast (pipelined chunked event stream, windowed).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange"),
+    donate_argnums=(0,),
+)
+def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
+                            mesh: Mesh, exchange: str = "alltoall"):
+    """Sharded twin of ``sim.engine.streamcast_scan``: each device owns
+    ``n/D`` rows of the [n, W, E] chunk plane and the [n, W] budget
+    plane; the in-flight window (slot_event/slot_birth and every
+    counter) is REPLICATED — the allocator is a pure function of the
+    replicated arrival schedule, so all shards step it identically.
+    Edges-mode chunk messages whose receiver lives on another shard
+    ride the per-destination outbox (pack_outbox -> exchange_outbox,
+    ``exchange`` = ``"alltoall"`` | ``"ring"``); aggregate mode needs
+    only a [W, E] psum of per-class sender counts.  Returns
+    ``(final_state, (*outs, outbox_overflow))`` with the unsharded
+    scan's per-tick outs; D == 1 is bit-equal by the replicated-draw
+    discipline.
+
+    ``state`` is donated (jaxlint J3, same contract as the unsharded
+    scan): callers pass a fresh init positionally."""
+    from consul_tpu.ops import bernoulli_mask, sample_peers
+    from consul_tpu.streamcast.model import (
+        _AUX_SALT,
+        _SCHED_SALT,
+        StreamcastState,
+        _p_live,
+        arrival_arrays,
+    )
+    from consul_tpu.streamcast.window import admit, retire
+
+    n, w_slots, e_chunks = cfg.n, cfg.window, cfg.chunks
+    fanout = cfg.fanout
+    d_shards = int(mesh.devices.size)
+    blk = block_size(n, mesh)
+    budget = (
+        outbox_budget(blk * w_slots * fanout, d_shards)
+        if cfg.delivery == "edges" else 1
+    )
+
+    def tick(carry, k, sched):
+        st, ob_ov = carry
+        me = jax.lax.axis_index(NODE_AXIS)
+        start = me * blk
+        t = st.tick
+        k_sel, k_loss = jax.random.split(k)
+        k_tie, k_chunk = jax.random.split(
+            jax.random.fold_in(k, _AUX_SALT)
+        )
+        rows_l = jnp.arange(blk, dtype=jnp.int32)
+
+        # -- 1. arrivals + window admission (replicated) -------------
+        ev_tick, ev_origin, ev_name = sched
+        arrive = ev_tick == t
+        slot_event, slot_birth, filled, freed, ov, co = admit(
+            st.slot_event, st.slot_birth, arrive, ev_name, t
+        )
+        chunks = st.chunks & ~(freed | filled)[None, :, None]
+        tx_left = jnp.where((freed | filled)[None, :], 0, st.tx_left)
+        org = ev_origin[jnp.maximum(slot_event, 0)]
+        seed = filled[None, :] & (
+            (start + rows_l)[:, None] == org[None, :]
+        )
+        chunks = chunks | seed[:, :, None]
+        tx_left = jnp.where(seed, cfg.tx_limit, tx_left)
+
+        # -- 2. transmit (replicated draws, local slices) ------------
+        occ = slot_event >= 0
+        eligible = (
+            jnp.any(chunks, axis=2) & (tx_left > 0) & occ[None, :]
+        )
+        prio = jnp.where(
+            eligible, tx_left.astype(jnp.float32), -jnp.inf
+        ) + _rows(jax.random.uniform(k_tie, (n, w_slots)), start, blk)
+        # Slot-index tie-break: float32 tie draws collide at scale and
+        # would breach the chunk_budget bound (see the unsharded round).
+        widx = jnp.arange(w_slots, dtype=jnp.int32)
+        ahead = (prio[:, None, :] > prio[:, :, None]) | (
+            (prio[:, None, :] == prio[:, :, None])
+            & (widx[None, None, :] < widx[None, :, None])
+        )
+        rank = jnp.sum(ahead.astype(jnp.int32), axis=2)
+        serviced = eligible & (rank < cfg.chunk_budget)
+        g = _rows(
+            jax.random.uniform(k_chunk, (n, w_slots, e_chunks)),
+            start, blk,
+        )
+        sel = jnp.argmax(jnp.where(chunks, g, -1.0), axis=2).astype(
+            jnp.int32
+        )
+        p_live = _p_live(cfg, t)
+        dropped = jnp.int32(0)
+
+        if cfg.delivery == "edges":
+            targets = _rows(sample_peers(k_sel, n, fanout), start, blk)
+            ok = serviced[:, :, None] & _rows(
+                bernoulli_mask(
+                    k_loss, (n, w_slots, fanout), p_live
+                ),
+                start, blk,
+            )
+            recv = jnp.broadcast_to(
+                targets[:, None, :], (blk, w_slots, fanout)
+            ).ravel()
+            wix = jnp.broadcast_to(
+                jnp.arange(w_slots, dtype=jnp.int32)[None, :, None],
+                (blk, w_slots, fanout),
+            ).ravel()
+            cix = jnp.broadcast_to(
+                sel[:, :, None], (blk, w_slots, fanout)
+            ).ravel()
+            okf = ok.ravel()
+            dest = recv // blk
+            local = okf & (dest == me)
+            flat = jnp.where(
+                local,
+                ((recv - start) * w_slots + wix) * e_chunks + cix,
+                blk * w_slots * e_chunks,
+            )
+            hits = (
+                jnp.zeros((blk * w_slots * e_chunks,), jnp.bool_)
+                .at[flat].set(True, mode="drop")
+            )
+            packed, dropped = pack_outbox(
+                dest, okf & (dest != me), (recv, wix, cix),
+                d_shards, budget,
+            )
+            ib_recv, ib_w, ib_c = exchange_outbox(
+                packed, backend=exchange
+            )
+            got_in = ib_recv >= 0
+            flat_in = jnp.where(
+                got_in,
+                ((ib_recv - start) * w_slots + ib_w) * e_chunks + ib_c,
+                blk * w_slots * e_chunks,
+            )
+            hits = hits.at[flat_in].set(True, mode="drop")
+            new_chunks = chunks | hits.reshape(
+                blk, w_slots, e_chunks
+            )
+        else:
+            # Aggregate: the only cross-shard traffic is the [W, E]
+            # per-class sender count.
+            onehot = chunks & (
+                sel[:, :, None]
+                == jnp.arange(e_chunks, dtype=jnp.int32)[None, None, :]
+            )
+            contrib = (serviced[:, :, None] & onehot).astype(
+                jnp.float32
+            )
+            s_tot = jax.lax.psum(
+                jnp.sum(contrib, axis=0), NODE_AXIS
+            )
+            lam = (
+                (s_tot[None, :, :] - contrib) * fanout * p_live
+                / max(n - 1, 1)
+            )
+            u = _rows(
+                jax.random.uniform(k_loss, (n, w_slots, e_chunks)),
+                start, blk,
+            )
+            new_chunks = chunks | (u < -jnp.expm1(-lam))
+
+        sent = jax.lax.psum(
+            jnp.sum(serviced, dtype=jnp.int32), NODE_AXIS
+        ) * fanout
+        spent = jnp.where(serviced, fanout, 0).astype(jnp.int32)
+        tx_left = jnp.maximum(tx_left - spent, 0)
+        newly = jnp.any(new_chunks & ~chunks, axis=2)
+        tx_left = jnp.where(newly, cfg.tx_limit, tx_left)
+
+        # -- 3. completion + retirement (replicated decisions) -------
+        full = jnp.all(new_chunks, axis=2) & occ[None, :]
+        done_count = jax.lax.psum(
+            jnp.sum(full, axis=0, dtype=jnp.int32), NODE_AXIS
+        )
+        active = jax.lax.psum(
+            jnp.sum(
+                jnp.any(new_chunks, axis=2) & (tx_left > 0), axis=0,
+                dtype=jnp.int32,
+            ),
+            NODE_AXIS,
+        )
+        cleared, complete, quiesced = retire(
+            slot_event, done_count, active, slot_birth, t,
+            cfg.done_target,
+        )
+
+        offered = st.offered + jnp.sum(arrive, dtype=jnp.int32)
+        delivered = st.delivered + jnp.sum(complete, dtype=jnp.int32)
+        quiesced_ct = st.quiesced + jnp.sum(quiesced, dtype=jnp.int32)
+        overflow = st.window_overflow + ov
+        coalesced = st.coalesced + co
+        ob_ov = ob_ov + jax.lax.psum(dropped, NODE_AXIS)
+
+        outs = (
+            slot_event, slot_birth, done_count,
+            offered, delivered, quiesced_ct, overflow, coalesced,
+            sent, ob_ov,
+        )
+        nxt = StreamcastState(
+            chunks=new_chunks & ~cleared[None, :, None],
+            tx_left=jnp.where(cleared[None, :], 0, tx_left),
+            slot_event=jnp.where(cleared, -1, slot_event),
+            slot_birth=slot_birth,
+            offered=offered,
+            delivered=delivered,
+            quiesced=quiesced_ct,
+            window_overflow=overflow,
+            coalesced=coalesced,
+            tick=t + 1,
+        )
+        return (nxt, ob_ov), outs
+
+    def body(st, key):
+        # The arrival schedule is a pure function of the replicated
+        # key, so every shard derives the identical stream.
+        sched = arrival_arrays(
+            cfg, jax.random.fold_in(key, _SCHED_SALT)
+        )
+        keys = jax.random.split(key, steps)
+        (final, _ov), outs = jax.lax.scan(
+            lambda carry, k: tick(carry, k, sched),
+            (st, jnp.int32(0)), keys,
+        )
+        return final, outs
+
+    state_spec = StreamcastState(
+        chunks=P(NODE_AXIS, None, None),
+        tx_left=P(NODE_AXIS, None),
+        slot_event=P(),
+        slot_birth=P(),
+        offered=P(),
+        delivered=P(),
+        quiesced=P(),
+        window_overflow=P(),
+        coalesced=P(),
+        tick=P(),
+    )
+    run = shard_map(
+        body, mesh=mesh,
+        in_specs=(state_spec, P()),
+        out_specs=(state_spec, tuple(P() for _ in range(10))),
+        check_rep=False,
+    )
+    return run(state, key)
+
+
+# ---------------------------------------------------------------------------
 # Standalone multichip datapoint: python -m consul_tpu.parallel.shard
 # ---------------------------------------------------------------------------
 

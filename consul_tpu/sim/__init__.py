@@ -24,9 +24,12 @@ from consul_tpu.sim.engine import (
     broadcast_scan,
     lifeguard_scan,
     swim_scan,
+    streamcast_scan,
+    run_streamcast,
     sharded_broadcast_scan,
     sharded_membership_scan,
     sharded_sparse_membership_scan,
+    sharded_streamcast_scan,
 )
 from consul_tpu.sim.metrics import (
     time_to_fraction,
@@ -59,9 +62,12 @@ __all__ = [
     "broadcast_scan",
     "multidc_scan",
     "swim_scan",
+    "streamcast_scan",
+    "run_streamcast",
     "sharded_broadcast_scan",
     "sharded_membership_scan",
     "sharded_sparse_membership_scan",
+    "sharded_streamcast_scan",
     "time_to_fraction",
     "BroadcastReport",
     "SwimReport",

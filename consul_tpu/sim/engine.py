@@ -47,6 +47,7 @@ from consul_tpu.parallel.shard import (
     sharded_broadcast_scan,
     sharded_membership_scan,
     sharded_sparse_membership_scan,
+    sharded_streamcast_scan,
 )
 from consul_tpu.sim.metrics import (
     BroadcastReport,
@@ -566,6 +567,101 @@ def run_sweep(universe, warmup: bool = True):
     return summarize_sweep(universe, outs, wall)
 
 
+def _streamcast_scan(state, key: jax.Array, cfg, steps: int):
+    """Run ``steps`` ticks of the pipelined event stream
+    (consul_tpu/streamcast); returns ``(final_state, outs)`` with
+    ``outs`` the per-tick window snapshots + cumulative counters
+    (model.streamcast_round docstring).  Unjitted impl of
+    :data:`streamcast_scan` (see :func:`_broadcast_scan`); the arrival
+    schedule derives from a salted fold-in of ``key``, so per-round
+    keys stay bit-identical to ``broadcast_scan``'s and the sweep
+    plane gets per-universe schedules for free.
+    """
+    # Imported at call time: streamcast.model depends on sim.faults,
+    # so a module-level import here would close an import cycle
+    # through the package __init__s (the models.lifeguard pattern).
+    from consul_tpu.streamcast.model import (
+        _SCHED_SALT,
+        arrival_arrays,
+        streamcast_round,
+    )
+
+    sched = arrival_arrays(cfg, jax.random.fold_in(key, _SCHED_SALT))
+
+    def tick(carry, k):
+        return streamcast_round(carry, k, cfg, sched)
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+streamcast_scan = jax.jit(
+    _streamcast_scan, static_argnames=("cfg", "steps"),
+    donate_argnums=(0,),
+)
+
+
+def run_streamcast(
+    cfg,
+    steps: int,
+    seed: int = 0,
+    warmup: bool = True,
+    mesh=None,
+    exchange: str = "alltoall",
+):
+    """Sustained-load streamcast study (cfg: StreamcastConfig): the
+    heavy-traffic workload — a continuous chunked event stream under
+    the pipelined per-round transmit budget, with per-event delivery
+    tracked in the in-flight window.  Returns a
+    :class:`consul_tpu.streamcast.StreamcastReport`.
+
+    ``mesh=`` shards the chunk planes over the device mesh
+    (parallel/shard.py; events ride the per-destination outbox seam)
+    and fills ``report.shard_overflow``; ``exchange`` picks the outbox
+    transport (see :func:`run_broadcast`).  ``state`` is donated on
+    both paths (jaxlint J3): callers pass a fresh init positionally.
+    """
+    from consul_tpu.streamcast.model import streamcast_init
+    from consul_tpu.streamcast.report import StreamcastReport
+
+    _check_exchange(exchange, mesh)
+    key = jax.random.PRNGKey(seed)
+    if mesh is not None:
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return sharded_streamcast_scan(st, k, c, s, mesh, exchange)
+    else:
+        scan = streamcast_scan
+    final, outs, wall = _timed(
+        lambda: streamcast_init(cfg), scan, key, cfg, steps, warmup
+    )
+    if mesh is not None:
+        *outs, shard_ov = outs
+        shard_ov = int(np.asarray(shard_ov)[-1])
+    else:
+        shard_ov = None
+    (slot_event, slot_birth, done_count, offered, delivered,
+     quiesced, overflow, coalesced, sent) = outs
+    return StreamcastReport(
+        n=cfg.n,
+        ticks=steps,
+        tick_ms=cfg.profile.gossip_interval_ms,
+        window=cfg.window,
+        chunks=cfg.chunks,
+        k_events=cfg.k_events,
+        slot_event=np.asarray(slot_event),
+        slot_birth=np.asarray(slot_birth),
+        done_count=np.asarray(done_count),
+        offered=np.asarray(offered),
+        delivered=np.asarray(delivered),
+        quiesced=np.asarray(quiesced),
+        window_overflow=np.asarray(overflow),
+        coalesced=np.asarray(coalesced),
+        sent=np.asarray(sent),
+        wall_s=wall,
+        shard_overflow=shard_ov,
+    )
+
+
 def run_swim(
     cfg: SwimConfig,
     steps: int,
@@ -708,6 +804,25 @@ def jaxlint_registry(include=("small", "big"),
                     s, k, scfg, ssteps, mesh, strack, ex),
                 scfg.base.n, devices=d, per_chip=True)
 
+    from consul_tpu.streamcast.model import (
+        StreamcastConfig,
+        streamcast_init,
+    )
+
+    def add_sharded_streamcast(tag: str, d: int, stcfg, ststeps: int,
+                               exchanges: tuple = ("alltoall",)) -> None:
+        if d > len(jax.devices()):
+            return
+        mesh = make_mesh(jax.devices()[:d])
+        for ex in exchanges:
+            sfx = "" if ex == "alltoall" else f"/{ex}"
+            add(f"sharded_streamcast@{tag}/D{d}{sfx}",
+                "sharded_streamcast_scan",
+                lambda: streamcast_init(stcfg),
+                lambda s, k, ex=ex: sharded_streamcast_scan(
+                    s, k, stcfg, ststeps, mesh, ex),
+                stcfg.n, devices=d, per_chip=True)
+
     if "small" in include:
         mcfg = MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),))
         bcfg = BroadcastConfig(n=64, fanout=3, delivery="edges")
@@ -715,6 +830,9 @@ def jaxlint_registry(include=("small", "big"),
         swcfg = SwimConfig(n=64, subject=1, loss=0.05)
         lgcfg = LifeguardConfig(n=64, subject=1, subject_alive=True)
         mdcfg = MultiDCConfig(n=64, segments=8)
+        stcfg = StreamcastConfig(n=64, events=12, chunks=2, window=4,
+                                 fanout=3, chunk_budget=2, rate=0.4,
+                                 names=3, loss=0.05, delivery="edges")
         add("broadcast@small", "broadcast_scan",
             lambda: broadcast_init(bcfg),
             lambda s, k: broadcast_scan(s, k, bcfg, 8), bcfg.n)
@@ -734,6 +852,12 @@ def jaxlint_registry(include=("small", "big"),
         add("multidc@small", "multidc_scan",
             lambda: multidc_init(mdcfg),
             lambda s, k: multidc_scan(s, k, mdcfg, 8), mdcfg.n)
+        add("streamcast@small", "streamcast_scan",
+            lambda: streamcast_init(stcfg),
+            lambda s, k: streamcast_scan(s, k, stcfg, 8), stcfg.n)
+        for d in sharded_devices:
+            add_sharded_streamcast("small", d, stcfg, 8,
+                                   exchanges=("alltoall", "ring"))
         for d in sharded_devices:
             # Both exchange backends at small-n: the ring twins put the
             # Pallas ring kernel's traced program under every jaxlint
@@ -781,6 +905,18 @@ def jaxlint_registry(include=("small", "big"),
         add("lifeguard@1m", "lifeguard_scan",
             lambda: lifeguard_init(lgcfg1m),
             lambda s, k: lifeguard_scan(s, k, lgcfg1m, 160), lgcfg1m.n)
+        # The sustained-load workload at the north-star scale: 1M nodes,
+        # 4-chunk events pipelined through an 8-slot window, Poisson
+        # offered load — bench.py's streaming section shapes.
+        stcfg1m = StreamcastConfig(n=1_000_000, events=256, chunks=4,
+                                   window=8, fanout=4, chunk_budget=2,
+                                   rate=0.5, names=32, profile=LAN,
+                                   done_frac=0.999,
+                                   delivery="aggregate")
+        add("streamcast@1m", "streamcast_scan",
+            lambda: streamcast_init(stcfg1m),
+            lambda s, k: streamcast_scan(s, k, stcfg1m, 150),
+            stcfg1m.n)
         d = max(
             (d for d in sharded_devices if d <= len(jax.devices())),
             default=0,
@@ -799,6 +935,15 @@ def jaxlint_registry(include=("small", "big"),
                     k_slots=64,
                 ),
                 3, (42,),
+            )
+            add_sharded_streamcast(
+                "1m_per_chip", d,
+                StreamcastConfig(n=1_000_000 * d, events=256, chunks=4,
+                                 window=8, fanout=4, chunk_budget=2,
+                                 rate=0.5, names=32, profile=LAN,
+                                 done_frac=0.999,
+                                 delivery="edges"),
+                10,
             )
 
     # Universe-sweep twins (consul_tpu/sweep): the vmapped programs at
@@ -840,6 +985,11 @@ def jaxlint_registry(include=("small", "big"),
                                       fail_at=((3, 2),)),
                 k_slots=8), 8,
              ("base.loss",), (3,), 48),
+            ("streamcast", StreamcastConfig(
+                n=64, events=12, chunks=2, window=4, fanout=3,
+                chunk_budget=2, rate=0.4, names=3, loss=0.05,
+                delivery="edges"), 8,
+             ("rate",), (), 64),
         )
         for model, cfg, steps, knobs, track, n in sw_small:
             for u in (1, 8):

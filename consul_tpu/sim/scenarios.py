@@ -8,6 +8,9 @@ the host agent plane lands).
   probe1k     1k-node SWIM probe/ack with 1% induced failure, fanout 3
   event100k   100k-node serf event broadcast, LAN timing, fanout 4,
               99% infection time
+  stream100k  100k-node sustained event stream (consul_tpu/streamcast):
+              Poisson 4-chunk events pipelined through an 8-slot
+              window, delivered events/sec + t50/t99 + overflow
   suspect1m   1M-node suspicion/dead propagation, 30% loss, WAN profile
   multidc1m   1M-node 8-segment multi-DC epidemic broadcast, sharded
               across the device mesh
@@ -109,6 +112,42 @@ def event100k(seed: int = 0, devices: int = None,
     # loud-never-silent contract as probe1k).
     rep = run_broadcast(cfg, steps=100, seed=seed, exchange=exchange)
     return {"scenario": "event100k", **rep.summary()}
+
+
+def stream100k(seed: int = 0, n: int = 100_000, steps: int = 150,
+               devices: int = None, exchange: str = "alltoall") -> dict:
+    """Sustained event stream at 100k nodes: Poisson arrivals of
+    4-chunk events pipelined through an 8-slot window under a fixed
+    2-slot/round budget (consul_tpu/streamcast) — the heavy-traffic
+    workload as a preset, reporting delivered events/sec against the
+    offered load with t50/t99 delivery quantiles and the
+    window-overflow saturation signal.
+
+    ``devices`` shards the chunk planes over the first D devices
+    (``cli sim stream100k --devices D``) — chunk messages ride the
+    per-destination outbox, budget misses reported as shard_overflow;
+    ``exchange`` picks the transport (``--exchange ring`` = the Pallas
+    DMA kernel).  ``n``/``steps`` scale down for CPU smoke runs."""
+    from consul_tpu.parallel import mesh_for
+    from consul_tpu.sim.engine import run_streamcast
+    from consul_tpu.streamcast import StreamcastConfig
+
+    rate = 0.3
+    cfg = StreamcastConfig(
+        n=n, events=int(rate * steps * 1.5), chunks=4, window=8,
+        fanout=4, chunk_budget=2, rate=rate, names=16, loss=0.05,
+        profile=LAN, done_frac=0.999,
+        delivery="edges" if devices else "aggregate",
+    )
+    rep = run_streamcast(cfg, steps=steps, seed=seed, warmup=False,
+                         mesh=mesh_for(devices) if devices else None,
+                         exchange=exchange)
+    return {
+        "scenario": "stream100k",
+        **rep.summary(),
+        **({"devices": devices, "exchange_backend": exchange}
+           if devices else {}),
+    }
 
 
 def suspect1m(seed: int = 0) -> dict:
@@ -218,6 +257,7 @@ SCENARIOS: dict[str, Callable[..., dict]] = {
     "dev3": dev3,
     "probe1k": probe1k,
     "event100k": event100k,
+    "stream100k": stream100k,
     "suspect1m": suspect1m,
     "multidc1m": multidc1m,
     "degraded1m": degraded1m,
@@ -228,8 +268,8 @@ def run_scenario(name: str, seed: int = 0, devices: int = None,
                  exchange: str = None) -> dict:
     """Run a preset by name.  ``devices`` shards the node axis over the
     first D mesh devices for the scenarios that support it (probe1k,
-    event100k); asking it of any other preset is an error, not a silent
-    single-chip run.  ``exchange`` picks the outbox transport of the
+    event100k, stream100k); asking it of any other preset is an error,
+    not a silent single-chip run.  ``exchange`` picks the outbox transport of the
     sharded plane and therefore requires ``devices`` — same
     loud-never-silent contract."""
     import inspect

@@ -1,0 +1,43 @@
+"""Streamcast: pipelined chunked event-broadcast under sustained load.
+
+The heavy-traffic workload plane (ROADMAP item 4): a continuous event
+stream — Poisson or scheduled arrivals, each event E chunks — gossiped
+under a fixed per-round, per-node transmit budget with chunks from
+many in-flight events pipelined across rounds ("The Algorithm of
+Pipelined Gossiping", PAPERS.md).  Completion is tracked per event in
+a [n, W] in-flight window; window overflow is counted loudly, never
+silent.  The deliverable is a throughput CURVE — sustained events/sec
+vs offered load with delivery-latency quantiles and the saturation
+knee — not a point number.
+
+Entry points: ``streamcast_scan`` / ``run_streamcast`` in
+``sim.engine``; the sharded twin rides the outbox seam in
+``parallel/shard.py``.
+"""
+
+from consul_tpu.streamcast.model import (
+    StreamcastConfig,
+    StreamcastState,
+    arrival_arrays,
+    streamcast_init,
+    streamcast_round,
+)
+from consul_tpu.streamcast.report import (
+    StreamcastReport,
+    latency_quantiles,
+    per_event_latency,
+)
+from consul_tpu.streamcast.window import admit, retire
+
+__all__ = [
+    "StreamcastConfig",
+    "StreamcastState",
+    "StreamcastReport",
+    "arrival_arrays",
+    "streamcast_init",
+    "streamcast_round",
+    "per_event_latency",
+    "latency_quantiles",
+    "admit",
+    "retire",
+]

@@ -1,0 +1,439 @@
+"""Pipelined chunked event-broadcast under sustained load.
+
+The broadcast model (models/broadcast.py) delivers ONE point event;
+real Serf user-event traffic is a continuous stream of payloads.  This
+model generalizes it along the two axes of "The Algorithm of Pipelined
+Gossiping" (PAPERS.md):
+
+  * **chunking** — each event is E chunks; a node holds a per-event
+    chunk bitmask and an event is delivered to a node only when all E
+    chunks have landed (``chunks`` bool[n, W, E]).
+  * **pipelining** — many events are in flight at once in a fixed
+    [n, W] window (W = max concurrent events,
+    ``streamcast.window``), and each node transmits under a fixed
+    per-round budget: it services at most ``chunk_budget`` window
+    slots per round, one chunk x ``fanout`` targets each.  Per-round,
+    per-node bandwidth is therefore ``<= chunk_budget * fanout`` chunk
+    copies REGARDLESS of how many events are in flight — the
+    constant-bandwidth property the paper's pipeline exists for.
+
+Arrivals are a static-capacity schedule of K events (explicit
+``schedule`` tuples, or Poisson at ``rate`` events/tick — the offered
+load); events carry a ``name`` for Lamport coalescing (a newer event
+supersedes an older same-name one mid-flight, the latest-state rule of
+eventing/coalesce.py).  Window overflow — an arrival that finds no
+free slot — is DROPPED AND COUNTED, never silent: the same accounting
+contract as the sharded outbox budget, and the saturation signal the
+bench throughput curve reads its knee from.
+
+Degenerate contract: at ``window=1, chunks=1`` with a single scheduled
+event, one round of this model consumes the SAME RNG stream and
+performs the SAME delivery arithmetic as ``broadcast_round`` — the
+bit-equality pin in tests/test_streamcast.py that makes streamcast a
+generalization of the point-event model rather than a fork of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import bernoulli_mask, sample_peers
+from consul_tpu.protocol import retransmit_limit
+from consul_tpu.protocol.profiles import GossipProfile, LAN
+from consul_tpu.sim.faults import FaultSchedule, _concrete, extra_loss_at
+from consul_tpu.streamcast.window import admit, retire
+
+# Salt folded into the scan key for draws broadcast_round does not make
+# (slot-priority tie-breaks, chunk choice, the arrival schedule), so
+# the k_sel/k_loss stream stays bit-identical to broadcast_scan's.
+_AUX_SALT = 0x73C0
+_SCHED_SALT = 0x73C1
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamcastConfig:
+    """Static (trace-time) parameters of a streamcast study.
+
+    Exactly one arrival mode: ``schedule`` — explicit
+    ``((tick, origin, name), ...)`` tuples in non-decreasing tick
+    order (event ids ARE Lamport times; name -1 = unnamed, never
+    coalesces) — or Poisson arrivals at ``rate`` events/tick with
+    ``events`` = K the static schedule capacity (arrivals past the
+    horizon simply never fire; K should cover rate x steps with
+    headroom or the stream dries up early).  ``names`` > 0 draws
+    Poisson event names from [0, names) so same-name supersede
+    pressure exists; 0 keeps every event distinct.
+
+    ``rate``, ``loss`` and ``chunk_budget`` are rate-like knobs (the
+    sweep plane vmaps them; ``chunk_budget`` only ever enters as a
+    rank comparison, never a shape).  ``window``/``chunks``/``events``
+    feed array shapes and stay static.
+
+    ``faults`` supports loss ramps only (extra packet loss over time);
+    the node-level primitives (partitions, degraded sets, churn) model
+    membership dynamics streamcast does not simulate — rejected
+    loudly rather than silently ignored.
+    """
+
+    n: int
+    events: int = 0                 # K: Poisson schedule capacity
+    chunks: int = 1                 # E chunks per event
+    window: int = 1                 # W concurrent in-flight slots
+    fanout: int | None = None
+    chunk_budget: int = 1           # slots serviced per node per round
+    retransmit_mult: int | None = None
+    loss: float = 0.0
+    rate: float = 0.0               # Poisson offered load, events/tick
+    schedule: tuple = ()            # ((tick, origin, name), ...)
+    names: int = 0                  # Poisson name-space size (0 = unnamed)
+    # Delivery fraction at which an event counts as delivered and its
+    # slot retires: 1.0 (default) is the exactness contract (every
+    # node, the broadcast-pin semantics); large-n sustained-load
+    # studies use e.g. 0.999 — the epidemic tail means the LAST
+    # straggler of a million may never land before budgets drain
+    # (TransmitLimitedQueue semantics: delivery is probabilistic),
+    # and a slot pinned on it would leak the window.
+    done_frac: float = 1.0
+    profile: GossipProfile = LAN
+    delivery: str = "edges"
+    faults: FaultSchedule = FaultSchedule()
+
+    def __post_init__(self):
+        if self.delivery not in ("edges", "aggregate"):
+            raise ValueError(
+                f"delivery must be 'edges' or 'aggregate', "
+                f"got {self.delivery!r}"
+            )
+        if self.fanout is None:
+            object.__setattr__(self, "fanout", self.profile.gossip_nodes)
+        if self.retransmit_mult is None:
+            object.__setattr__(
+                self, "retransmit_mult", self.profile.retransmit_mult
+            )
+        if self.chunks < 1 or self.window < 1:
+            raise ValueError(
+                f"chunks={self.chunks} and window={self.window} must "
+                "be >= 1"
+            )
+        if _concrete(self.chunk_budget) and self.chunk_budget < 1:
+            raise ValueError(
+                f"chunk_budget={self.chunk_budget} must be >= 1"
+            )
+        if not 0.0 < self.done_frac <= 1.0:
+            raise ValueError(
+                f"done_frac={self.done_frac} outside (0, 1]"
+            )
+        if self.faults.partitions or self.faults.degraded or \
+                self.faults.churn:
+            raise ValueError(
+                "streamcast consumes loss ramps only; partitions/"
+                "degraded/churn model membership dynamics this plane "
+                "does not simulate — compose them onto a membership "
+                "study instead"
+            )
+        if self.schedule:
+            if _concrete(self.rate) and self.rate:
+                raise ValueError(
+                    "pass exactly one arrival mode: schedule=(...) OR "
+                    "rate="
+                )
+            if self.events not in (0, len(self.schedule)):
+                raise ValueError(
+                    f"events={self.events} disagrees with "
+                    f"len(schedule)={len(self.schedule)}; omit events "
+                    "in scheduled mode"
+                )
+            last = None
+            for entry in self.schedule:
+                if len(entry) != 3:
+                    raise ValueError(
+                        f"schedule entries are (tick, origin, name) "
+                        f"3-tuples, got {entry!r}"
+                    )
+                tick, origin, _name = entry
+                if tick < 0:
+                    raise ValueError(f"schedule tick {tick} < 0")
+                if last is not None and tick < last:
+                    raise ValueError(
+                        "schedule ticks must be non-decreasing "
+                        "(event ids are Lamport times)"
+                    )
+                last = tick
+                if not 0 <= origin < self.n:
+                    raise ValueError(
+                        f"schedule origin {origin} outside [0, {self.n})"
+                    )
+        else:
+            if _concrete(self.rate) and self.rate <= 0.0:
+                raise ValueError(
+                    "pass exactly one arrival mode: schedule=(...) OR "
+                    "rate= > 0"
+                )
+            if self.events < 1:
+                raise ValueError(
+                    "Poisson mode needs events=K (static schedule "
+                    "capacity; size it to cover rate x steps with "
+                    "headroom)"
+                )
+
+    @property
+    def k_events(self) -> int:
+        """K: the static arrival-schedule capacity."""
+        return len(self.schedule) if self.schedule else self.events
+
+    @property
+    def done_target(self) -> int:
+        """Nodes that must hold every chunk for delivery:
+        ``ceil(done_frac * n)``, n itself at the default."""
+        import math
+
+        if self.done_frac >= 1.0:
+            return self.n
+        return max(1, math.ceil(self.done_frac * self.n))
+
+    @property
+    def tx_limit(self) -> int:
+        """Per-slot transmit budget: an E-chunk event is E messages,
+        each owed its own ``retransmit_limit`` worth of transmissions
+        (memberlist's TransmitLimitedQueue budgets per message, and a
+        serviced round pushes only ONE of the E chunks) — so the slot
+        budget scales by E.  E = 1 reduces to the broadcast model's
+        budget exactly (the bit-equality pin)."""
+        return retransmit_limit(self.retransmit_mult, self.n) * self.chunks
+
+
+class StreamcastState(NamedTuple):
+    chunks: jax.Array           # bool[n, W, E] — chunk c of slot w held
+    tx_left: jax.Array          # int32[n, W] — per-slot transmit budget
+    slot_event: jax.Array       # int32[W] — global event id, -1 free
+    slot_birth: jax.Array       # int32[W] — arrival tick of the occupant
+    offered: jax.Array          # int32 — arrivals seen (admitted or not)
+    delivered: jax.Array        # int32 — events retired fully delivered
+    quiesced: jax.Array         # int32 — events retired incomplete
+    window_overflow: jax.Array  # int32 — arrivals dropped, no free slot
+    coalesced: jax.Array        # int32 — events superseded by name
+    tick: jax.Array             # int32 scalar
+
+
+def streamcast_init(cfg: StreamcastConfig) -> StreamcastState:
+    n, w, e = cfg.n, cfg.window, cfg.chunks
+    return StreamcastState(
+        chunks=jnp.zeros((n, w, e), jnp.bool_),
+        tx_left=jnp.zeros((n, w), jnp.int32),
+        slot_event=jnp.full((w,), -1, jnp.int32),
+        slot_birth=jnp.zeros((w,), jnp.int32),
+        offered=jnp.int32(0),
+        delivered=jnp.int32(0),
+        quiesced=jnp.int32(0),
+        window_overflow=jnp.int32(0),
+        coalesced=jnp.int32(0),
+        tick=jnp.int32(0),
+    )
+
+
+def arrival_arrays(cfg: StreamcastConfig, key: jax.Array):
+    """``(ev_tick, ev_origin, ev_name)`` int32[K] — the arrival
+    schedule as device arrays.
+
+    Scheduled mode folds the host tuples in (validated at config
+    construction); Poisson mode derives inter-arrival gaps from
+    ``key`` with ``rate`` as ordinary jnp arithmetic, so the offered
+    load is sweepable as a traced per-universe knob (consul_tpu/sweep)
+    — per-universe keys then give per-universe schedules."""
+    k = cfg.k_events
+    if cfg.schedule:
+        ev_tick = jnp.asarray(
+            [t for t, _, _ in cfg.schedule], jnp.int32
+        )
+        ev_origin = jnp.asarray(
+            [o for _, o, _ in cfg.schedule], jnp.int32
+        )
+        ev_name = jnp.asarray(
+            [m for _, _, m in cfg.schedule], jnp.int32
+        )
+        return ev_tick, ev_origin, ev_name
+    k_gap, k_org, k_name = jax.random.split(key, 3)
+    rate = jnp.maximum(jnp.asarray(cfg.rate, jnp.float32), 1e-6)
+    gaps = jax.random.exponential(k_gap, (k,)) / rate
+    ev_tick = jnp.floor(jnp.cumsum(gaps)).astype(jnp.int32)
+    ev_origin = jax.random.randint(
+        k_org, (k,), 0, cfg.n, dtype=jnp.int32
+    )
+    if cfg.names > 0:
+        ev_name = jax.random.randint(
+            k_name, (k,), 0, cfg.names, dtype=jnp.int32
+        )
+    else:
+        ev_name = jnp.full((k,), -1, jnp.int32)
+    return ev_tick, ev_origin, ev_name
+
+
+def _p_live(cfg: StreamcastConfig, tick: jax.Array):
+    """Per-copy survival probability this round.  Without ramps this
+    is the same host-float expression broadcast_round uses (the
+    bit-equality pin rides on it); ramps multiply in as independent
+    drop processes (sim/faults.py combine_loss)."""
+    if cfg.faults.ramps:
+        return (1.0 - cfg.loss) * (
+            1.0 - extra_loss_at(cfg.faults, tick)
+        )
+    return 1.0 - cfg.loss
+
+
+def streamcast_round(state: StreamcastState, key: jax.Array,
+                     cfg: StreamcastConfig, sched: tuple):
+    """One gossip tick of the pipelined stream.
+
+    Returns ``(next_state, outs)`` with ``outs`` the per-tick counter
+    tuple ``(slot_event, slot_birth, done_count, offered, delivered,
+    quiesced, window_overflow, coalesced, sent)`` — window snapshots
+    are taken AFTER admission and BEFORE retirement, so an event's
+    completion tick is visible in its own slot's curve.
+
+    RNG discipline: ``k_sel``/``k_loss`` split exactly as
+    ``broadcast_round`` splits them (target draw, loss draw); every
+    extra draw (slot-priority tie-break, chunk choice) comes from a
+    salted fold-in of the round key, leaving the broadcast stream
+    untouched — the W=1/E=1 bit-equality pin.
+    """
+    n, w_slots, e_chunks = cfg.n, cfg.window, cfg.chunks
+    fanout = cfg.fanout
+    ev_tick, ev_origin, ev_name = sched
+    t = state.tick
+    k_sel, k_loss = jax.random.split(key)
+    k_tie, k_chunk = jax.random.split(jax.random.fold_in(key, _AUX_SALT))
+
+    # -- 1. arrivals + window admission ------------------------------
+    arrive = ev_tick == t
+    slot_event, slot_birth, filled, freed, ov, co = admit(
+        state.slot_event, state.slot_birth, arrive, ev_name, t
+    )
+    chunks = state.chunks & ~(freed | filled)[None, :, None]
+    tx_left = jnp.where((freed | filled)[None, :], 0, state.tx_left)
+    org = ev_origin[jnp.maximum(slot_event, 0)]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    seed = filled[None, :] & (rows[:, None] == org[None, :])
+    chunks = chunks | seed[:, :, None]
+    tx_left = jnp.where(seed, cfg.tx_limit, tx_left)
+
+    # -- 2. transmit under the pipelined budget ----------------------
+    # A node services its top-``chunk_budget`` eligible slots (highest
+    # remaining budget, random tie-break) and pushes ONE uniformly
+    # chosen held chunk per serviced slot to ``fanout`` targets shared
+    # across slots — bandwidth <= chunk_budget * fanout copies/round
+    # however many events are in flight.  The budget enters as a rank
+    # comparison, never a shape, so it is sweepable.
+    occ = slot_event >= 0
+    eligible = (
+        jnp.any(chunks, axis=2) & (tx_left > 0) & occ[None, :]
+    )
+    prio = jnp.where(
+        eligible, tx_left.astype(jnp.float32), -jnp.inf
+    ) + jax.random.uniform(k_tie, (n, w_slots))
+    # Strict total order: float32 tie-break draws DO collide at 1M x W
+    # draws/round (birthday over 2^24), and a tie would let a node
+    # service chunk_budget + 1 slots — break ties by slot index so
+    # the bandwidth bound is exact, not probabilistic.
+    widx = jnp.arange(w_slots, dtype=jnp.int32)
+    ahead = (prio[:, None, :] > prio[:, :, None]) | (
+        (prio[:, None, :] == prio[:, :, None])
+        & (widx[None, None, :] < widx[None, :, None])
+    )
+    rank = jnp.sum(ahead.astype(jnp.int32), axis=2)
+    serviced = eligible & (rank < cfg.chunk_budget)
+    g = jax.random.uniform(k_chunk, (n, w_slots, e_chunks))
+    sel = jnp.argmax(jnp.where(chunks, g, -1.0), axis=2).astype(
+        jnp.int32
+    )
+    p_live = _p_live(cfg, t)
+
+    if cfg.delivery == "edges":
+        # Exact per-message scatter: the broadcast_round path, one
+        # (sender, slot, target) message per serviced slot x fanout.
+        targets = sample_peers(k_sel, n, fanout)             # [n, F]
+        ok = serviced[:, :, None] & bernoulli_mask(
+            k_loss, (n, w_slots, fanout), p_live
+        )
+        recv = jnp.broadcast_to(
+            targets[:, None, :], (n, w_slots, fanout)
+        )
+        wix = jnp.broadcast_to(
+            jnp.arange(w_slots, dtype=jnp.int32)[None, :, None],
+            (n, w_slots, fanout),
+        )
+        cix = jnp.broadcast_to(
+            sel[:, :, None], (n, w_slots, fanout)
+        )
+        flat = jnp.where(
+            ok, (recv * w_slots + wix) * e_chunks + cix,
+            n * w_slots * e_chunks,
+        )
+        hits = (
+            jnp.zeros((n * w_slots * e_chunks,), jnp.bool_)
+            .at[flat.ravel()].set(True, mode="drop")
+            .reshape(n, w_slots, e_chunks)
+        )
+        new_chunks = chunks | hits
+    else:
+        # Receiver-side Poissonized delivery per (slot, chunk) message
+        # class — the aggregate_arrivals argument chunk-wise: all
+        # copies of chunk c of slot w are identical, so the per-class
+        # sender count is sufficient and the network is elementwise
+        # RNG (no scatter).
+        onehot = chunks & (
+            sel[:, :, None]
+            == jnp.arange(e_chunks, dtype=jnp.int32)[None, None, :]
+        )
+        contrib = (serviced[:, :, None] & onehot).astype(jnp.float32)
+        s_tot = jnp.sum(contrib, axis=0)                     # [W, E]
+        lam = (
+            (s_tot[None, :, :] - contrib) * fanout * p_live
+            / max(n - 1, 1)
+        )
+        u = jax.random.uniform(k_loss, (n, w_slots, e_chunks))
+        new_chunks = chunks | (u < -jnp.expm1(-lam))
+
+    sent = jnp.sum(serviced, dtype=jnp.int32) * fanout
+    spent = jnp.where(serviced, fanout, 0).astype(jnp.int32)
+    tx_left = jnp.maximum(tx_left - spent, 0)
+    newly = jnp.any(new_chunks & ~chunks, axis=2)
+    tx_left = jnp.where(newly, cfg.tx_limit, tx_left)
+
+    # -- 3. completion + retirement ----------------------------------
+    full = jnp.all(new_chunks, axis=2) & occ[None, :]
+    done_count = jnp.sum(full, axis=0, dtype=jnp.int32)      # [W]
+    active = jnp.sum(
+        jnp.any(new_chunks, axis=2) & (tx_left > 0), axis=0,
+        dtype=jnp.int32,
+    )
+    cleared, complete, quiesced = retire(
+        slot_event, done_count, active, slot_birth, t, cfg.done_target
+    )
+
+    offered = state.offered + jnp.sum(arrive, dtype=jnp.int32)
+    delivered = state.delivered + jnp.sum(complete, dtype=jnp.int32)
+    quiesced_ct = state.quiesced + jnp.sum(quiesced, dtype=jnp.int32)
+    overflow = state.window_overflow + ov
+    coalesced = state.coalesced + co
+
+    outs = (
+        slot_event, slot_birth, done_count,
+        offered, delivered, quiesced_ct, overflow, coalesced, sent,
+    )
+    nxt = StreamcastState(
+        chunks=new_chunks & ~cleared[None, :, None],
+        tx_left=jnp.where(cleared[None, :], 0, tx_left),
+        slot_event=jnp.where(cleared, -1, slot_event),
+        slot_birth=slot_birth,
+        offered=offered,
+        delivered=delivered,
+        quiesced=quiesced_ct,
+        window_overflow=overflow,
+        coalesced=coalesced,
+        tick=t + 1,
+    )
+    return nxt, outs

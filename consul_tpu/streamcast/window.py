@@ -1,0 +1,154 @@
+"""The in-flight event window: fixed-W slot allocation for a stream.
+
+A streamcast study gossips MANY events concurrently; the window is the
+static-shape home of the in-flight set — ``slot_event[W]`` holds the
+global id of the event occupying each slot (-1 free).  Everything here
+is a pure function of replicated scalars/short vectors, so the same
+allocator runs identically on every shard of the mesh (the window is
+global state; only the chunk planes shard).
+
+Accounting contract (the outbox-budget discipline of
+consul_tpu/parallel/shard.py): a stream the window cannot hold is
+never silently truncated —
+
+  window_overflow   arrivals that found no free slot and were DROPPED
+                    (the saturation signal: offered load x event
+                    lifetime exceeded W)
+  coalesced         arrivals/occupants superseded by a NEWER event of
+                    the same name (serf user-event semantics: only the
+                    latest payload of a name matters —
+                    eventing/coalesce.py's latest-state rule and the
+                    Lamport ordering of eventing/lamport.py, applied
+                    in-plane: event ids ARE Lamport times, the
+                    schedule arrives in id order)
+
+Admission order is Lamport order (ascending event id) into ascending
+free slots — deterministic, so the brute-force reference in
+tests/test_streamcast.py can replay it exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def admit(slot_event: jax.Array, slot_birth: jax.Array,
+          arrive: jax.Array, ev_name: jax.Array, tick: jax.Array):
+    """One tick of window admission.
+
+    ``slot_event`` int32[W] (-1 free), ``slot_birth`` int32[W],
+    ``arrive`` bool[K] (events arriving this tick), ``ev_name``
+    int32[K] (-1 = unnamed, never coalesces), ``tick`` int32 scalar.
+
+    Returns ``(slot_event, slot_birth, filled, freed, overflow,
+    coalesced)``:
+
+      filled     bool[W] — slots holding a fresh event this tick:
+                 ranked admissions AND in-place supersede claims (the
+                 caller clears these planes and seeds the new
+                 origin's chunks)
+      freed      bool[W] — slots whose previous occupant was
+                 superseded by a newer same-name arrival; always a
+                 subset of ``filled`` (the superseder takes the slot
+                 it freed)
+      overflow   int32 — arrivals dropped for want of a free slot
+      coalesced  int32 — superseded occupants + superseded same-tick
+                 arrivals (never double-counted as overflow)
+    """
+    k_events = arrive.shape[0]
+    ev_id = jnp.arange(k_events, dtype=jnp.int32)
+    occ = slot_event >= 0
+
+    # -- Lamport supersede (in place) --------------------------------
+    # An arriving NAMED event supersedes any older same-name event:
+    # an in-window occupant is REPLACED IN ITS OWN SLOT by the newest
+    # superseding arrival (serf coalesce semantics: the latest payload
+    # takes over the name's delivery — under a full window the
+    # superseder must not race ranked admission and overflow while its
+    # freed slot goes to an unrelated arrival); older same-tick
+    # arrivals never allocate.  Unnamed events (-1) coalesce with
+    # nothing.
+    named_arr = jnp.where(arrive & (ev_name >= 0), ev_name, -2)
+    slot_name = jnp.where(
+        occ, ev_name[jnp.maximum(slot_event, 0)], -3
+    )
+    supersedes = (
+        (named_arr[None, :] == slot_name[:, None])
+        & (ev_id[None, :] > slot_event[:, None])
+    )                                                   # [W, K]
+    freed = occ & jnp.any(supersedes, axis=1)
+    claim = jnp.max(
+        jnp.where(supersedes, ev_id[None, :], -1), axis=1
+    )                                                   # [W]
+    superseded_arr = arrive & jnp.any(
+        (named_arr[None, :] == named_arr[:, None])
+        & (ev_id[None, :] > ev_id[:, None])
+        & (ev_name[:, None] >= 0),
+        axis=1,
+    )
+    coalesced = (
+        jnp.sum(freed, dtype=jnp.int32)
+        + jnp.sum(superseded_arr, dtype=jnp.int32)
+    )
+    slot_event = jnp.where(freed, claim, slot_event)
+    slot_birth = jnp.where(freed, tick, slot_birth)
+    claimed = jnp.any(
+        freed[:, None] & (claim[:, None] == ev_id[None, :]), axis=0
+    )                                                   # [K]
+
+    # -- rank-matched allocation -------------------------------------
+    # Remaining arrivals admit in Lamport order into ascending free
+    # slots: arrival rank r claims the r-th free slot (the sortmerge
+    # prefix-sum discipline on a W-length plane).  Arrivals ranked
+    # past the free count are the window overflow — dropped and
+    # counted, never silent.
+    want = arrive & ~superseded_arr & ~claimed
+    free = slot_event < 0
+    n_free = jnp.sum(free, dtype=jnp.int32)
+    arr_rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+    admitted = want & (arr_rank < n_free)
+    n_adm = jnp.sum(admitted, dtype=jnp.int32)
+    overflow = jnp.sum(want, dtype=jnp.int32) - n_adm
+
+    ids_by_rank = (
+        jnp.full((k_events,), -1, jnp.int32)
+        .at[jnp.where(admitted, arr_rank, k_events)]
+        .set(ev_id, mode="drop")
+    )
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    filled = free & (free_rank < n_adm)
+    take = ids_by_rank[jnp.clip(free_rank, 0, k_events - 1)]
+    slot_event = jnp.where(filled, take, slot_event)
+    slot_birth = jnp.where(filled, tick, slot_birth)
+    # In-place claims are fresh occupants too: the caller clears the
+    # superseded planes (``freed``) and seeds the new origin
+    # (``filled``) for them like any other admission.
+    return (slot_event, slot_birth, filled | freed, freed, overflow,
+            coalesced)
+
+
+def retire(slot_event: jax.Array, done_count: jax.Array,
+           active_senders: jax.Array, slot_birth: jax.Array,
+           tick: jax.Array, target: int):
+    """End-of-round retirement: free slots whose event is finished.
+
+    A slot retires when at least ``target`` nodes hold every chunk
+    (``complete`` — ``target`` is ``ceil(done_frac * n)``, n itself
+    under the default exactness contract) or when no node can
+    transmit for it any more (``quiesced`` — the transmit budget is
+    exhausted, so the event can never spread further; without this
+    rule a lossy event that misses one node would pin its slot
+    forever).  Fresh slots (born this tick) never quiesce — the
+    origin has not sent yet.
+
+    Returns ``(cleared, complete, quiesced)`` bool[W] masks; the
+    caller zeroes the cleared planes and counts deliveries.
+    """
+    occ = slot_event >= 0
+    complete = occ & (done_count >= target)
+    quiesced = (
+        occ & ~complete & (active_senders == 0) & (slot_birth < tick)
+    )
+    cleared = complete | quiesced
+    return cleared, complete, quiesced

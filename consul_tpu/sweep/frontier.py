@@ -56,6 +56,17 @@ ENTRYPOINT_METRICS: dict = {
         "suspecting_final", "dead_known_final", "suspect_cells_mean",
         "known_members_final",
     )),
+    # Streamcast (consul_tpu/streamcast): throughput/latency axes.
+    # pareto_mask MINIMIZES every column, so the throughput axis of a
+    # (throughput, t99) frontier is ``undelivered_frac`` (fraction of
+    # offered events not fully delivered — 0 is perfect throughput);
+    # the raw rates ride along for reading the curve.
+    "streamcast": frozenset({
+        "events_offered", "events_delivered", "events_quiesced",
+        "events_coalesced", "window_overflow",
+        "offered_events_per_sim_s", "delivered_events_per_sim_s",
+        "undelivered_frac", "t50_ms", "t99_ms",
+    }),
 }
 
 
@@ -290,6 +301,52 @@ def summarize_sweep(universe, outs, wall_s: float) -> SweepReport:
             t = first_tick_at_least(infected, frac * n)
             metrics[f"t{int(frac * 100)}_ms"] = (t + 1.0) * tick_ms
         metrics["converged_tick"] = first_tick_at_least(infected, n)
+    elif universe.entrypoint == "streamcast":
+        from consul_tpu.streamcast.report import per_event_latency
+
+        (slot_event, slot_birth, done_count, offered, delivered,
+         quiesced, overflow, coalesced, _sent) = outs
+        U = np.asarray(offered).shape[0]
+        sim_s = steps * tick_ms / 1000.0
+        metrics["events_offered"] = np.asarray(offered, float)[:, -1]
+        metrics["events_delivered"] = np.asarray(
+            delivered, float
+        )[:, -1]
+        metrics["events_quiesced"] = np.asarray(quiesced, float)[:, -1]
+        metrics["events_coalesced"] = np.asarray(
+            coalesced, float
+        )[:, -1]
+        metrics["window_overflow"] = np.asarray(overflow, float)[:, -1]
+        metrics["offered_events_per_sim_s"] = (
+            metrics["events_offered"] / sim_s
+        )
+        metrics["delivered_events_per_sim_s"] = (
+            metrics["events_delivered"] / sim_s
+        )
+        off = metrics["events_offered"]
+        metrics["undelivered_frac"] = np.where(
+            off > 0, 1.0 - metrics["events_delivered"] / np.maximum(
+                off, 1.0
+            ), np.nan,
+        )
+        # Per-universe median of the per-event latency to frac*n —
+        # the same reduction StreamcastReport.summary performs.
+        for frac, name in ((0.50, "t50_ms"), (0.99, "t99_ms")):
+            med = np.full(U, np.nan)
+            for u in range(U):
+                lat = np.asarray(
+                    list(per_event_latency(
+                        np.asarray(slot_event)[u],
+                        np.asarray(slot_birth)[u],
+                        np.asarray(done_count)[u],
+                        n, tick_ms, frac,
+                    ).values()),
+                    dtype=float,
+                )
+                ok = lat[~np.isnan(lat)]
+                if ok.size:
+                    med[u] = float(np.median(ok))
+            metrics[name] = med
     else:  # membership / sparse
         sus_t, dead_t, sus_cells, known = outs
         if universe.track:

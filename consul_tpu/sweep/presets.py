@@ -130,10 +130,51 @@ def fault_matrix(universes=None, seed=0, n=192, steps=80,
     )
 
 
+def stream_load_curve(universes=None, seed=0, n=4096, window=8,
+                      chunks=4, fanout=4, chunk_budget=2,
+                      rates=(0.1, 0.3, 0.6, 1.2), steps=150,
+                      loss=0.05) -> Universe:
+    """Offered-load ladder over the streamcast plane
+    (consul_tpu/streamcast): each universe is one offered load
+    (events/tick), all other knobs shared, so ONE batched program
+    measures the whole sustained-throughput curve — delivered
+    events/sec vs offered, with the window-overflow saturation knee
+    where the curve flattens.  The frontier axes are
+    (undelivered_frac, t99_ms): universes past the knee pay on the
+    throughput axis, universes before it compete on latency."""
+    if universes is not None:
+        raise ValueError(
+            "streamload is a grid preset: U = len(rates), not "
+            "--universes"
+        )
+    from consul_tpu.streamcast.model import StreamcastConfig
+
+    cfg = StreamcastConfig(
+        n=n, events=int(max(rates) * steps * 1.5), chunks=chunks,
+        window=window, fanout=fanout, chunk_budget=chunk_budget,
+        rate=rates[0], loss=loss, delivery="aggregate",
+        # Sustained-load semantics: an event is delivered at 99.9% of
+        # nodes — the epidemic tail means the LAST straggler of a big
+        # n may never land before budgets drain, and a slot pinned on
+        # it would leak the window (model.StreamcastConfig.done_frac).
+        done_frac=0.999,
+    )
+    return Universe(
+        entrypoint="streamcast", cfg=cfg, steps=steps,
+        # One shared key: the load points differ ONLY in rate (the
+        # Poisson schedule still differs per universe because rate
+        # scales the same exponential gap draws).
+        seeds=(seed,) * len(rates),
+        knobs=("rate",),
+        values=(tuple(rates),),
+    )
+
+
 PRESETS: dict = {
     "seeds4k": seed_sweep,
     "tuning": tuning_grid,
     "faultmatrix": fault_matrix,
+    "streamload": stream_load_curve,
 }
 
 

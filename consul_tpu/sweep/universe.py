@@ -51,11 +51,12 @@ _SHAPE_FIELDS = frozenset({
     "n", "k_slots", "piggyback", "stage_width", "segments", "seg_size",
     "bridges_per_segment", "indirect_checks", "udp_buffer_size",
     "event_buffer_size", "query_buffer_size", "max_user_event_size",
+    "events", "chunks", "window", "names",
     # schedule structure (host-validated scatter indices)
-    "fail_at", "leave_at", "join_at", "pieces", "subject",
+    "fail_at", "leave_at", "join_at", "pieces", "subject", "schedule",
     "fail_at_tick", "start", "heal", "end", "seed", "leave_grace_ticks",
     # trace-time constants and branch selectors
-    "delivery", "profile", "base", "faults", "lifeguard",
+    "delivery", "profile", "base", "faults", "lifeguard", "done_frac",
     "subject_alive", "probe_enabled", "push_pull_enabled", "name",
     "probe_interval_ms", "probe_timeout_ms", "gossip_interval_ms",
     "push_pull_interval_ms", "gossip_to_the_dead_ms",
@@ -70,8 +71,10 @@ _FAULT_KNOB_FIELDS = frozenset({
 })
 
 # Knobs that are integer-valued in the models (transmission counts);
-# everything else stacks as float32.
-_INT_KNOB_FIELDS = frozenset({"fanout", "gossip_nodes"})
+# everything else stacks as float32.  chunk_budget is streamcast's
+# serviced-slots-per-round cap — it only ever enters as a rank
+# comparison, never a shape, so it is sweepable despite being a count.
+_INT_KNOB_FIELDS = frozenset({"fanout", "gossip_nodes", "chunk_budget"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +101,12 @@ def _lifeguard_init(cfg):
     from consul_tpu.models.lifeguard import lifeguard_init
 
     return lifeguard_init(cfg)
+
+
+def _streamcast_init(cfg):
+    from consul_tpu.streamcast.model import streamcast_init
+
+    return streamcast_init(cfg)
 
 
 SWEEP_ENTRYPOINTS: dict = {
@@ -145,6 +154,21 @@ SWEEP_ENTRYPOINTS: dict = {
         base_cfg=lambda c: c.base,
         knob_paths=frozenset({"base.loss", "base.suspicion_scale"}),
         aggregate_only=frozenset(),
+    ),
+    # The sustained-load plane (consul_tpu/streamcast): ``rate`` is the
+    # offered load — per-universe arrival schedules derive from the
+    # per-universe keys, so ONE batched program measures a whole
+    # throughput curve; ``chunk_budget`` is the pipelined bandwidth
+    # cap (a rank comparison, never a shape).
+    "streamcast": _EntrypointSpec(
+        name="streamcast",
+        init=_streamcast_init,
+        call=lambda s, k, c, steps, track: engine._streamcast_scan(
+            s, k, c, steps),
+        base_cfg=lambda c: c,
+        knob_paths=frozenset({"loss", "rate", "chunk_budget"}),
+        aggregate_only=frozenset({"fanout"}),
+        fault_paths=True,
     ),
 }
 

@@ -128,11 +128,15 @@ def test_broadcast_quantile_band_at_100k():
     _assert_quantile_band(r_e, r_a, n, (0.25, 0.5, 0.9, 0.99))
 
 
+@pytest.mark.slow  # ~32s at CPU: 6 x 220-tick n=10k studies
 def test_swim_detection_quantile_band_at_10k():
     """Death-propagation CDF across observers, edges vs aggregate, at
     the scale band the VERDICT asked for.  Detection horizons are
     O(100) ticks here, so the 5% relative clause (not the 1-tick floor)
-    is the operative bound."""
+    is the operative bound.  Behind -m slow per the tier-1 budget
+    policy for long-horizon distributional bands (PR 3); the n=4096
+    swim agreement test and the 10k/100k broadcast bands above keep
+    the edges==aggregate claim in tier-1."""
     n = 10_000
     cfg_e = SwimConfig(n=n, subject=3, loss=0.2, delivery="edges")
     cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")

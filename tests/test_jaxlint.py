@@ -408,9 +408,21 @@ class TestRepoGate:
         # the zero-findings walks above cover the batched plane (and
         # the traced knob-rebuild path) for the whole family.
         for model in ("swim", "lifeguard", "broadcast", "membership",
-                      "sparse"):
+                      "sparse", "streamcast"):
             for u in (1, 8):
                 assert f"sweep_{model}@small/U{u}" in small_programs
+
+    def test_registry_covers_streamcast(self, small_programs):
+        # The pipelined event-stream plane: the unsharded scan plus
+        # the sharded twins at D in {1, 2} over BOTH exchange backends
+        # (the /ring twins walk the Pallas program), all under every
+        # zero-findings gate.
+        assert "streamcast@small" in small_programs
+        for d in (1, 2):
+            assert f"sharded_streamcast@small/D{d}" in small_programs
+            assert (
+                f"sharded_streamcast@small/D{d}/ring" in small_programs
+            )
 
     def test_small_registry_zero_findings(self, small_programs,
                                           small_traces):
@@ -440,6 +452,18 @@ class TestRepoGate:
             peak = estimate_peak(big_traces[name])
             assert peak.per_chip_bytes is not None, name
             assert 0 < peak.per_chip_bytes <= BUDGET_16GB, name
+
+    def test_streamcast_1m_footprint_pinned(self, big_traces):
+        # J6 prices the sustained-load plane at the north-star shape
+        # (n=1M, W=8, E=4): the peak must cover at least the persistent
+        # chunk plane plus one [n, W, E] float32 delivery draw, and
+        # stay far inside the 16 GB/chip gate — the headroom that says
+        # W (and therefore the sustainable offered load) can grow ~50x
+        # before sharding becomes mandatory.
+        peak = estimate_peak(big_traces["streamcast@1m"]).chip_bytes
+        n, w, e = 1_000_000, 8, 4
+        floor = n * w * e * (1 + 4)  # bool chunks + f32 uniform draw
+        assert floor <= peak <= BUDGET_16GB, peak
 
     def test_lint_programs_end_to_end(self, small_programs):
         findings, peaks = lint_programs(

@@ -19,8 +19,8 @@ from consul_tpu.sim import SCENARIOS, run_scenario
 
 def test_registry_covers_baseline_configs():
     assert set(SCENARIOS) == {
-        "dev3", "probe1k", "event100k", "suspect1m", "multidc1m",
-        "degraded1m",
+        "dev3", "probe1k", "event100k", "stream100k", "suspect1m",
+        "multidc1m", "degraded1m",
     }
 
 
@@ -74,8 +74,11 @@ def test_event100k_timing_pins():
     assert out["t9999_ms"] <= 3000
 
 
+@pytest.mark.slow  # ~36s at CPU: full 1M multi-DC scenario
 def test_multidc1m_timing_pins():
     """Config 5: 1M nodes, 8 segments, sharded over the device mesh.
+    Behind -m slow per the long-horizon-1M policy (PR 3/4, like
+    suspect1m).
     Every segment must be reached; cross-segment spread rides the
     slower WAN cadence, so whole-cluster t99 sits above the one-segment
     LAN figure but within a small multiple of it."""

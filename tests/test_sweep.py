@@ -39,8 +39,10 @@ from consul_tpu.sim.engine import (
     membership_scan,
     run_sweep,
     sparse_membership_scan,
+    streamcast_scan,
     swim_scan,
 )
+from consul_tpu.streamcast import StreamcastConfig, streamcast_init
 from consul_tpu.sweep import Universe, make_preset, pareto_mask
 from consul_tpu.sweep.frontier import ENTRYPOINT_METRICS, SweepReport
 from consul_tpu.sweep.universe import make_sweep, stacked_init
@@ -79,6 +81,11 @@ _SMALL = {
         base=MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),)),
         k_slots=8), sparse_membership_init,
         sparse_membership_scan, 8, (3,)),
+    "streamcast": (StreamcastConfig(n=64, events=10, chunks=2,
+                                    window=3, fanout=3, chunk_budget=2,
+                                    rate=0.4, names=3, loss=0.05,
+                                    delivery="edges"),
+                   streamcast_init, streamcast_scan, 10, None),
 }
 
 
@@ -223,6 +230,28 @@ class TestKnobValidation:
     def test_unknown_path_rejected(self):
         with pytest.raises(ValueError, match="has no field"):
             self._mk(SwimConfig(n=64, subject=1), "losss")
+
+    def test_streamcast_rate_and_budget_sweepable(self):
+        # The offered load and the pipelined bandwidth cap are the
+        # streamcast tuning family; neither feeds a shape (rate is
+        # jnp arithmetic in the arrival derivation, chunk_budget a
+        # rank comparison).
+        cfg = _SMALL["streamcast"][0]
+        self._mk(cfg, "rate", 0.5, entrypoint="streamcast")  # no raise
+        self._mk(cfg, "chunk_budget", 3, entrypoint="streamcast")
+
+    def test_streamcast_shape_fields_rejected(self):
+        cfg = _SMALL["streamcast"][0]
+        for knob in ("window", "chunks", "events", "names"):
+            with pytest.raises(ValueError,
+                               match="shapes or trace-time structure"):
+                self._mk(cfg, knob, 4, entrypoint="streamcast")
+
+    def test_streamcast_fanout_rejected_under_edges(self):
+        cfg = _SMALL["streamcast"][0]  # delivery="edges"
+        with pytest.raises(ValueError,
+                           match=r"\[n, fanout\].*aggregate"):
+            self._mk(cfg, "fanout", 4, entrypoint="streamcast")
 
     def test_fault_severity_paths_allowed_for_lifeguard(self):
         from consul_tpu.sim.faults import (
@@ -498,6 +527,8 @@ class TestFaultMatrixCoverage:
             make_preset("faultmatrix", universes=5)
         with pytest.raises(ValueError, match="grid preset"):
             make_preset("tuning", universes=5)
+        with pytest.raises(ValueError, match="grid preset"):
+            make_preset("streamload", universes=5)
 
     def test_seed_preset_universe_override(self):
         uni = make_preset("seeds4k", universes=3)

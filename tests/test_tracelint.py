@@ -26,7 +26,7 @@ from consul_tpu.analysis import (
 PKG_ROOT = pathlib.Path(consul_tpu.__file__).resolve().parent
 LINT_TREES = [
     PKG_ROOT / "models", PKG_ROOT / "sim", PKG_ROOT / "ops",
-    PKG_ROOT / "parallel", PKG_ROOT / "sweep",
+    PKG_ROOT / "parallel", PKG_ROOT / "sweep", PKG_ROOT / "streamcast",
 ]
 
 
@@ -469,6 +469,22 @@ class TestRepoGate:
             target == tree or target.is_relative_to(tree)
             for tree in LINT_TREES
         ), "consul_tpu/parallel left the linted trees"
+        violations = lint_paths([target])
+        assert violations == [], "\n".join(
+            v.format() for v in violations
+        )
+
+    def test_streamcast_plane_is_covered_and_clean(self):
+        # The pipelined event-stream subsystem (windowed chunk gossip
+        # + the in-flight allocator) is traced code end to end; pin
+        # consul_tpu/streamcast into the zero-violations gate BY NAME
+        # so a tree reshuffle can't silently drop the newest traced
+        # subsystem from LINT_TREES.
+        target = PKG_ROOT / "streamcast"
+        assert any(
+            target == tree or target.is_relative_to(tree)
+            for tree in LINT_TREES
+        ), "consul_tpu/streamcast left the linted trees"
         violations = lint_paths([target])
         assert violations == [], "\n".join(
             v.format() for v in violations
