@@ -272,6 +272,103 @@ def _streaming_curve() -> dict:
     return out
 
 
+def _geo_section() -> dict:
+    """The geo/WAN plane (consul_tpu/geo): adaptive vs fixed
+    anti-entropy under a scheduled bandwidth brownout at the
+    north-star n=1M (8 DCs, Vivaldi-derived link latencies), plus the
+    Vivaldi coordinate relative error at convergence — the first bench
+    datapoints for models/multidc-style and models/vivaldi workloads.
+
+    Both arms run the SAME faulted universe and seed; the only delta
+    is ``adaptive`` (the one-knob A/B seam).  The deliverable is the
+    per-segment convergence split (t50/t99) and the loud per-link
+    accounting: admitted WAN bytes, overflow, and stale waste.  CPU
+    containers reduce n under the same MemAvailable discipline as the
+    sparse/streaming sections — the A/B's SHAPE is the deliverable
+    there; the 1M magnitude belongs to accelerators.
+    """
+    import dataclasses as _dc
+
+    import jax as _jax
+
+    from consul_tpu.geo.latency import derive_wan_latency
+    from consul_tpu.geo.model import GeoConfig
+    from consul_tpu.sim.engine import run_geo
+    from consul_tpu.sim.faults import BandwidthSchedule, FaultSchedule
+
+    n = 1_000_000
+    steps = 160
+    out: dict = {}
+    if _jax.default_backend() == "cpu":
+        # ~13 bytes per (node, event) covers the LAN draw + bool/int32
+        # planes with slack at E=16.
+        n = 100_000
+        avail_gb = _available_memory_gb()
+        if avail_gb is not None and avail_gb < n * 16 * 13 / 1e9:
+            n = 25_000
+        out["geo_reduced_n"] = (
+            f"cpu backend: A/B measured at n={n} "
+            f"({'unknown' if avail_gb is None else round(avail_gb, 1)}"
+            "GB available)"
+        )
+    latency, vinfo = derive_wan_latency(
+        8, 5, tick_ms=LAN.gossip_interval_ms, seed=0, rounds=400,
+        wan_window=8,
+    )
+    base_bytes = 16 * 1400.0
+    # Brownout to 10% capacity over ticks [5, 120), healed after.
+    faults = FaultSchedule(bandwidth=(
+        BandwidthSchedule(pieces=((5, 0.1 * base_bytes),
+                                  (120, 64 * base_bytes))),
+    ))
+    # All events originate in DC 0 (non-bridge nodes): the primary-DC
+    # publish pattern, so every outbound link must carry the FULL
+    # event set through the brownout — the regime the adaptive
+    # transfer exists for.
+    seg_size, bridges, events = n // 8, 5, 16
+    origins = tuple(
+        bridges + e * (seg_size - bridges) // events
+        for e in range(events)
+    )
+    cfg = GeoConfig(
+        n=n, segments=8, bridges_per_segment=bridges, events=events,
+        wan_latency_ticks=latency, wan_window=8,
+        wan_capacity_bytes=base_bytes, wan_msg_bytes=1400,
+        wan_queue_bytes=2 * base_bytes, ae_batch=16, adaptive=True,
+        loss_wan=0.05, origins=origins, faults=faults,
+    )
+    arms = {}
+    for label, adaptive in (("adaptive", True), ("fixed", False)):
+        rep = run_geo(
+            _dc.replace(cfg, adaptive=adaptive), steps=steps, seed=0,
+            warmup=False,
+        )
+        s = rep.summary()
+        arms[label] = {
+            "t50_ms": s["t50_ms"],
+            "t99_ms": s["t99_ms"],
+            "segment_t99_ms": s["segment_t99_ms"],
+            "wan_admitted_bytes": s["wan_admitted_bytes"],
+            "wan_overflow_units": s["wan_overflow_units"],
+            "wan_wasted_units": s["wan_wasted_units"],
+            "accounting_ok": s["accounting_ok"],
+        }
+    out.update({
+        "geo_n": n,
+        "geo_steps": steps,
+        "geo_segments": cfg.segments,
+        "geo_events": cfg.events,
+        "geo_arms": arms,
+        "geo_adaptive_t99_ms": arms["adaptive"]["t99_ms"],
+        "geo_fixed_t99_ms": arms["fixed"]["t99_ms"],
+        "vivaldi_rel_rtt_error": round(vinfo["rel_rtt_error"], 4),
+        "vivaldi_mean_cross_rtt_ms": round(
+            vinfo["mean_cross_rtt_ms"], 1
+        ),
+    })
+    return out
+
+
 def _run_multichip() -> dict:
     """The sharded-plane datapoint (consul_tpu/parallel/shard.py)."""
     import subprocess
@@ -562,6 +659,18 @@ def main() -> None:
 
     streaming = section("streaming", _streaming, {})
 
+    # The geo/WAN plane (consul_tpu/geo): the adaptive-vs-fixed
+    # anti-entropy A/B under a scheduled bandwidth brownout — the
+    # multi-DC scenario axis, with Vivaldi coordinate error as the
+    # latency-derivation evidence.
+    def _geo():
+        try:
+            return _geo_section()
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"geo_error": str(e)[:200]}
+
+    geo = section("geo", _geo, {})
+
     # The multichip datapoint: the sharded plane across real devices,
     # or its forced-host-device validation on single-chip containers —
     # replaces the dryrun-only multichip story.
@@ -651,6 +760,7 @@ def main() -> None:
                     **lifeguard,
                     **sweep,
                     **streaming,
+                    **geo,
                     **membership,
                     **multichip,
                     **jaxlint_peaks,

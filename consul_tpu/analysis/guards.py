@@ -35,10 +35,12 @@ ENGINE_ENTRYPOINTS = (
     "membership_scan",
     "sparse_membership_scan",
     "streamcast_scan",
+    "geo_scan",
     "sharded_broadcast_scan",
     "sharded_membership_scan",
     "sharded_sparse_membership_scan",
     "sharded_streamcast_scan",
+    "sharded_geo_scan",
 )
 
 

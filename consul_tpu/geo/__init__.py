@@ -1,0 +1,36 @@
+"""Geo-distributed WAN plane (consul_tpu/geo).
+
+Couples the repo's three isolated multi-DC pieces into one measured
+subsystem: Vivaldi-derived per-link latency (``latency``), the
+latency-delayed bandwidth-capped WAN link plane with adaptive
+anti-entropy (``model``), and the host-side convergence/accounting
+report (``report``).  The scan entrypoints live in sim/engine
+(``geo_scan``/``run_geo``) with the sharded twin in parallel/shard
+(``sharded_geo_scan``).
+"""
+
+from consul_tpu.geo.latency import (
+    dc_placement,
+    derive_wan_latency,
+)
+from consul_tpu.geo.model import (
+    GeoConfig,
+    GeoState,
+    admit_link_units,
+    expand_delivery_slots,
+    geo_init,
+    geo_round,
+)
+from consul_tpu.geo.report import GeoReport
+
+__all__ = [
+    "GeoConfig",
+    "GeoState",
+    "GeoReport",
+    "admit_link_units",
+    "dc_placement",
+    "derive_wan_latency",
+    "expand_delivery_slots",
+    "geo_init",
+    "geo_round",
+]

@@ -1,0 +1,161 @@
+"""Vivaldi-derived WAN link latencies: coordinates feed the geo plane.
+
+models/vivaldi.py reproduces the reference's network coordinate system
+(vendor/serf/coordinate/) but nothing downstream consumed it — the rtt
+CLI command reads live agent coordinates, and the simulation plane used
+hand-picked latency constants.  This module closes that loop for the
+geo subsystem:
+
+  1. **Latent DC-clustered placement.**  Each segment (DC) gets a
+     cluster center in a latent metric space; its bridge nodes sit at
+     the center plus LAN-scale jitter.  Ground-truth RTT between two
+     nodes is the latent distance (``euclidean_rtt_model``), so
+     intra-DC RTTs are ~``lan_scale`` and inter-DC RTTs are
+     ~``dc_scale`` — the planetary-scale geometry the WAN pool exists
+     for.  The latent scale is deliberately exaggerated relative to
+     real WAN RTTs so that per-link latency spans MULTIPLE gossip
+     ticks at the LAN discretization (one tick = 200 ms): the delay
+     structure has to be visible to the simulator to be studied.
+  2. **Vivaldi to convergence.**  The bridge population runs
+     ``vivaldi_round`` until the coordinates predict pairwise RTTs
+     (median relative error is returned so the convergence claim is
+     measured, never assumed).
+  3. **Per-link latency matrix.**  The CONVERGED coordinates — not the
+     latent ground truth — yield the per-segment-pair one-way delivery
+     latency in ticks: mean estimated RTT between the two bridge sets,
+     halved, discretized, clipped into the geo ring window.  This is
+     exactly how a real deployment would derive WAN timing from its
+     coordinate subsystem (consul's ``rtt`` command arithmetic over
+     segment members).
+
+Everything here is host-side and deterministic per ``seed``: the
+returned matrix is a static tuple-of-tuples that hashes into
+``GeoConfig`` (one jit program per derived geometry), pinned by
+tests/test_geo.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models.vivaldi import (
+    VivaldiConfig,
+    euclidean_rtt_model,
+    raw_distance,
+    vivaldi_init,
+    vivaldi_round,
+)
+
+#: Default latent scales (seconds).  dc_scale sets inter-center
+#: distances so derived one-way latencies SPAN the geo ring window
+#: (1..6 ticks at the LAN 200 ms tick with wan_window=8, measured);
+#: lan_scale is the intra-DC jitter around each center.
+DC_SCALE_S = 0.6
+LAN_SCALE_S = 0.01
+
+
+def dc_placement(segments: int, bridges_per_segment: int, seed: int = 0,
+                 dim_true: int = 3, dc_scale: float = DC_SCALE_S,
+                 lan_scale: float = LAN_SCALE_S) -> jax.Array:
+    """f32[S*B, dim_true] latent positions of the bridge population:
+    per-segment cluster centers plus per-node jitter, bridges of
+    segment s at rows [s*B, (s+1)*B)."""
+    key = jax.random.PRNGKey(seed)
+    k_centers, k_jitter = jax.random.split(key)
+    centers = (
+        jax.random.normal(k_centers, (segments, dim_true)) * dc_scale
+    )
+    jitter = (
+        jax.random.normal(
+            k_jitter, (segments * bridges_per_segment, dim_true)
+        )
+        * lan_scale
+    )
+    return jnp.repeat(centers, bridges_per_segment, axis=0) + jitter
+
+
+def derive_wan_latency(segments: int, bridges_per_segment: int,
+                       tick_ms: float, seed: int = 0, rounds: int = 400,
+                       wan_window: int = 8, dim_true: int = 3,
+                       rtt_jitter: float = 0.05,
+                       dc_scale: float = DC_SCALE_S,
+                       lan_scale: float = LAN_SCALE_S):
+    """Run Vivaldi to convergence over the DC-clustered placement and
+    derive the per-segment-pair one-way WAN latency in ticks.
+
+    Returns ``(latency_ticks, info)``:
+
+    * ``latency_ticks`` — tuple[S][S] of ints, symmetric, diagonal 0,
+      off-diagonal clipped into [1, wan_window - 1] (the geo ring
+      window's addressable delays).  Static and hashable: it goes
+      straight into ``GeoConfig.wan_latency_ticks``.
+    * ``info`` — the measured convergence evidence: median relative
+      RTT error of the converged coordinates vs the latent ground
+      truth over cross-DC bridge pairs (``rel_rtt_error``), the mean
+      cross-DC RTT in ms, rounds run, and the population size.
+    """
+    if wan_window < 2:
+        raise ValueError(f"wan_window={wan_window} leaves no room for a "
+                         "latency of >= 1 tick")
+    positions = dc_placement(segments, bridges_per_segment, seed=seed,
+                             dim_true=dim_true, dc_scale=dc_scale,
+                             lan_scale=lan_scale)
+    nv = segments * bridges_per_segment
+    cfg = VivaldiConfig(n=nv, rtt_jitter=rtt_jitter)
+    rtt_fn = euclidean_rtt_model(positions)
+    step = jax.jit(lambda s, k: vivaldi_round(s, k, cfg, rtt_fn))
+    st = vivaldi_init(cfg)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), 0x6E0)
+    for i in range(rounds):
+        st = step(st, jax.random.fold_in(key, i))
+
+    # Converged pairwise estimates (DistanceTo semantics, adjustments
+    # included when positive) and the latent ground truth.
+    idx = jnp.arange(nv, dtype=jnp.int32)
+    i = jnp.repeat(idx, nv)
+    j = jnp.tile(idx, nv)
+    est = np.asarray(
+        _estimated_rtt_matrix(st, i, j).reshape(nv, nv)
+    )
+    true = np.asarray(rtt_fn(i, j).reshape(nv, nv))
+
+    seg = np.arange(nv) // bridges_per_segment
+    cross = seg[:, None] != seg[None, :]
+    rel_err = float(np.median(
+        np.abs(est[cross] - true[cross]) / np.maximum(true[cross], 1e-9)
+    ))
+
+    # Per-link mean estimated RTT between the two bridge sets.
+    rtt_sd = np.zeros((segments, segments))
+    for s in range(segments):
+        for d in range(segments):
+            if s == d:
+                continue
+            block = est[np.ix_(seg == s, seg == d)]
+            rtt_sd[s, d] = float(block.mean())
+    rtt_sd = 0.5 * (rtt_sd + rtt_sd.T)  # RTT is symmetric by contract
+
+    one_way_ticks = np.rint(rtt_sd * 1000.0 / 2.0 / tick_ms)
+    ticks = np.clip(one_way_ticks, 1, wan_window - 1).astype(int)
+    np.fill_diagonal(ticks, 0)
+    latency = tuple(tuple(int(v) for v in row) for row in ticks)
+    info = {
+        "rel_rtt_error": rel_err,
+        "mean_cross_rtt_ms": float(
+            rtt_sd[~np.eye(segments, dtype=bool)].mean() * 1000.0
+        ),
+        "rounds": rounds,
+        "population": nv,
+    }
+    return latency, info
+
+
+def _estimated_rtt_matrix(st, i: jax.Array, j: jax.Array) -> jax.Array:
+    """coordinate.go DistanceTo over index arrays (the models/vivaldi
+    estimated_rtt arithmetic, kept here so the derivation is explicit
+    about using the CONVERGED coordinates, not the latent truth)."""
+    dist = raw_distance(st.vec[i], st.height[i], st.vec[j], st.height[j])
+    adjusted = dist + st.adjustment[i] + st.adjustment[j]
+    return jnp.where(adjusted > 0.0, adjusted, dist)

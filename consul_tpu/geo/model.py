@@ -1,0 +1,524 @@
+"""Geo-distributed WAN plane: multi-DC gossip with latency-delayed,
+bandwidth-capped cross-segment links and adaptive anti-entropy.
+
+This model couples the three previously-isolated pieces of the repo's
+multi-DC story into one measured plane:
+
+  * **Latency coupling** (models/vivaldi.py -> consul_tpu/geo/latency):
+    per-segment-pair one-way delivery latency in ticks, derived from
+    converged Vivaldi coordinates over a latent DC-clustered placement.
+    WAN units admitted onto link (s, d) at tick t land at
+    ``t + latency[s, d]`` through a small per-link delay ring — the
+    same static-window discretization trick ``degraded_late`` uses for
+    the ack tail, applied to propagation delay.
+  * **Bandwidth fault schedule** (sim/faults.py BandwidthSchedule):
+    each directed segment pair carries at most ``capacity(t)`` bytes
+    per tick.  Anti-entropy units past the capacity defer into a
+    bounded per-link queue (the reliable state-transfer session);
+    gossip units are UDP-like chatter — a congested link DROPS them.
+    Either way every unit is COUNTED, never silent — the loud
+    accounting contract, with the per-tick identity
+
+        offered + queue_prev == admitted + queue + overflow
+
+    pinned per link by tests/test_geo.py.
+  * **Adaptive anti-entropy** ("A State Transfer Method That Adapts to
+    Network Bandwidth Variations in Geographic State Machine
+    Replication", PAPERS.md): a push-style state-transfer leg between
+    bridge sets whose per-round offer size follows an EWMA of the
+    link's observed admitted throughput (plus one probe unit to
+    re-ramp after a brownout heals), vs a fixed-size baseline —
+    ``adaptive: bool`` is the one-knob A/B seam.
+
+The study payload is E concurrent broadcast items (``events``): each
+event originates at one node and must reach every node of every
+segment.  Within a segment, LAN gossip runs receiver-side Poissonized
+(the aggregate mode whose distributional equivalence to the exact
+scatter path tests/test_aggregate.py pins) — the scalable, device-local
+mode.  Across segments, EVERY unit is exact: WAN gossip copies and
+anti-entropy units are individually admitted against the capacity,
+delayed by the ring, and delivered to one uniformly-drawn bridge of
+the destination segment, so the link accounting is a census, not an
+estimate.
+
+Why adaptive beats fixed under a brownout (the mechanism, not just the
+claim): the sender sizes its offer from DELAYED feedback — it sees the
+destination's bridge-known set ``latency[s, d]`` ticks late (the
+``known_hist`` ring), and its queued units were selected at enqueue
+time.  A fixed-size sender under a brownout fills its queue with
+near-duplicate picks (it keeps re-offering the same missing events
+every round until feedback returns), so the scarce admitted capacity
+drains stale duplicates (``wasted`` counts them) and the rest
+overflows; the adaptive sender offers ~the admitted rate, keeps its
+queue short, and its picks stay fresh.  bench.py's "geo" section
+measures exactly this A/B at 1M nodes under a scheduled brownout.
+
+Deviation from models/multidc.py: bridges have no per-event WAN
+transmit budget — the link capacity IS the WAN budget here (that is
+the point of the plane); LAN budgets are unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import bernoulli_mask
+from consul_tpu.protocol import retransmit_limit
+from consul_tpu.protocol.profiles import GossipProfile, LAN, WAN
+from consul_tpu.sim.faults import (
+    FaultSchedule,
+    extra_loss_at,
+    link_capacity_at,
+)
+
+#: Static ceiling on per-link units/tick (the delivery slot plane is
+#: [S^2, cap_units]); a config asking for more should raise
+#: wan_msg_bytes instead of melting the slot expansion.
+MAX_CAP_UNITS = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class GeoConfig:
+    """Static (trace-time) parameters of a geo/WAN study.
+
+    ``wan_latency_ticks`` is the Vivaldi-derived per-segment-pair
+    one-way latency matrix (tuple[S][S] of ints, diagonal 0,
+    off-diagonal in [1, wan_window - 1]); empty = every cross link at
+    1 tick (the degenerate geometry).  ``wan_capacity_bytes`` is the
+    static per-link ceiling in bytes/tick — BandwidthSchedule faults
+    only ever tighten it.  ``adaptive`` switches the anti-entropy
+    offer sizing between the EWMA controller (``ae_gain`` is the
+    sweepable gain) and the fixed ``ae_batch`` baseline; everything
+    else about the two arms is identical, so the A/B is one knob.
+
+    Rate-like knobs (the sweep plane vmaps them): ``loss_lan``,
+    ``loss_wan``, ``ae_gain``, and ``faults.*`` severities including
+    ``faults.bandwidth[*].scale``.  ``faults`` supports loss ramps
+    (extra WAN loss over time) and bandwidth schedules; the node-level
+    primitives model membership dynamics this plane does not simulate
+    and are rejected loudly.
+    """
+
+    n: int
+    segments: int = 8
+    bridges_per_segment: int = 3
+    events: int = 8
+    lan_profile: GossipProfile = LAN
+    wan_profile: GossipProfile = WAN
+    loss_lan: float = 0.0
+    loss_wan: float = 0.0
+    wan_latency_ticks: tuple = ()
+    wan_window: int = 8               # L: delay-ring slots
+    wan_capacity_bytes: float = 64 * 1400.0
+    wan_msg_bytes: int = 1400         # one WAN unit (gossip or AE)
+    wan_queue_bytes: float = 128 * 1400.0
+    ae_batch: int = 8                 # fixed-mode offer / adaptive cap
+    adaptive: bool = True
+    ae_gain: float = 0.2              # EWMA gain of the controller
+    origins: tuple = ()               # per-event origin nodes
+    faults: FaultSchedule = FaultSchedule()
+
+    def __post_init__(self):
+        if self.n % self.segments != 0:
+            raise ValueError("n must divide evenly into segments")
+        if self.bridges_per_segment >= self.seg_size:
+            raise ValueError("segment smaller than its bridge set")
+        if self.events < 1:
+            raise ValueError(f"events={self.events} must be >= 1")
+        if self.wan_window < 2:
+            raise ValueError(
+                f"wan_window={self.wan_window} leaves no room for a "
+                "latency of >= 1 tick"
+            )
+        if self.wan_msg_bytes < 1:
+            raise ValueError("wan_msg_bytes must be >= 1")
+        if not 1 <= self.cap_units <= MAX_CAP_UNITS:
+            raise ValueError(
+                f"wan_capacity_bytes/wan_msg_bytes = {self.cap_units} "
+                f"units/tick outside [1, {MAX_CAP_UNITS}] — the "
+                "delivery slot plane is sized by this ratio; raise "
+                "wan_msg_bytes alongside the capacity"
+            )
+        if self.ae_batch < 1:
+            raise ValueError(f"ae_batch={self.ae_batch} must be >= 1")
+        if self.faults.partitions or self.faults.degraded or \
+                self.faults.churn:
+            raise ValueError(
+                "geo consumes loss ramps and bandwidth schedules only; "
+                "partitions/degraded/churn model membership dynamics "
+                "this plane does not simulate — compose them onto a "
+                "membership study instead"
+            )
+        if self.wan_latency_ticks:
+            S = self.segments
+            if len(self.wan_latency_ticks) != S or any(
+                len(row) != S for row in self.wan_latency_ticks
+            ):
+                raise ValueError(
+                    f"wan_latency_ticks must be {S}x{S} to match "
+                    f"segments={S}"
+                )
+            for s, row in enumerate(self.wan_latency_ticks):
+                for d, lat in enumerate(row):
+                    if s == d:
+                        continue
+                    if not 1 <= lat <= self.wan_window - 1:
+                        raise ValueError(
+                            f"wan_latency_ticks[{s}][{d}]={lat} outside "
+                            f"[1, {self.wan_window - 1}] (the ring "
+                            "window's addressable delays)"
+                        )
+        for o in self.origins:
+            if not 0 <= o < self.n:
+                raise ValueError(f"origin {o} outside [0, {self.n})")
+        if self.origins and len(self.origins) != self.events:
+            raise ValueError(
+                f"{len(self.origins)} origins for events={self.events}"
+            )
+
+    # -- layout -----------------------------------------------------------
+    @property
+    def seg_size(self) -> int:
+        return self.n // self.segments
+
+    @property
+    def n_links(self) -> int:
+        return self.segments * self.segments
+
+    @property
+    def fanout_lan(self) -> int:
+        return self.lan_profile.gossip_nodes
+
+    @property
+    def fanout_wan(self) -> int:
+        return self.wan_profile.gossip_nodes
+
+    @property
+    def profile(self) -> GossipProfile:
+        """The clock-defining profile (one tick = one LAN gossip
+        interval) — the field name the sweep/report planes read."""
+        return self.lan_profile
+
+    @property
+    def tx_limit_lan(self) -> int:
+        return retransmit_limit(
+            self.lan_profile.retransmit_mult, self.seg_size
+        )
+
+    @property
+    def wan_rate(self) -> float:
+        """P(a bridge runs a WAN gossip round in a given LAN tick) —
+        the multidc Poisson-staggered cadence ratio."""
+        return min(
+            self.lan_profile.gossip_interval_ms
+            / self.wan_profile.gossip_interval_ms,
+            1.0,
+        )
+
+    # -- link budgets -----------------------------------------------------
+    @property
+    def cap_units(self) -> int:
+        """Static per-link ceiling in units/tick (= the delivery slot
+        count per link)."""
+        return int(self.wan_capacity_bytes // self.wan_msg_bytes)
+
+    @property
+    def queue_units(self) -> int:
+        return int(self.wan_queue_bytes // self.wan_msg_bytes)
+
+    @property
+    def event_origins(self) -> tuple:
+        """Per-event origin nodes: the explicit tuple, or events dealt
+        round-robin across segments at non-bridge offsets (event e ->
+        segment e % S, offset past the bridge block) so every event
+        must climb LAN -> bridge -> WAN (the flood path), for ANY
+        (events, segments) combination."""
+        if self.origins:
+            return self.origins
+        S, ss, B = self.segments, self.seg_size, self.bridges_per_segment
+        span = ss - B                    # non-bridge rows per segment
+        per_seg = -(-self.events // S)   # ceil: events dealt per segment
+        return tuple(
+            (e % S) * ss + B + (e // S) * span // per_seg
+            for e in range(self.events)
+        )
+
+    def latency_flat(self) -> tuple:
+        """tuple[S*S] of per-link one-way latencies in ticks (row-major
+        (src, dst); self links 0; default geometry = 1 tick)."""
+        S = self.segments
+        if self.wan_latency_ticks:
+            return tuple(
+                lat for row in self.wan_latency_ticks for lat in row
+            )
+        return tuple(
+            0 if s == d else 1 for s in range(S) for d in range(S)
+        )
+
+
+class GeoState(NamedTuple):
+    knows: jax.Array       # bool[n, E] — node holds event e
+    tx_lan: jax.Array      # int32[n, E] — LAN transmit budget
+    ring: jax.Array        # int32[L, S*S, E] — in-flight WAN units
+    queue: jax.Array       # int32[S*S, E] — deferred (queued) units
+    known_hist: jax.Array  # bool[L, S, E] — bridge-known history ring
+    ewma: jax.Array        # f32[S*S] — EWMA admitted units/tick
+    # Admitted capacity spent on events the destination's bridge set
+    # already held (counted at link exit, before the loss draw — the
+    # capacity was consumed either way).
+    wasted: jax.Array      # int32 scalar
+    tick: jax.Array        # int32 scalar
+
+
+def geo_init(cfg: GeoConfig) -> GeoState:
+    n, E, S, L = cfg.n, cfg.events, cfg.segments, cfg.wan_window
+    origins = jnp.asarray(cfg.event_origins, jnp.int32)
+    ev = jnp.arange(E, dtype=jnp.int32)
+    knows = (
+        jnp.zeros((n, E), jnp.bool_).at[origins, ev].set(True)
+    )
+    tx_lan = (
+        jnp.zeros((n, E), jnp.int32)
+        .at[origins, ev].set(cfg.tx_limit_lan)
+    )
+    return GeoState(
+        knows=knows,
+        tx_lan=tx_lan,
+        ring=jnp.zeros((L, S * S, E), jnp.int32),
+        queue=jnp.zeros((S * S, E), jnp.int32),
+        known_hist=jnp.zeros((L, S, E), jnp.bool_),
+        # Optimistic start at the static ceiling: the first brownout
+        # tick pulls it down within ~1/gain rounds.
+        ewma=jnp.full((S * S,), float(cfg.cap_units), jnp.float32),
+        wasted=jnp.int32(0),
+        tick=jnp.int32(0),
+    )
+
+
+def admit_link_units(counts: jax.Array, cap_units: jax.Array,
+                     queue_units: int):
+    """Admit a per-link unit stream against per-link capacity.
+
+    ``counts`` int32[S2, M] — units offered per (link, stream
+    position), in admission-priority order (deferred queue first, then
+    fresh anti-entropy, then fresh gossip); ``cap_units`` int32[S2] —
+    this tick's per-link capacity.  Each link admits greedily in
+    stream order up to its capacity; leftovers defer greedily up to
+    ``queue_units``; the rest overflows.  Returns ``(admitted,
+    deferred, overflow)``, each int32[S2, M], with
+
+        counts == admitted + deferred + overflow   (elementwise)
+
+    — the conservation the per-tick link accounting identity is built
+    from.  Pure and shape-static; property-tested against a
+    sequential numpy reference in tests/test_geo.py.
+    """
+    prior = jnp.cumsum(counts, axis=1) - counts
+    admitted = jnp.clip(cap_units[:, None] - prior, 0, counts)
+    left = counts - admitted
+    prior_l = jnp.cumsum(left, axis=1) - left
+    deferred = jnp.clip(queue_units - prior_l, 0, left)
+    overflow = left - deferred
+    return admitted, deferred, overflow
+
+
+def _p_wan(cfg: GeoConfig, tick: jax.Array):
+    """Per-unit WAN delivery survival this tick: the static loss_wan
+    times any scheduled loss ramps (independent drop processes)."""
+    base = 1.0 - jnp.asarray(cfg.loss_wan, jnp.float32)
+    if cfg.faults.ramps:
+        return base * (1.0 - extra_loss_at(cfg.faults, tick))
+    return base
+
+
+def expand_delivery_slots(arriving: jax.Array, cap_units: int):
+    """Unpack per-(link, event) unit counts into the static delivery
+    slot plane: ``(ev_slot, valid)`` each [S2, cap_units], slot j of a
+    link carrying the event whose cumulative count interval covers j.
+    Counts never exceed ``cap_units`` per link (each ring slot holds
+    one tick's admissions, and admission is capped), so no unit is
+    silently truncated."""
+    ends = jnp.cumsum(arriving, axis=1)                    # [S2, E]
+    j = jnp.arange(cap_units, dtype=jnp.int32)             # [U]
+    ev_slot = jnp.sum(
+        (ends[:, None, :] <= j[None, :, None]).astype(jnp.int32),
+        axis=2,
+    )                                                      # [S2, U]
+    valid = j[None, :] < ends[:, -1:]
+    return ev_slot, valid
+
+
+def geo_round(state: GeoState, key: jax.Array, cfg: GeoConfig):
+    """One LAN tick of the geo plane.
+
+    Returns ``(next_state, outs)`` with ``outs`` the per-tick
+    ``(per_segment, offered, admitted, queued, overflow, wasted)``:
+    ``per_segment`` int32[S] counts nodes holding ALL events (the
+    convergence curve), the link counters are int32[S2] per directed
+    link in units (x ``wan_msg_bytes`` for bytes), ``queued`` is the
+    post-tick queue depth, and ``wasted`` the cumulative delivered
+    units whose event the destination's bridge set already held.
+    """
+    n, S, ss = cfg.n, cfg.segments, cfg.seg_size
+    B, E, L = cfg.bridges_per_segment, cfg.events, cfg.wan_window
+    S2, U = cfg.n_links, cfg.cap_units
+    t = state.tick
+    k_lan, k_gossip, k_tgt, k_loss = jax.random.split(key, 4)
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seg = idx // ss
+    knows = state.knows
+
+    # -- 1. LAN gossip: receiver-side Poissonized per (segment, event) --
+    senders = knows & (state.tx_lan > 0)                   # [n, E]
+    per_seg_senders = jnp.sum(
+        senders.reshape(S, ss, E).astype(jnp.int32), axis=1
+    ).astype(jnp.float32)                                  # [S, E]
+    lam = (
+        (per_seg_senders[seg] - senders.astype(jnp.float32))
+        * cfg.fanout_lan
+        * (1.0 - jnp.asarray(cfg.loss_lan, jnp.float32))
+        / max(ss - 1, 1)
+    )
+    got_lan = (
+        jax.random.uniform(k_lan, (n, E)) < -jnp.expm1(-lam)
+    ) & ~knows
+
+    # -- 2. WAN feedback: bridge-known masks + the delayed belief ------
+    bridge_rows = knows.reshape(S, ss, E)[:, :B, :]
+    bk = jnp.any(bridge_rows, axis=1)                      # bool[S, E]
+    bk_cnt = jnp.sum(
+        bridge_rows.astype(jnp.int32), axis=1
+    ).astype(jnp.float32)                                  # [S, E]
+    known_hist = state.known_hist.at[t % L].set(bk)
+    lat = jnp.asarray(cfg.latency_flat(), jnp.int32)       # [S2]
+    link = jnp.arange(S2, dtype=jnp.int32)
+    src_idx, dst_idx = link // S, link % S
+    cross = src_idx != dst_idx
+    # What the src believes the dst knows: the dst's bridge-known mask
+    # from latency[s, d] ticks ago (initial slots are all-False, so
+    # early beliefs say "dst knows nothing" — offers err loud, not
+    # silent).  lat >= 1 on cross links keeps this read clear of the
+    # slot just written.
+    belief = known_hist[(t - lat) % L, dst_idx]            # [S2, E]
+    src_bk = bk[src_idx]                                   # [S2, E]
+
+    # -- 3. anti-entropy offers (the adaptive seam) --------------------
+    missing = src_bk & ~belief & cross[:, None]
+    rank = jnp.cumsum(missing.astype(jnp.int32), axis=1) - missing
+    if cfg.adaptive:
+        # Offer what the link is observed to carry (the EWMA of
+        # admitted throughput) MINUS what is already sitting in the
+        # sender's own output queue, +1 probe unit so the controller
+        # re-ramps when a brownout heals.  Both terms are sender-local
+        # observables — the adaptive-SMR method's "match the transfer
+        # size to the measured bandwidth" rule, which keeps the pipe
+        # full but never builds the stale backlog the fixed arm pays
+        # for.  ae_batch caps it (the fixed arm's size), so adaptive
+        # never offers MORE than the baseline — the A/B differs only
+        # in restraint.
+        backlog = jnp.sum(state.queue, axis=1)
+        batch = jnp.clip(
+            jnp.floor(state.ewma).astype(jnp.int32) + 1 - backlog,
+            0, cfg.ae_batch,
+        )
+    else:
+        batch = jnp.full((S2,), cfg.ae_batch, jnp.int32)
+    ae = (missing & (rank < batch[:, None])).astype(jnp.int32)
+
+    # -- 4. WAN gossip offers (Poisson-staggered bridge chatter) -------
+    lam_g = (
+        bk_cnt[src_idx]
+        * (cfg.wan_rate * cfg.fanout_wan / max(S - 1, 1))
+        * cross[:, None].astype(jnp.float32)
+    )
+    gossip = jax.random.poisson(k_gossip, lam_g).astype(jnp.int32)
+
+    # -- 5. admission against the bandwidth schedule -------------------
+    cap_f = link_capacity_at(
+        cfg.faults, t, S, base=cfg.wan_capacity_bytes
+    ).reshape(S2)
+    cap_units = jnp.clip(
+        jnp.floor(cap_f / cfg.wan_msg_bytes), 0, U
+    ).astype(jnp.int32)
+    cap_units = jnp.where(cross, cap_units, 0)  # self links carry nothing
+    stream = jnp.concatenate([state.queue, ae, gossip], axis=1)
+    adm, deferred, ovf = admit_link_units(
+        stream, cap_units, cfg.queue_units
+    )
+    admitted_e = adm[:, :E] + adm[:, E:2 * E] + adm[:, 2 * E:]
+    # Gossip is UDP-like chatter: a congested link DROPS it — loudly,
+    # into overflow — rather than deferring it; only the anti-entropy
+    # stream (the reliable state-transfer session the adaptive
+    # controller sizes) occupies the bounded queue.  AE precedes
+    # gossip in stream order, so reclassifying gossip's deferral steals
+    # nothing from the queue budget AE could have used.
+    queue = deferred[:, :E] + deferred[:, E:2 * E]
+    offered_fresh = jnp.sum(ae + gossip, axis=1)           # [S2]
+    admitted_tot = jnp.sum(admitted_e, axis=1)
+    overflow_tot = jnp.sum(ovf, axis=1) + jnp.sum(
+        deferred[:, 2 * E:], axis=1
+    )
+
+    # -- 6. the latency ring: deliver this tick's arrivals, enqueue ----
+    arriving = state.ring[t % L]                           # [S2, E]
+    ring = state.ring.at[t % L].set(0)
+    ring = ring.at[(t + lat) % L, link].add(admitted_e)
+
+    ev_slot, valid = expand_delivery_slots(arriving, U)
+    # Each unit targets one uniformly-drawn bridge of the destination
+    # segment (bridges are the first B rows of each segment block).
+    tb = jax.random.randint(k_tgt, (S2, U), 0, B, dtype=jnp.int32)
+    recv = dst_idx[:, None] * ss + tb
+    live = valid & bernoulli_mask(k_loss, (S2, U), _p_wan(cfg, t))
+    flat = jnp.where(live, recv * E + ev_slot, n * E)
+    hits = (
+        jnp.zeros((n * E,), jnp.bool_)
+        .at[flat.ravel()].set(True, mode="drop")
+        .reshape(n, E)
+    )
+    got_wan = hits & ~knows
+    # Capacity spent on events the dst bridge set already held — the
+    # goodput leak the adaptive controller exists to shrink.  Counted
+    # at link exit over ALL arriving units (before the loss draw: the
+    # link carried the unit whether or not the copy then survived).
+    wasted = state.wasted + jnp.sum(
+        arriving * bk[dst_idx].astype(jnp.int32), dtype=jnp.int32
+    )
+
+    # -- 7. merge + budgets --------------------------------------------
+    newly = got_lan | got_wan
+    new_knows = knows | newly
+    tx_lan = jnp.maximum(
+        state.tx_lan - jnp.where(senders, cfg.fanout_lan, 0), 0
+    )
+    tx_lan = jnp.where(newly, cfg.tx_limit_lan, tx_lan)
+
+    gain = jnp.asarray(cfg.ae_gain, jnp.float32)
+    ewma = (
+        (1.0 - gain) * state.ewma + gain * admitted_tot.astype(jnp.float32)
+    )
+
+    per_segment = jnp.sum(
+        jnp.all(new_knows, axis=1).reshape(S, ss).astype(jnp.int32),
+        axis=1,
+    )
+    outs = (
+        per_segment, offered_fresh, admitted_tot,
+        jnp.sum(queue, axis=1), overflow_tot, wasted,
+    )
+    nxt = GeoState(
+        knows=new_knows,
+        tx_lan=tx_lan,
+        ring=ring,
+        queue=queue,
+        known_hist=known_hist,
+        ewma=ewma,
+        wasted=wasted,
+        tick=t + 1,
+    )
+    return nxt, outs

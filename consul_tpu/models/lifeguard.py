@@ -116,6 +116,15 @@ class LifeguardConfig(SwimConfig):
                 "aggregate delivery supports at most one Partition; "
                 "use delivery='edges' for stacked partitions"
             )
+        if self.faults.bandwidth:
+            # Bandwidth schedules cap per-link WAN bytes — a quantity
+            # this model has no link plane for; accepting one would
+            # silently measure a fault-free universe.
+            raise ValueError(
+                "BandwidthSchedule faults apply to the geo/WAN plane "
+                "(consul_tpu/geo) only; this model has no per-link "
+                "byte accounting to cap"
+            )
 
 
 def lifeguard_init(cfg: LifeguardConfig) -> LifeguardState:

@@ -1419,6 +1419,248 @@ def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
 
 
 # ---------------------------------------------------------------------------
+# Sharded geo/WAN plane (multi-DC, latency-delayed bandwidth-capped links).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange"),
+    donate_argnums=(0,),
+)
+def sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
+                     mesh: Mesh, exchange: str = "alltoall"):
+    """Sharded twin of ``sim.engine.geo_scan``: segments are laid out
+    CONTIGUOUSLY over the mesh (``segments % D == 0``, each device
+    owning ``segments/D`` whole DCs), so ALL LAN traffic — the
+    receiver-side Poissonized per-segment gossip — is device-local and
+    only WAN units (anti-entropy + bridge gossip) cross the mesh: the
+    ICI/DCN ↔ LAN/WAN analogy of SURVEY.md §5 stated as a layout.
+
+    The link plane (beliefs, offers, admission against the bandwidth
+    schedule, the latency ring, the EWMA controller) is REPLICATED —
+    it is a pure function of the replicated per-segment bridge-known
+    masks and the replicated round keys, so every shard steps it
+    bit-identically.  Delivery slots are replicated draws; each shard
+    emits only the slots whose SOURCE segment it owns, local
+    deliveries scatter directly, and remote ones ride the
+    per-destination outbox (pack_outbox -> exchange_outbox,
+    ``exchange`` = ``"alltoall"`` | ``"ring"``).  D == 1 is bit-equal
+    to the unsharded scan; returns ``(final_state, (*outs,
+    outbox_overflow))``.
+
+    ``state`` is donated (jaxlint J3, same contract as the unsharded
+    scan): callers pass a fresh init positionally."""
+    from consul_tpu.geo.model import (
+        GeoState,
+        _p_wan,
+        admit_link_units,
+        expand_delivery_slots,
+    )
+    from consul_tpu.ops import bernoulli_mask
+    from consul_tpu.sim.faults import link_capacity_at
+
+    n, S, ss = cfg.n, cfg.segments, cfg.seg_size
+    B, E, L = cfg.bridges_per_segment, cfg.events, cfg.wan_window
+    S2, U = cfg.n_links, cfg.cap_units
+    d_shards = int(mesh.devices.size)
+    if S % d_shards:
+        raise ValueError(
+            f"segments={S} does not divide over {d_shards} devices — "
+            "the geo layout owns whole DCs per device"
+        )
+    spd = S // d_shards
+    blk = block_size(n, mesh)
+    # Per-shard emission bound: a shard sends only the slots whose
+    # SOURCE segment it owns — spd * S links x U slots (the c x-mean
+    # discipline of outbox_budget wants the per-shard stream length).
+    budget = outbox_budget(spd * S * U, d_shards)
+
+    def tick(carry, k):
+        st, ob_ov = carry
+        me = jax.lax.axis_index(NODE_AXIS)
+        start = me * blk
+        t = st.tick
+        k_lan, k_gossip, k_tgt, k_loss = jax.random.split(k, 4)
+        knows = st.knows
+        rows_l = jnp.arange(blk, dtype=jnp.int32)
+        seg_l = rows_l // ss                       # local segment index
+
+        # -- 1. LAN gossip: per-segment Poissonized, device-local ----
+        senders = knows & (st.tx_lan > 0)
+        per_seg_senders = jnp.sum(
+            senders.reshape(spd, ss, E).astype(jnp.int32), axis=1
+        ).astype(jnp.float32)
+        lam = (
+            (per_seg_senders[seg_l] - senders.astype(jnp.float32))
+            * cfg.fanout_lan
+            * (1.0 - jnp.asarray(cfg.loss_lan, jnp.float32))
+            / max(ss - 1, 1)
+        )
+        got_lan = (
+            _rows(jax.random.uniform(k_lan, (n, E)), start, blk)
+            < -jnp.expm1(-lam)
+        ) & ~knows
+
+        # -- 2. bridge-known masks: local slices, replicated assembly -
+        bridge_rows = knows.reshape(spd, ss, E)[:, :B, :]
+        seg_slot = me * spd + jnp.arange(spd, dtype=jnp.int32)
+        bk = jax.lax.psum(
+            jnp.zeros((S, E), jnp.int32)
+            .at[seg_slot].set(jnp.any(bridge_rows, axis=1)
+                              .astype(jnp.int32)),
+            NODE_AXIS,
+        ) > 0
+        bk_cnt = jax.lax.psum(
+            jnp.zeros((S, E), jnp.int32)
+            .at[seg_slot].set(jnp.sum(bridge_rows.astype(jnp.int32),
+                                      axis=1)),
+            NODE_AXIS,
+        ).astype(jnp.float32)
+        known_hist = st.known_hist.at[t % L].set(bk)
+        lat = jnp.asarray(cfg.latency_flat(), jnp.int32)
+        link = jnp.arange(S2, dtype=jnp.int32)
+        src_idx, dst_idx = link // S, link % S
+        cross = src_idx != dst_idx
+        belief = known_hist[(t - lat) % L, dst_idx]
+        src_bk = bk[src_idx]
+
+        # -- 3-5. offers + admission (replicated, as unsharded) ------
+        missing = src_bk & ~belief & cross[:, None]
+        rank = jnp.cumsum(missing.astype(jnp.int32), axis=1) - missing
+        if cfg.adaptive:
+            # EWMA-throughput minus the standing backlog (+1 probe):
+            # the adaptive-SMR sizing rule — see geo.model.geo_round.
+            backlog = jnp.sum(st.queue, axis=1)
+            batch = jnp.clip(
+                jnp.floor(st.ewma).astype(jnp.int32) + 1 - backlog,
+                0, cfg.ae_batch,
+            )
+        else:
+            batch = jnp.full((S2,), cfg.ae_batch, jnp.int32)
+        ae = (missing & (rank < batch[:, None])).astype(jnp.int32)
+        lam_g = (
+            bk_cnt[src_idx]
+            * (cfg.wan_rate * cfg.fanout_wan / max(S - 1, 1))
+            * cross[:, None].astype(jnp.float32)
+        )
+        gossip = jax.random.poisson(k_gossip, lam_g).astype(jnp.int32)
+        cap_f = link_capacity_at(
+            cfg.faults, t, S, base=cfg.wan_capacity_bytes
+        ).reshape(S2)
+        cap_units = jnp.clip(
+            jnp.floor(cap_f / cfg.wan_msg_bytes), 0, U
+        ).astype(jnp.int32)
+        cap_units = jnp.where(cross, cap_units, 0)
+        stream = jnp.concatenate([st.queue, ae, gossip], axis=1)
+        adm, deferred, ovf = admit_link_units(
+            stream, cap_units, cfg.queue_units
+        )
+        admitted_e = adm[:, :E] + adm[:, E:2 * E] + adm[:, 2 * E:]
+        # Congested links DROP gossip (loudly) — only the AE stream
+        # defers into the queue; see geo.model.geo_round.
+        queue = deferred[:, :E] + deferred[:, E:2 * E]
+        offered_fresh = jnp.sum(ae + gossip, axis=1)
+        admitted_tot = jnp.sum(admitted_e, axis=1)
+        overflow_tot = jnp.sum(ovf, axis=1) + jnp.sum(
+            deferred[:, 2 * E:], axis=1
+        )
+
+        # -- 6. latency ring + delivery over the outbox seam ---------
+        arriving = st.ring[t % L]
+        ring = st.ring.at[t % L].set(0)
+        ring = ring.at[(t + lat) % L, link].add(admitted_e)
+        ev_slot, valid = expand_delivery_slots(arriving, U)
+        tb = jax.random.randint(k_tgt, (S2, U), 0, B, dtype=jnp.int32)
+        recv = dst_idx[:, None] * ss + tb
+        live = valid & bernoulli_mask(k_loss, (S2, U), _p_wan(cfg, t))
+        # Each slot is emitted by exactly ONE shard — its source
+        # segment's owner; locals scatter directly, remotes ride the
+        # outbox, so the union over shards is the unsharded slot set.
+        okf = (live & ((src_idx // spd) == me)[:, None]).ravel()
+        recv_f = recv.ravel()
+        ev_f = ev_slot.ravel()
+        dest = recv_f // blk
+        local = okf & (dest == me)
+        flat = jnp.where(local, (recv_f - start) * E + ev_f, blk * E)
+        hits = (
+            jnp.zeros((blk * E,), jnp.bool_)
+            .at[flat].set(True, mode="drop")
+        )
+        packed, dropped = pack_outbox(
+            dest, okf & (dest != me), (recv_f, ev_f), d_shards, budget
+        )
+        ib_recv, ib_ev = exchange_outbox(packed, backend=exchange)
+        got_in = ib_recv >= 0
+        flat_in = jnp.where(
+            got_in, (ib_recv - start) * E + ib_ev, blk * E
+        )
+        hits = hits.at[flat_in].set(True, mode="drop").reshape(blk, E)
+        got_wan = hits & ~knows
+        wasted = st.wasted + jnp.sum(
+            arriving * bk[dst_idx].astype(jnp.int32), dtype=jnp.int32
+        )
+        ob_ov = ob_ov + jax.lax.psum(dropped, NODE_AXIS)
+
+        # -- 7. merge + budgets --------------------------------------
+        newly = got_lan | got_wan
+        new_knows = knows | newly
+        tx_lan = jnp.maximum(
+            st.tx_lan - jnp.where(senders, cfg.fanout_lan, 0), 0
+        )
+        tx_lan = jnp.where(newly, cfg.tx_limit_lan, tx_lan)
+        gain = jnp.asarray(cfg.ae_gain, jnp.float32)
+        ewma = (
+            (1.0 - gain) * st.ewma
+            + gain * admitted_tot.astype(jnp.float32)
+        )
+        per_segment = jax.lax.psum(
+            jnp.zeros((S,), jnp.int32).at[seg_slot].set(
+                jnp.sum(
+                    jnp.all(new_knows, axis=1)
+                    .reshape(spd, ss).astype(jnp.int32),
+                    axis=1,
+                )
+            ),
+            NODE_AXIS,
+        )
+        outs = (
+            per_segment, offered_fresh, admitted_tot,
+            jnp.sum(queue, axis=1), overflow_tot, wasted, ob_ov,
+        )
+        nxt = GeoState(
+            knows=new_knows, tx_lan=tx_lan, ring=ring, queue=queue,
+            known_hist=known_hist, ewma=ewma, wasted=wasted,
+            tick=t + 1,
+        )
+        return (nxt, ob_ov), outs
+
+    def body(st, key):
+        keys = jax.random.split(key, steps)
+        (final, _ov), outs = jax.lax.scan(
+            tick, (st, jnp.int32(0)), keys
+        )
+        return final, outs
+
+    state_spec = GeoState(
+        knows=P(NODE_AXIS, None),
+        tx_lan=P(NODE_AXIS, None),
+        ring=P(),
+        queue=P(),
+        known_hist=P(),
+        ewma=P(),
+        wasted=P(),
+        tick=P(),
+    )
+    run = shard_map(
+        body, mesh=mesh,
+        in_specs=(state_spec, P()),
+        out_specs=(state_spec, tuple(P() for _ in range(7))),
+        check_rep=False,
+    )
+    return run(state, key)
+
+
+# ---------------------------------------------------------------------------
 # Standalone multichip datapoint: python -m consul_tpu.parallel.shard
 # ---------------------------------------------------------------------------
 

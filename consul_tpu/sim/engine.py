@@ -45,6 +45,7 @@ from consul_tpu.models.swim import (
 from consul_tpu.parallel import make_mesh, shard_state
 from consul_tpu.parallel.shard import (
     sharded_broadcast_scan,
+    sharded_geo_scan,
     sharded_membership_scan,
     sharded_sparse_membership_scan,
     sharded_streamcast_scan,
@@ -662,6 +663,88 @@ def run_streamcast(
     )
 
 
+def _geo_scan(state, key: jax.Array, cfg, steps: int):
+    """Run ``steps`` LAN ticks of the geo/WAN plane
+    (consul_tpu/geo.model.geo_round); returns ``(final_state, outs)``
+    with ``outs`` the per-tick ``(per_segment, offered, admitted,
+    queued, overflow, wasted)`` link-accounting counters.  Unjitted
+    impl of :data:`geo_scan` (see :func:`_broadcast_scan`)."""
+    # Imported at call time: geo.model depends on sim.faults, so a
+    # module-level import here would close an import cycle through
+    # the package __init__s (the models.lifeguard pattern).
+    from consul_tpu.geo.model import geo_round
+
+    def tick(carry, k):
+        return geo_round(carry, k, cfg)
+
+    keys = jax.random.split(key, steps)
+    return jax.lax.scan(tick, state, keys)
+
+
+geo_scan = jax.jit(
+    _geo_scan, static_argnames=("cfg", "steps"), donate_argnums=(0,),
+)
+
+
+def run_geo(
+    cfg,
+    steps: int,
+    seed: int = 0,
+    warmup: bool = True,
+    mesh=None,
+    exchange: str = "alltoall",
+):
+    """Geo-distributed WAN study (cfg: GeoConfig): E concurrent events
+    spread over S segments through latency-delayed, bandwidth-capped
+    WAN links with adaptive (or fixed) anti-entropy between the bridge
+    sets.  Returns a :class:`consul_tpu.geo.GeoReport` with per-segment
+    convergence times and the per-link transfer census.
+
+    ``mesh=`` shards the per-node planes over the device mesh with
+    segments laid out contiguously (parallel/shard.py: LAN traffic
+    stays device-local, only WAN units ride the outbox seam) and fills
+    ``report.shard_overflow``; ``exchange`` picks the outbox transport
+    (see :func:`run_broadcast`).  ``state`` is donated on both paths
+    (jaxlint J3): callers pass a fresh init positionally.
+    """
+    from consul_tpu.geo.model import geo_init
+    from consul_tpu.geo.report import GeoReport
+
+    _check_exchange(exchange, mesh)
+    key = jax.random.PRNGKey(seed)
+    if mesh is not None:
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return sharded_geo_scan(st, k, c, s, mesh, exchange)
+    else:
+        scan = geo_scan
+    _final, outs, wall = _timed(
+        lambda: geo_init(cfg), scan, key, cfg, steps, warmup
+    )
+    if mesh is not None:
+        *outs, shard_ov = outs
+        shard_ov = int(np.asarray(shard_ov)[-1])
+    else:
+        shard_ov = None
+    per_segment, offered, admitted, queued, overflow, wasted = outs
+    return GeoReport(
+        n=cfg.n,
+        segments=cfg.segments,
+        events=cfg.events,
+        ticks=steps,
+        tick_ms=cfg.lan_profile.gossip_interval_ms,
+        msg_bytes=cfg.wan_msg_bytes,
+        adaptive=cfg.adaptive,
+        per_segment=np.asarray(per_segment),
+        offered=np.asarray(offered),
+        admitted=np.asarray(admitted),
+        queued=np.asarray(queued),
+        overflow=np.asarray(overflow),
+        wasted=np.asarray(wasted),
+        wall_s=wall,
+        shard_overflow=shard_ov,
+    )
+
+
 def run_swim(
     cfg: SwimConfig,
     steps: int,
@@ -823,6 +906,22 @@ def jaxlint_registry(include=("small", "big"),
                     s, k, stcfg, ststeps, mesh, ex),
                 stcfg.n, devices=d, per_chip=True)
 
+    from consul_tpu.geo.model import GeoConfig, geo_init
+
+    def add_sharded_geo(tag: str, d: int, gcfg, gsteps: int,
+                        exchanges: tuple = ("alltoall",)) -> None:
+        if d > len(jax.devices()):
+            return
+        mesh = make_mesh(jax.devices()[:d])
+        for ex in exchanges:
+            sfx = "" if ex == "alltoall" else f"/{ex}"
+            add(f"sharded_geo@{tag}/D{d}{sfx}",
+                "sharded_geo_scan",
+                lambda: geo_init(gcfg),
+                lambda s, k, ex=ex: sharded_geo_scan(
+                    s, k, gcfg, gsteps, mesh, ex),
+                gcfg.n, devices=d, per_chip=True)
+
     if "small" in include:
         mcfg = MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),))
         bcfg = BroadcastConfig(n=64, fanout=3, delivery="edges")
@@ -855,6 +954,17 @@ def jaxlint_registry(include=("small", "big"),
         add("streamcast@small", "streamcast_scan",
             lambda: streamcast_init(stcfg),
             lambda s, k: streamcast_scan(s, k, stcfg, 8), stcfg.n)
+        gecfg = GeoConfig(n=64, segments=8, bridges_per_segment=2,
+                          events=4, wan_window=4, wan_msg_bytes=100,
+                          wan_capacity_bytes=800.0,
+                          wan_queue_bytes=1600.0, ae_batch=4,
+                          loss_wan=0.05)
+        add("geo@small", "geo_scan",
+            lambda: geo_init(gecfg),
+            lambda s, k: geo_scan(s, k, gecfg, 8), gecfg.n)
+        for d in sharded_devices:
+            add_sharded_geo("small", d, gecfg, 8,
+                            exchanges=("alltoall", "ring"))
         for d in sharded_devices:
             add_sharded_streamcast("small", d, stcfg, 8,
                                    exchanges=("alltoall", "ring"))
@@ -917,6 +1027,18 @@ def jaxlint_registry(include=("small", "big"),
             lambda: streamcast_init(stcfg1m),
             lambda s, k: streamcast_scan(s, k, stcfg1m, 150),
             stcfg1m.n)
+        # The geo/WAN plane at the north-star scale: 1M nodes over 8
+        # DCs, 16 concurrent events, bandwidth-capped Vivaldi-latency
+        # links — bench.py's "geo" section shapes.
+        gecfg1m = GeoConfig(n=1_000_000, segments=8,
+                            bridges_per_segment=5, events=16,
+                            wan_window=8, wan_msg_bytes=1400,
+                            wan_capacity_bytes=16 * 1400.0,
+                            wan_queue_bytes=32 * 1400.0, ae_batch=16,
+                            loss_wan=0.05)
+        add("geo@1m", "geo_scan",
+            lambda: geo_init(gecfg1m),
+            lambda s, k: geo_scan(s, k, gecfg1m, 60), gecfg1m.n)
         d = max(
             (d for d in sharded_devices if d <= len(jax.devices())),
             default=0,
@@ -990,6 +1112,12 @@ def jaxlint_registry(include=("small", "big"),
                 chunk_budget=2, rate=0.4, names=3, loss=0.05,
                 delivery="edges"), 8,
              ("rate",), (), 64),
+            ("geo", GeoConfig(n=64, segments=8, bridges_per_segment=2,
+                              events=4, wan_window=4, wan_msg_bytes=100,
+                              wan_capacity_bytes=800.0,
+                              wan_queue_bytes=1600.0, ae_batch=4,
+                              loss_wan=0.05), 8,
+             ("loss_wan",), (), 64),
         )
         for model, cfg, steps, knobs, track, n in sw_small:
             for u in (1, 8):

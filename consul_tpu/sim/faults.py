@@ -20,6 +20,14 @@ Primitives (each optional, all composable):
                 exists for
   ChurnWindow   a window during which each node is independently
                 offline (restarting) with per-tick probability
+  BandwidthSchedule
+                piecewise per-link WAN capacity in bytes/tick (a
+                bandwidth brownout): the geo plane (consul_tpu/geo)
+                caps how many WAN message-bytes cross each segment
+                pair per tick, with overflow counted loudly — the
+                varying-bandwidth environment of "A State Transfer
+                Method That Adapts to Network Bandwidth Variations in
+                Geographic State Machine Replication" (PAPERS.md)
 
 ``compose`` merges two schedules; independent drop processes combine as
 ``1 - prod(1 - p_i)`` (evaluated in :func:`extra_loss_at` /
@@ -113,26 +121,63 @@ class ChurnWindow:
 
 
 @dataclasses.dataclass(frozen=True)
+class BandwidthSchedule:
+    """Piecewise per-link WAN capacity: ``pieces`` is a sorted tuple of
+    (start_tick, bytes_per_tick); before the first piece the link is
+    unconstrained (the consumer's static base capacity applies) and
+    each piece holds until the next one starts (the last holds
+    forever).  ``src``/``dst`` select one directed segment link (-1 =
+    every link), so a single schedule can brown out one WAN path while
+    the rest of the mesh stays healthy.
+
+    ``scale`` multiplies every piece's capacity — the severity knob of
+    a brownout sweep: one static schedule shape, a per-universe traced
+    severity (smaller scale = harder brownout).  Schedules compose by
+    per-link MINIMUM (the tightest constraint wins), and the consumer
+    clips the result to its static base capacity, so a traced scale
+    can never admit more than the static ceiling."""
+
+    pieces: tuple[tuple[int, float], ...]
+    src: int = -1
+    dst: int = -1
+    scale: float = 1.0
+
+    def __post_init__(self):
+        starts = [s for s, _ in self.pieces]
+        if starts != sorted(starts):
+            raise ValueError(
+                f"BandwidthSchedule pieces must be sorted, got {starts}"
+            )
+        for _, cap in self.pieces:
+            if cap < 0:
+                raise ValueError(f"capacity {cap} must be >= 0 bytes/tick")
+        if _concrete(self.scale) and self.scale < 0.0:
+            raise ValueError(f"scale {self.scale} must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultSchedule:
     ramps: tuple[LossRamp, ...] = ()
     partitions: tuple[Partition, ...] = ()
     degraded: tuple[DegradedSet, ...] = ()
     churn: tuple[ChurnWindow, ...] = ()
+    bandwidth: tuple[BandwidthSchedule, ...] = ()
 
     def compose(self, other: "FaultSchedule") -> "FaultSchedule":
         """Union of fault processes; independent drops multiply out at
-        evaluation time."""
+        evaluation time (bandwidth constraints combine by min)."""
         return FaultSchedule(
             ramps=self.ramps + other.ramps,
             partitions=self.partitions + other.partitions,
             degraded=self.degraded + other.degraded,
             churn=self.churn + other.churn,
+            bandwidth=self.bandwidth + other.bandwidth,
         )
 
     @property
     def has_faults(self) -> bool:
         return bool(self.ramps or self.partitions or self.degraded
-                    or self.churn)
+                    or self.churn or self.bandwidth)
 
 
 # ---------------------------------------------------------------------------
@@ -253,3 +298,48 @@ def online_mask(
         return jnp.ones((n,), bool)
     p_off = offline_prob_at(sched, tick)
     return jax.random.uniform(key, (n,)) >= p_off
+
+
+def _link_mask(bs: BandwidthSchedule, segments: int):
+    """Host-built bool[S, S]: the directed links a schedule constrains
+    (``src``/``dst`` are static segment selectors)."""
+    import numpy as np
+
+    mask = np.ones((segments, segments), bool)
+    if bs.src >= 0:
+        if bs.src >= segments:
+            raise ValueError(
+                f"BandwidthSchedule src={bs.src} outside [0, {segments})"
+            )
+        mask &= np.arange(segments)[:, None] == bs.src
+    if bs.dst >= 0:
+        if bs.dst >= segments:
+            raise ValueError(
+                f"BandwidthSchedule dst={bs.dst} outside [0, {segments})"
+            )
+        mask &= np.arange(segments)[None, :] == bs.dst
+    return mask
+
+
+def link_capacity_at(
+    sched: FaultSchedule, tick: jax.Array, segments: int, base: float
+) -> jax.Array:
+    """float32[S, S]: per-directed-link capacity in bytes/tick at
+    ``tick``.  ``base`` is the static per-link ceiling (the unfaulted
+    capacity); schedules only ever tighten it — constraints combine by
+    per-link minimum and the result is clipped to [0, base], so a
+    traced ``scale`` can never admit past the static bound the
+    consumer's slot planes are sized for."""
+    cap = jnp.full((segments, segments), base, jnp.float32)
+    for bs in sched.bandwidth:
+        starts = jnp.asarray([s for s, _ in bs.pieces], jnp.int32)
+        # Index 0 is the pre-schedule sentinel (unconstrained: the base
+        # applies); pieces are scaled by the (possibly traced) severity.
+        vals = jnp.asarray(
+            [0.0] + [c for _, c in bs.pieces], jnp.float32
+        ) * jnp.asarray(bs.scale, jnp.float32)
+        idx = jnp.searchsorted(starts, tick, side="right")
+        val = jnp.where(idx == 0, jnp.float32(base), vals[idx])
+        mask = jnp.asarray(_link_mask(bs, segments), jnp.bool_)
+        cap = jnp.where(mask, jnp.minimum(cap, val), cap)
+    return jnp.clip(cap, 0.0, jnp.float32(base))

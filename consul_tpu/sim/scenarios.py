@@ -11,6 +11,10 @@ the host agent plane lands).
   stream100k  100k-node sustained event stream (consul_tpu/streamcast):
               Poisson 4-chunk events pipelined through an 8-slot
               window, delivered events/sec + t50/t99 + overflow
+  geo100k     100k-node geo/WAN study (consul_tpu/geo): 8 DCs,
+              Vivaldi-derived link latencies, a scheduled bandwidth
+              brownout, adaptive anti-entropy — per-segment
+              convergence + the per-link transfer census
   suspect1m   1M-node suspicion/dead propagation, 30% loss, WAN profile
   multidc1m   1M-node 8-segment multi-DC epidemic broadcast, sharded
               across the device mesh
@@ -150,6 +154,54 @@ def stream100k(seed: int = 0, n: int = 100_000, steps: int = 150,
     }
 
 
+def geo100k(seed: int = 0, n: int = 100_000, steps: int = 120,
+            devices: int = None, exchange: str = "alltoall") -> dict:
+    """100k-node geo/WAN study (consul_tpu/geo): 8 DCs with
+    Vivaldi-derived per-link latency, bandwidth-capped WAN links under
+    a mid-run brownout, and adaptive anti-entropy between the bridge
+    sets — per-segment convergence plus the loud per-link transfer
+    census.
+
+    ``devices`` lays the segments contiguously over the first D
+    devices (``cli sim geo100k --devices D``: LAN traffic stays
+    device-local, WAN units ride the outbox; budget misses reported as
+    shard_overflow); ``exchange`` picks the transport (``--exchange
+    ring`` = the Pallas DMA kernel).  ``n``/``steps`` scale down for
+    CPU smoke runs."""
+    from consul_tpu.geo.latency import derive_wan_latency
+    from consul_tpu.geo.model import GeoConfig
+    from consul_tpu.parallel import mesh_for
+    from consul_tpu.sim.engine import run_geo
+    from consul_tpu.sim.faults import BandwidthSchedule, FaultSchedule
+
+    base_bytes = 16 * 1400.0
+    latency, vinfo = derive_wan_latency(
+        8, 3, tick_ms=LAN.gossip_interval_ms, seed=seed, rounds=300,
+        wan_window=8,
+    )
+    cfg = GeoConfig(
+        n=n, segments=8, bridges_per_segment=3, events=16,
+        wan_latency_ticks=latency, wan_window=8,
+        wan_capacity_bytes=base_bytes, wan_msg_bytes=1400,
+        wan_queue_bytes=2 * base_bytes, ae_batch=16, adaptive=True,
+        loss_wan=0.05,
+        faults=FaultSchedule(bandwidth=(
+            BandwidthSchedule(pieces=((20, 0.2 * base_bytes),
+                                      (80, 64 * base_bytes))),
+        )),
+    )
+    rep = run_geo(cfg, steps=steps, seed=seed, warmup=False,
+                  mesh=mesh_for(devices) if devices else None,
+                  exchange=exchange)
+    return {
+        "scenario": "geo100k",
+        **rep.summary(),
+        "vivaldi_rel_rtt_error": round(vinfo["rel_rtt_error"], 4),
+        **({"devices": devices, "exchange_backend": exchange}
+           if devices else {}),
+    }
+
+
 def suspect1m(seed: int = 0) -> dict:
     """BASELINE config 4: 1M-node suspicion/dead propagation, 30% loss,
     WAN timing."""
@@ -258,6 +310,7 @@ SCENARIOS: dict[str, Callable[..., dict]] = {
     "probe1k": probe1k,
     "event100k": event100k,
     "stream100k": stream100k,
+    "geo100k": geo100k,
     "suspect1m": suspect1m,
     "multidc1m": multidc1m,
     "degraded1m": degraded1m,
@@ -268,7 +321,7 @@ def run_scenario(name: str, seed: int = 0, devices: int = None,
                  exchange: str = None) -> dict:
     """Run a preset by name.  ``devices`` shards the node axis over the
     first D mesh devices for the scenarios that support it (probe1k,
-    event100k, stream100k); asking it of any other preset is an error,
+    event100k, stream100k, geo100k); asking it of any other preset is an error,
     not a silent single-chip run.  ``exchange`` picks the outbox transport of the
     sharded plane and therefore requires ``devices`` — same
     loud-never-silent contract."""

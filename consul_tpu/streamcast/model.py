@@ -128,12 +128,13 @@ class StreamcastConfig:
                 f"done_frac={self.done_frac} outside (0, 1]"
             )
         if self.faults.partitions or self.faults.degraded or \
-                self.faults.churn:
+                self.faults.churn or self.faults.bandwidth:
             raise ValueError(
                 "streamcast consumes loss ramps only; partitions/"
                 "degraded/churn model membership dynamics this plane "
-                "does not simulate — compose them onto a membership "
-                "study instead"
+                "does not simulate, and bandwidth schedules cap the "
+                "geo/WAN link plane (consul_tpu/geo) — compose them "
+                "onto the study that consumes them instead"
             )
         if self.schedule:
             if _concrete(self.rate) and self.rate:

@@ -67,6 +67,16 @@ ENTRYPOINT_METRICS: dict = {
         "offered_events_per_sim_s", "delivered_events_per_sim_s",
         "undelivered_frac", "t50_ms", "t99_ms",
     }),
+    # Geo/WAN plane (consul_tpu/geo): convergence latency vs WAN byte
+    # cost — ``cli sweep`` Paretos (wan_admitted_bytes, t99_ms), both
+    # minimized; overflow/waste ride along as the loud-accounting
+    # columns of the brownout ladder.
+    "geo": frozenset({
+        "converged_frac", "t50_ms", "t99_ms", "seg_t99_ms_worst",
+        "wan_offered_bytes", "wan_admitted_bytes",
+        "wan_overflow_units", "wan_wasted_units",
+        "wan_queue_final_units",
+    }),
 }
 
 
@@ -347,6 +357,39 @@ def summarize_sweep(universe, outs, wall_s: float) -> SweepReport:
                 if ok.size:
                     med[u] = float(np.median(ok))
             metrics[name] = med
+    elif universe.entrypoint == "geo":
+        per_segment, offered, admitted, queued, overflow, wasted = outs
+        per_segment = np.asarray(per_segment)   # [U, steps, S]
+        total = per_segment.sum(axis=2)         # [U, steps]
+        seg_size = n // base.segments
+        msg_bytes = base.wan_msg_bytes
+        metrics["converged_frac"] = total[:, -1].astype(float) / n
+        for frac in (0.50, 0.99):
+            t = first_tick_at_least(total, frac * n)
+            metrics[f"t{int(frac * 100)}_ms"] = (t + 1.0) * tick_ms
+        # Worst segment's t99: the per-DC convergence straggler.
+        seg_t = np.stack([
+            first_tick_at_least(per_segment[:, :, s], 0.99 * seg_size)
+            for s in range(base.segments)
+        ], axis=1)                              # [U, S]
+        metrics["seg_t99_ms_worst"] = (
+            np.max(seg_t, axis=1) + 1.0
+        ) * tick_ms                             # NaN propagates: any
+        #                                         never-converged DC
+        #                                         marks the universe
+        metrics["wan_offered_bytes"] = (
+            np.asarray(offered, float).sum(axis=(1, 2)) * msg_bytes
+        )
+        metrics["wan_admitted_bytes"] = (
+            np.asarray(admitted, float).sum(axis=(1, 2)) * msg_bytes
+        )
+        metrics["wan_overflow_units"] = np.asarray(
+            overflow, float
+        ).sum(axis=(1, 2))
+        metrics["wan_wasted_units"] = np.asarray(wasted, float)[:, -1]
+        metrics["wan_queue_final_units"] = np.asarray(
+            queued, float
+        )[:, -1].sum(axis=1)
     else:  # membership / sparse
         sus_t, dead_t, sus_cells, known = outs
         if universe.track:

@@ -170,11 +170,64 @@ def stream_load_curve(universes=None, seed=0, n=4096, window=8,
     )
 
 
+def wan_brownout(universes=None, seed=0, n=2048, segments=8,
+                 scales=(1.0, 0.5, 0.2, 0.05), steps=160,
+                 brownout_at=4, heal_at=120) -> Universe:
+    """Bandwidth-brownout severity ladder over the geo/WAN plane
+    (consul_tpu/geo): ONE static BandwidthSchedule shape whose
+    ``scale`` rides as the per-universe severity knob, so the whole
+    ladder — healthy control (scale 1.0) down to a 5%-capacity
+    brownout — runs as ONE vmapped program.  Per rung: convergence
+    t50/t99, the worst segment's t99, and the loud per-link accounting
+    (admitted bytes, overflow, stale waste).  Frontier axes:
+    (wan_admitted_bytes, t99_ms) — WAN byte cost vs convergence
+    latency, both minimized."""
+    if universes is not None:
+        raise ValueError(
+            "wanbrownout is a grid preset: U = len(scales), not "
+            "--universes"
+        )
+    from consul_tpu.geo.latency import derive_wan_latency
+    from consul_tpu.geo.model import GeoConfig
+    from consul_tpu.protocol.profiles import LAN
+    from consul_tpu.sim.faults import BandwidthSchedule
+
+    base_bytes = 16 * 1400.0
+    # The piece VALUES are scaled by the severity knob: during the
+    # brownout window the link carries scale x base; after heal_at the
+    # piece value is far above base so min(base, scale * heal) == base
+    # for every rung >= 0.05 — the ladder heals to full capacity.
+    faults = FaultSchedule(bandwidth=(
+        BandwidthSchedule(
+            pieces=((brownout_at, base_bytes), (heal_at, 64 * base_bytes))
+        ),
+    ))
+    latency, _info = derive_wan_latency(
+        segments, 3, tick_ms=LAN.gossip_interval_ms, seed=seed,
+        rounds=300, wan_window=8,
+    )
+    cfg = GeoConfig(
+        n=n, segments=segments, bridges_per_segment=3, events=16,
+        wan_latency_ticks=latency, wan_window=8,
+        wan_capacity_bytes=base_bytes, wan_msg_bytes=1400,
+        wan_queue_bytes=2 * base_bytes, ae_batch=16, adaptive=True,
+        loss_wan=0.05, faults=faults,
+    )
+    return Universe(
+        entrypoint="geo", cfg=cfg, steps=steps,
+        # One shared key: rungs differ ONLY in severity.
+        seeds=(seed,) * len(scales),
+        knobs=("faults.bandwidth[0].scale",),
+        values=(tuple(scales),),
+    )
+
+
 PRESETS: dict = {
     "seeds4k": seed_sweep,
     "tuning": tuning_grid,
     "faultmatrix": fault_matrix,
     "streamload": stream_load_curve,
+    "wanbrownout": wan_brownout,
 }
 
 

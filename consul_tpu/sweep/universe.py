@@ -52,6 +52,11 @@ _SHAPE_FIELDS = frozenset({
     "bridges_per_segment", "indirect_checks", "udp_buffer_size",
     "event_buffer_size", "query_buffer_size", "max_user_event_size",
     "events", "chunks", "window", "names",
+    # geo/WAN plane: the link slot planes, ring window, and queue
+    # bound are all sized by these (consul_tpu/geo/model.py)
+    "wan_latency_ticks", "wan_window", "wan_capacity_bytes",
+    "wan_msg_bytes", "wan_queue_bytes", "ae_batch", "adaptive",
+    "origins", "lan_profile", "wan_profile", "src", "dst",
     # schedule structure (host-validated scatter indices)
     "fail_at", "leave_at", "join_at", "pieces", "subject", "schedule",
     "fail_at_tick", "start", "heal", "end", "seed", "leave_grace_ticks",
@@ -89,6 +94,10 @@ class _EntrypointSpec:
     knob_paths: frozenset
     aggregate_only: frozenset  # legal only under delivery="aggregate"
     fault_paths: bool = False  # "faults.…" severity paths legal
+    # "faults.bandwidth[*].…" paths legal: only the geo plane has the
+    # per-link byte accounting a BandwidthSchedule caps — sweeping its
+    # severity on any other entrypoint would ladder identical universes.
+    bandwidth_paths: bool = False
 
 
 def _sparse_init(cfg):
@@ -107,6 +116,12 @@ def _streamcast_init(cfg):
     from consul_tpu.streamcast.model import streamcast_init
 
     return streamcast_init(cfg)
+
+
+def _geo_init(cfg):
+    from consul_tpu.geo.model import geo_init
+
+    return geo_init(cfg)
 
 
 SWEEP_ENTRYPOINTS: dict = {
@@ -169,6 +184,24 @@ SWEEP_ENTRYPOINTS: dict = {
         knob_paths=frozenset({"loss", "rate", "chunk_budget"}),
         aggregate_only=frozenset({"fanout"}),
         fault_paths=True,
+    ),
+    # The geo/WAN plane (consul_tpu/geo): LAN/WAN loss and the
+    # adaptive controller's EWMA gain are rate knobs, and the
+    # bandwidth-brownout severity rides ``faults.bandwidth[*].scale``
+    # — one static schedule shape, a per-universe traced severity, so
+    # a whole brownout ladder is ONE vmapped program (the wanbrownout
+    # preset).  Everything sizing the link planes (window, capacity,
+    # latency matrix, batch, adaptive) is shape-denied.
+    "geo": _EntrypointSpec(
+        name="geo",
+        init=_geo_init,
+        call=lambda s, k, c, steps, track: engine._geo_scan(
+            s, k, c, steps),
+        base_cfg=lambda c: c,
+        knob_paths=frozenset({"loss_lan", "loss_wan", "ae_gain"}),
+        aggregate_only=frozenset(),
+        fault_paths=True,
+        bandwidth_paths=True,
     ),
 }
 
@@ -276,6 +309,13 @@ def validate_knob(entrypoint: str, cfg, path: str) -> None:
 
     if path in allowed:
         return
+    if path.startswith("faults.bandwidth") and not spec.bandwidth_paths:
+        raise ValueError(
+            f"knob {path!r}: BandwidthSchedule severities only act on "
+            "the geo/WAN link plane — sweeping one on "
+            f"{entrypoint!r} would ladder identical universes "
+            "(the model has no per-link byte accounting to cap)"
+        )
     if spec.fault_paths and path.startswith("faults.") and (
         final in _FAULT_KNOB_FIELDS
     ):

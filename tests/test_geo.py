@@ -1,0 +1,562 @@
+"""Geo-distributed WAN plane (consul_tpu/geo).
+
+The ladder of guarantees, weakest precondition first:
+
+  * link admission kernel == sequential numpy reference (capacity cap,
+    bounded deferral, drop-tail overflow) — property-tested, with the
+    conservation counts == admitted + deferred + overflow.
+  * loud accounting: per link per tick, offered + queue_prev ==
+    admitted + queue + overflow, under healthy AND browned-out links.
+  * latency coupling: a unit admitted on a link with latency L lands
+    at the destination exactly L ticks later (the delay ring).
+  * Vivaldi derivation: the per-link latency matrix is deterministic
+    per seed, symmetric, in-window, and the converged coordinates
+    predict the latent RTTs (measured relative error).
+  * adaptive anti-entropy beats the fixed baseline under a bandwidth
+    brownout: faster t99, less overflow, less stale waste — the
+    adaptive-SMR claim at small n.
+  * sharded exactness: D=1 bit-equal to geo_scan, D=2 == D=1 with
+    outbox overflow 0, ring == all_to_all.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.geo import (
+    GeoConfig,
+    admit_link_units,
+    derive_wan_latency,
+    geo_init,
+)
+from consul_tpu.protocol.profiles import WAN
+from consul_tpu.sim.engine import geo_scan, run_geo
+from consul_tpu.sim.faults import (
+    BandwidthSchedule,
+    ChurnWindow,
+    FaultSchedule,
+    link_capacity_at,
+)
+
+# WAN gossip disabled: the anti-entropy leg is the only cross-DC path,
+# so link-level claims (latency, A/B) are not confounded by chatter.
+WAN_NOGOSSIP = dataclasses.replace(WAN, gossip_nodes=0)
+
+# One shared config for the engine + sharded-exactness tests, so the
+# module pays one compile per DISTINCT program (unsharded, D1, D2,
+# D2/ring) — the test_shard.py budget discipline.
+_SHARDED_CFG = GeoConfig(
+    n=256, segments=4, bridges_per_segment=2, events=6,
+    wan_window=6, wan_latency_ticks=((0, 1, 2, 3), (1, 0, 2, 2),
+                                     (2, 2, 0, 1), (3, 2, 1, 0)),
+    wan_msg_bytes=100, wan_capacity_bytes=1600.0,
+    wan_queue_bytes=3200.0, ae_batch=6, loss_wan=0.05,
+)
+_SHARDED_STEPS = 40
+
+
+# ---------------------------------------------------------------------------
+# BandwidthSchedule: the capacity evaluator vs a host reference.
+# ---------------------------------------------------------------------------
+
+
+def _cap_ref(scheds, tick, segments, base):
+    cap = np.full((segments, segments), base, float)
+    for bs in scheds:
+        val = None
+        for start, v in bs.pieces:
+            if tick >= start:
+                val = v * bs.scale
+        if val is None:
+            continue
+        for s in range(segments):
+            for d in range(segments):
+                if bs.src >= 0 and s != bs.src:
+                    continue
+                if bs.dst >= 0 and d != bs.dst:
+                    continue
+                cap[s, d] = min(cap[s, d], val)
+    return np.clip(cap, 0.0, base)
+
+
+class TestBandwidthSchedule:
+    def test_capacity_matches_reference(self):
+        scheds = (
+            BandwidthSchedule(pieces=((5, 300.0), (20, 1200.0))),
+            BandwidthSchedule(pieces=((10, 150.0),), src=1, scale=0.5),
+            BandwidthSchedule(pieces=((0, 900.0),), src=2, dst=0),
+        )
+        faults = FaultSchedule(bandwidth=scheds)
+        for tick in (0, 4, 5, 9, 10, 19, 20, 50):
+            got = np.asarray(
+                link_capacity_at(faults, jnp.int32(tick), 3, base=1000.0)
+            )
+            np.testing.assert_allclose(
+                got, _cap_ref(scheds, tick, 3, 1000.0), err_msg=str(tick)
+            )
+
+    def test_schedules_compose_by_min(self):
+        a = FaultSchedule(bandwidth=(
+            BandwidthSchedule(pieces=((0, 700.0),)),))
+        b = FaultSchedule(bandwidth=(
+            BandwidthSchedule(pieces=((0, 400.0),)),))
+        cap = np.asarray(
+            link_capacity_at(a.compose(b), jnp.int32(1), 2, base=1000.0)
+        )
+        assert (cap == 400.0).all()
+        assert a.compose(b).has_faults
+
+    def test_scale_never_admits_past_base(self):
+        # A severity scale > 1 (or a huge piece) is clipped to the
+        # static base — the bound the delivery slot planes are sized by.
+        f = FaultSchedule(bandwidth=(
+            BandwidthSchedule(pieces=((0, 500.0),), scale=100.0),))
+        cap = np.asarray(link_capacity_at(f, jnp.int32(3), 2, base=800.0))
+        assert (cap == 800.0).all()
+
+    def test_validation_is_loud(self):
+        with pytest.raises(ValueError, match="sorted"):
+            BandwidthSchedule(pieces=((10, 1.0), (5, 2.0)))
+        with pytest.raises(ValueError, match=">= 0"):
+            BandwidthSchedule(pieces=((0, -4.0),))
+        with pytest.raises(ValueError, match="src=7"):
+            link_capacity_at(
+                FaultSchedule(bandwidth=(
+                    BandwidthSchedule(pieces=((0, 1.0),), src=7),)),
+                jnp.int32(0), 2, base=10.0,
+            )
+
+
+# ---------------------------------------------------------------------------
+# Link admission kernel vs a sequential numpy reference.
+# ---------------------------------------------------------------------------
+
+
+def _admit_ref(counts, cap, qcap):
+    """Greedy sequential reference: admit in stream order up to the
+    link's capacity, defer up to the queue bound, drop the rest."""
+    s2, m = counts.shape
+    adm = np.zeros_like(counts)
+    dfr = np.zeros_like(counts)
+    ovf = np.zeros_like(counts)
+    for link in range(s2):
+        cap_left, q_left = int(cap[link]), int(qcap)
+        for i in range(m):
+            c = int(counts[link, i])
+            a = min(c, cap_left)
+            cap_left -= a
+            d = min(c - a, q_left)
+            q_left -= d
+            adm[link, i], dfr[link, i] = a, d
+            ovf[link, i] = c - a - d
+    return adm, dfr, ovf
+
+
+class TestAdmissionKernel:
+    def test_matches_bruteforce_reference(self):
+        rng = np.random.default_rng(0)
+        kernel = jax.jit(admit_link_units, static_argnames=("queue_units",))
+        for case in range(20):
+            s2, m = int(rng.integers(1, 6)), int(rng.integers(1, 12))
+            counts = rng.integers(0, 7, (s2, m)).astype(np.int32)
+            cap = rng.integers(0, 12, (s2,)).astype(np.int32)
+            qcap = int(rng.integers(0, 10))
+            adm, dfr, ovf = kernel(
+                jnp.asarray(counts), jnp.asarray(cap), qcap
+            )
+            adm, dfr, ovf = map(np.asarray, (adm, dfr, ovf))
+            r_adm, r_dfr, r_ovf = _admit_ref(counts, cap, qcap)
+            np.testing.assert_array_equal(adm, r_adm, err_msg=str(case))
+            np.testing.assert_array_equal(dfr, r_dfr, err_msg=str(case))
+            np.testing.assert_array_equal(ovf, r_ovf, err_msg=str(case))
+            # Conservation: every offered unit is accounted somewhere.
+            np.testing.assert_array_equal(counts, adm + dfr + ovf)
+
+
+# ---------------------------------------------------------------------------
+# Config validation: loud, never silent.
+# ---------------------------------------------------------------------------
+
+
+class TestConfigValidation:
+    def test_latency_matrix_shape_and_range(self):
+        with pytest.raises(ValueError, match="2x2"):
+            GeoConfig(n=64, segments=2, bridges_per_segment=2,
+                      wan_latency_ticks=((0, 1),))
+        with pytest.raises(ValueError, match="outside"):
+            GeoConfig(n=64, segments=2, bridges_per_segment=2,
+                      wan_window=4,
+                      wan_latency_ticks=((0, 9), (1, 0)))
+
+    def test_capacity_slot_bound_is_loud(self):
+        with pytest.raises(ValueError, match="wan_msg_bytes"):
+            GeoConfig(n=64, segments=2, bridges_per_segment=2,
+                      wan_msg_bytes=1, wan_capacity_bytes=1e9)
+
+    def test_node_fault_primitives_rejected(self):
+        with pytest.raises(ValueError, match="membership dynamics"):
+            GeoConfig(n=64, segments=2, bridges_per_segment=2,
+                      faults=FaultSchedule(
+                          churn=(ChurnWindow(0, 5, 0.5),)))
+
+    def test_origins_checked(self):
+        with pytest.raises(ValueError, match="outside"):
+            GeoConfig(n=64, segments=2, bridges_per_segment=2,
+                      events=1, origins=(64,))
+        with pytest.raises(ValueError, match="origins"):
+            GeoConfig(n=64, segments=2, bridges_per_segment=2,
+                      events=2, origins=(0,))
+
+    def test_default_origins_spread_and_non_bridge(self):
+        cfg = GeoConfig(n=64, segments=4, bridges_per_segment=2,
+                        events=4)
+        segs = {o // cfg.seg_size for o in cfg.event_origins}
+        assert segs == {0, 1, 2, 3}
+        assert all(
+            o % cfg.seg_size >= cfg.bridges_per_segment
+            for o in cfg.event_origins
+        )
+
+    def test_default_origins_never_bridges_when_misaligned(self):
+        # events > segments used to wrap raw node strides onto bridge
+        # rows (segment offset 0 < B), silently skipping the
+        # LAN -> bridge -> WAN climb the default documents.
+        for n, s, b, e in ((64, 2, 2, 8), (96, 3, 2, 7), (64, 4, 3, 9)):
+            cfg = GeoConfig(n=n, segments=s, bridges_per_segment=b,
+                            events=e, wan_msg_bytes=100,
+                            wan_capacity_bytes=800.0,
+                            wan_queue_bytes=800.0)
+            origins = cfg.event_origins
+            assert len(set(origins)) == e, (origins, "collision")
+            assert all(o % cfg.seg_size >= b for o in origins), origins
+            assert {o // cfg.seg_size for o in origins} == set(range(s))
+
+    def test_bandwidth_faults_rejected_by_non_geo_consumers(self):
+        # A BandwidthSchedule on a model with no link plane would be
+        # silently ignored — the user would believe they measured a
+        # brownout.  Loud, never silent.
+        from consul_tpu.models.lifeguard import LifeguardConfig
+        from consul_tpu.streamcast import StreamcastConfig
+
+        bw = FaultSchedule(bandwidth=(
+            BandwidthSchedule(pieces=((0, 100.0),)),))
+        with pytest.raises(ValueError, match="geo/WAN plane"):
+            LifeguardConfig(n=64, subject=1, subject_alive=True,
+                            faults=bw)
+        with pytest.raises(ValueError, match="loss ramps only"):
+            StreamcastConfig(n=64, events=2, chunks=2, window=2,
+                             rate=0.1, faults=bw)
+
+
+# ---------------------------------------------------------------------------
+# Latency coupling: the delay ring delivers exactly L ticks later.
+# ---------------------------------------------------------------------------
+
+
+class TestLatencyRing:
+    def test_unit_lands_exactly_latency_ticks_later(self):
+        # Origin 0 IS a bridge of segment 0, AE-only transfer, loss 0:
+        # the single event is offered at tick 0, admitted at tick 0,
+        # and MUST first appear in segment 1 after exactly lat ticks.
+        lat = 3
+        cfg = GeoConfig(
+            n=64, segments=2, bridges_per_segment=2, events=1,
+            wan_profile=WAN_NOGOSSIP, wan_window=5,
+            wan_latency_ticks=((0, lat), (lat, 0)),
+            wan_msg_bytes=100, wan_capacity_bytes=800.0,
+            wan_queue_bytes=800.0, ae_batch=4, origins=(0,),
+        )
+        rep = run_geo(cfg, steps=10, seed=0, warmup=False)
+        seg1 = rep.per_segment[:, 1]
+        assert (seg1[:lat] == 0).all(), seg1
+        assert seg1[lat] >= 1, seg1
+        assert rep.accounting_ok()
+
+
+# ---------------------------------------------------------------------------
+# Loud accounting under pressure.
+# ---------------------------------------------------------------------------
+
+
+class TestAccounting:
+    def test_identity_holds_and_overflow_is_loud(self):
+        # Capacity 1 unit/tick, tiny queue, gossip chatter ON: the
+        # links MUST overflow, and every unit must still be accounted:
+        # offered + queue_prev == admitted + queue + overflow per link
+        # per tick.
+        cfg = GeoConfig(
+            n=128, segments=4, bridges_per_segment=2, events=8,
+            wan_window=4, wan_msg_bytes=100,
+            wan_capacity_bytes=100.0, wan_queue_bytes=200.0,
+            ae_batch=8,
+        )
+        rep = run_geo(cfg, steps=50, seed=1, warmup=False)
+        assert rep.accounting_ok()
+        assert rep.wan_overflow_units > 0
+        # Loud never silent: offered is a census of every fresh unit.
+        assert rep.offered.sum() == (
+            rep.admitted.sum() + rep.overflow.sum()
+            + rep.queued[-1].sum()
+        )
+
+    def test_ample_capacity_never_overflows(self):
+        cfg = dataclasses.replace(
+            _SHARDED_CFG, wan_capacity_bytes=25600.0,
+            wan_queue_bytes=25600.0,
+        )
+        rep = run_geo(cfg, steps=30, seed=1, warmup=False)
+        assert rep.wan_overflow_units == 0
+        assert rep.accounting_ok()
+
+
+# ---------------------------------------------------------------------------
+# The adaptive-SMR claim: adaptive beats fixed under a brownout.
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveAntiEntropy:
+    def test_adaptive_beats_fixed_under_brownout(self):
+        # A 2-DC transfer of 24 events over a link browned out to 2
+        # units/tick (ticks 2..180): the fixed-size sender floods its
+        # queue with picks that go stale behind the backlog (the
+        # belief feedback is latency-delayed), so admitted capacity
+        # drains duplicates and fresh offers overflow; the adaptive
+        # sender sizes its offer to EWMA throughput minus backlog and
+        # converges during the brownout.
+        brownout = FaultSchedule(bandwidth=(
+            BandwidthSchedule(pieces=((2, 200.0), (180, 320000.0))),
+        ))
+        cfg = GeoConfig(
+            n=256, segments=2, bridges_per_segment=2, events=24,
+            wan_profile=WAN_NOGOSSIP, wan_window=5,
+            wan_latency_ticks=((0, 3), (3, 0)),
+            wan_msg_bytes=100, wan_capacity_bytes=3200.0,
+            wan_queue_bytes=6400.0, ae_batch=24, ae_gain=0.3,
+            adaptive=True, faults=brownout,
+        )
+        ra = run_geo(cfg, 220, seed=0, warmup=False)
+        rf = run_geo(
+            dataclasses.replace(cfg, adaptive=False), 220, seed=0,
+            warmup=False,
+        )
+        t_ad, t_fx = ra.convergence_tick(0.99), rf.convergence_tick(0.99)
+        assert t_ad is not None, "adaptive arm never converged"
+        assert t_fx is None or t_ad < t_fx, (t_ad, t_fx)
+        assert ra.wan_overflow_units < rf.wan_overflow_units
+        assert ra.wan_wasted_units < rf.wan_wasted_units
+        assert ra.accounting_ok() and rf.accounting_ok()
+
+
+# ---------------------------------------------------------------------------
+# Vivaldi-derived link matrix.
+# ---------------------------------------------------------------------------
+
+
+class TestVivaldiDerivation:
+    def test_deterministic_per_seed_and_well_formed(self):
+        kw = dict(tick_ms=200.0, rounds=150, wan_window=6)
+        l0, info = derive_wan_latency(4, 2, seed=0, **kw)
+        l0b, _ = derive_wan_latency(4, 2, seed=0, **kw)
+        l3, _ = derive_wan_latency(4, 2, seed=3, **kw)
+        assert l0 == l0b, "latency derivation is not deterministic"
+        assert l0 != l3, "seed does not reach the placement"
+        a = np.asarray(l0)
+        assert (np.diag(a) == 0).all()
+        assert (a == a.T).all(), "RTT-derived latency must be symmetric"
+        off = a[~np.eye(4, dtype=bool)]
+        assert off.min() >= 1 and off.max() <= 5
+        # The convergence claim is measured, not assumed.
+        assert info["rel_rtt_error"] < 0.35, info
+
+    def test_feeds_geo_config(self):
+        lat, _ = derive_wan_latency(4, 2, tick_ms=200.0, seed=0,
+                                    rounds=150, wan_window=6)
+        cfg = GeoConfig(n=64, segments=4, bridges_per_segment=2,
+                        events=2, wan_window=6, wan_latency_ticks=lat,
+                        wan_msg_bytes=100, wan_capacity_bytes=800.0,
+                        wan_queue_bytes=800.0)
+        assert len(cfg.latency_flat()) == 16
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring + retrace discipline.
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    @pytest.mark.single_trace(entrypoints=("geo_scan",))
+    def test_run_geo_report_and_single_trace(self):
+        # The exact (cfg, steps) the sharded ladder uses, so the whole
+        # module pays ONE unsharded compile.
+        rep = run_geo(_SHARDED_CFG, steps=_SHARDED_STEPS, seed=3,
+                      warmup=False)
+        s = rep.summary()
+        assert s["accounting_ok"]
+        assert s["converged_nodes_final"] > 0
+        assert rep.per_segment.shape == (_SHARDED_STEPS, 4)
+        assert rep.offered.shape == (_SHARDED_STEPS, 16)
+        # Second run, same config: the jit cache serves it (the
+        # single_trace marker fails the test otherwise).
+        run_geo(_SHARDED_CFG, steps=_SHARDED_STEPS, seed=3,
+                warmup=False)
+
+    def test_exchange_without_mesh_rejected(self):
+        with pytest.raises(ValueError, match="requires mesh"):
+            run_geo(_SHARDED_CFG, steps=2, exchange="ring")
+
+    def test_scenario_preset_registered(self):
+        from consul_tpu.sim.scenarios import SCENARIOS, run_scenario
+
+        assert "geo100k" in SCENARIOS
+        with pytest.raises(ValueError, match="--devices"):
+            run_scenario("geo100k", exchange="ring")
+
+    def test_sweep_entrypoint_registered_and_validated(self):
+        from consul_tpu.sweep import Universe
+        from consul_tpu.sweep.frontier import ENTRYPOINT_METRICS
+        from consul_tpu.sweep.universe import SWEEP_ENTRYPOINTS
+
+        assert "geo" in SWEEP_ENTRYPOINTS
+        assert "t99_ms" in ENTRYPOINT_METRICS["geo"]
+        # Rate knobs pass, shape-feeding fields are rejected loudly.
+        ok = Universe(entrypoint="geo", cfg=_SHARDED_CFG, steps=4,
+                      seeds=(0,), knobs=("loss_wan",),
+                      values=((0.1,),))
+        assert ok.U == 1
+        Universe(entrypoint="geo", cfg=_SHARDED_CFG, steps=4,
+                 seeds=(0,), knobs=("ae_gain",), values=((0.3,),))
+        for knob in ("wan_window", "ae_batch", "segments",
+                     "wan_capacity_bytes", "events"):
+            with pytest.raises(ValueError,
+                               match="shapes or trace-time structure"):
+                Universe(entrypoint="geo", cfg=_SHARDED_CFG, steps=4,
+                         seeds=(0,), knobs=(knob,), values=((2,),))
+
+    def test_wanbrownout_preset_constructs(self):
+        from consul_tpu.sweep.presets import make_preset
+
+        uni = make_preset("wanbrownout")
+        assert uni.entrypoint == "geo"
+        assert uni.knobs == ("faults.bandwidth[0].scale",)
+        assert uni.U == 4
+        with pytest.raises(ValueError, match="grid preset"):
+            make_preset("wanbrownout", universes=8)
+
+
+# ---------------------------------------------------------------------------
+# Sharded exactness ladder: D=1 bit-equal, D=2 == D=1 (overflow 0),
+# ring == all_to_all.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _sharded_runs():
+    """One config, every plane: unsharded, D=1, D=2, D=2/ring — the
+    whole ladder pays one compile per distinct program."""
+    from consul_tpu.parallel import make_mesh
+    from consul_tpu.parallel.shard import sharded_geo_scan
+
+    key = jax.random.PRNGKey(3)
+    runs = {}
+    _, runs["unsharded"] = geo_scan(
+        geo_init(_SHARDED_CFG), key, _SHARDED_CFG, _SHARDED_STEPS
+    )
+    for label, d, ex in (("D1", 1, "alltoall"), ("D2", 2, "alltoall"),
+                         ("D2/ring", 2, "ring")):
+        mesh = make_mesh(jax.devices()[:d])
+        _, runs[label] = sharded_geo_scan(
+            geo_init(_SHARDED_CFG), key, _SHARDED_CFG, _SHARDED_STEPS,
+            mesh, ex,
+        )
+    return {
+        k: tuple(np.asarray(x) for x in v) for k, v in runs.items()
+    }
+
+
+class TestSharded:
+    def test_d1_bit_equal_to_unsharded(self):
+        runs = _sharded_runs()
+        for i, (a, b) in enumerate(zip(runs["unsharded"],
+                                       runs["D1"][:-1])):
+            np.testing.assert_array_equal(a, b, err_msg=f"out {i}")
+        assert runs["D1"][-1][-1] == 0  # no outbox budget misses
+
+    def test_d2_equals_d1_with_zero_outbox_overflow(self):
+        runs = _sharded_runs()
+        for i, (a, b) in enumerate(zip(runs["D1"], runs["D2"])):
+            np.testing.assert_array_equal(a, b, err_msg=f"out {i}")
+        assert runs["D2"][-1][-1] == 0
+
+    def test_ring_bit_equal_to_alltoall(self):
+        runs = _sharded_runs()
+        for i, (a, b) in enumerate(zip(runs["D2"], runs["D2/ring"])):
+            np.testing.assert_array_equal(a, b, err_msg=f"out {i}")
+
+    def test_run_geo_mesh_reports_shard_overflow(self):
+        from consul_tpu.parallel import make_mesh
+
+        mesh = make_mesh(jax.devices()[:2])
+        rep = run_geo(_SHARDED_CFG, steps=_SHARDED_STEPS, seed=3,
+                      warmup=False, mesh=mesh)
+        assert rep.shard_overflow == 0
+        assert rep.accounting_ok()
+
+    def test_segments_must_divide_over_devices(self):
+        from consul_tpu.parallel import make_mesh
+        from consul_tpu.parallel.shard import sharded_geo_scan
+
+        cfg = GeoConfig(n=192, segments=3, bridges_per_segment=2,
+                        events=2, wan_msg_bytes=100,
+                        wan_capacity_bytes=800.0,
+                        wan_queue_bytes=800.0)
+        mesh = make_mesh(jax.devices()[:2])
+        with pytest.raises(ValueError, match="does not divide"):
+            sharded_geo_scan(geo_init(cfg), jax.random.PRNGKey(0),
+                             cfg, 2, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Long horizon: the 1M-scale study (accelerators; CPU via MemAvailable).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_geo_1m_brownout_end_to_end():
+    """The bench 'geo' section's shape, end to end: 8 DCs under a
+    scheduled brownout at large n (1M on accelerators; reduced on CPU
+    under the MemAvailable guard), adaptive arm — convergence with the
+    accounting identity intact."""
+    from bench import _available_memory_gb
+    from consul_tpu.geo.latency import derive_wan_latency
+    from consul_tpu.protocol.profiles import LAN
+
+    n = 1_000_000
+    if jax.default_backend() == "cpu":
+        avail = _available_memory_gb()
+        n = 100_000 if (avail is None or avail < 24) else 1_000_000
+    latency, info = derive_wan_latency(
+        8, 5, tick_ms=LAN.gossip_interval_ms, seed=0, rounds=400,
+        wan_window=8,
+    )
+    assert info["rel_rtt_error"] < 0.35
+    base_bytes = 16 * 1400.0
+    cfg = GeoConfig(
+        n=n, segments=8, bridges_per_segment=5, events=16,
+        wan_latency_ticks=latency, wan_window=8,
+        wan_capacity_bytes=base_bytes, wan_msg_bytes=1400,
+        wan_queue_bytes=2 * base_bytes, ae_batch=16, adaptive=True,
+        loss_wan=0.05,
+        faults=FaultSchedule(bandwidth=(
+            BandwidthSchedule(pieces=((10, 0.1 * base_bytes),
+                                      (110, 64 * base_bytes))),
+        )),
+    )
+    rep = run_geo(cfg, steps=160, seed=0, warmup=False)
+    assert rep.accounting_ok()
+    assert rep.convergence_tick(0.99) is not None
+    assert rep.wan_overflow_units >= 0

@@ -408,9 +408,18 @@ class TestRepoGate:
         # the zero-findings walks above cover the batched plane (and
         # the traced knob-rebuild path) for the whole family.
         for model in ("swim", "lifeguard", "broadcast", "membership",
-                      "sparse", "streamcast"):
+                      "sparse", "streamcast", "geo"):
             for u in (1, 8):
                 assert f"sweep_{model}@small/U{u}" in small_programs
+
+    def test_registry_covers_geo(self, small_programs):
+        # The geo/WAN plane: the unsharded scan plus the sharded twins
+        # at D in {1, 2} over BOTH exchange backends, all under every
+        # zero-findings gate.
+        assert "geo@small" in small_programs
+        for d in (1, 2):
+            assert f"sharded_geo@small/D{d}" in small_programs
+            assert f"sharded_geo@small/D{d}/ring" in small_programs
 
     def test_registry_covers_streamcast(self, small_programs):
         # The pipelined event-stream plane: the unsharded scan plus
@@ -463,6 +472,19 @@ class TestRepoGate:
         peak = estimate_peak(big_traces["streamcast@1m"]).chip_bytes
         n, w, e = 1_000_000, 8, 4
         floor = n * w * e * (1 + 4)  # bool chunks + f32 uniform draw
+        assert floor <= peak <= BUDGET_16GB, peak
+
+    def test_geo_1m_footprint_pinned(self, big_traces):
+        # J6 prices the geo/WAN plane at the north-star shape (n=1M,
+        # E=16): the peak must cover at least the persistent [n, E]
+        # chunk-of-state planes (bool knows + int32 tx_lan) plus one
+        # [n, E] float32 LAN delivery draw, and stay far inside the
+        # 16 GB/chip gate — the headroom that says events (and the
+        # anti-entropy load) can grow ~50x before sharding becomes
+        # mandatory.
+        peak = estimate_peak(big_traces["geo@1m"]).chip_bytes
+        n, e = 1_000_000, 16
+        floor = n * e * (1 + 4 + 4)  # bool knows + i32 tx + f32 draw
         assert floor <= peak <= BUDGET_16GB, peak
 
     def test_lint_programs_end_to_end(self, small_programs):

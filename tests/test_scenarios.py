@@ -19,8 +19,8 @@ from consul_tpu.sim import SCENARIOS, run_scenario
 
 def test_registry_covers_baseline_configs():
     assert set(SCENARIOS) == {
-        "dev3", "probe1k", "event100k", "stream100k", "suspect1m",
-        "multidc1m", "degraded1m",
+        "dev3", "probe1k", "event100k", "stream100k", "geo100k",
+        "suspect1m", "multidc1m", "degraded1m",
     }
 
 

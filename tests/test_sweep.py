@@ -33,8 +33,10 @@ from consul_tpu.models.membership_sparse import (
     sparse_membership_init,
 )
 from consul_tpu.models.swim import SwimConfig, swim_init
+from consul_tpu.geo import GeoConfig, geo_init
 from consul_tpu.sim.engine import (
     broadcast_scan,
+    geo_scan,
     lifeguard_scan,
     membership_scan,
     run_sweep,
@@ -86,6 +88,11 @@ _SMALL = {
                                     rate=0.4, names=3, loss=0.05,
                                     delivery="edges"),
                    streamcast_init, streamcast_scan, 10, None),
+    "geo": (GeoConfig(n=64, segments=8, bridges_per_segment=2,
+                      events=4, wan_window=4, wan_msg_bytes=100,
+                      wan_capacity_bytes=800.0, wan_queue_bytes=1600.0,
+                      ae_batch=4, loss_wan=0.05),
+            geo_init, geo_scan, 8, None),
 }
 
 

@@ -27,6 +27,7 @@ PKG_ROOT = pathlib.Path(consul_tpu.__file__).resolve().parent
 LINT_TREES = [
     PKG_ROOT / "models", PKG_ROOT / "sim", PKG_ROOT / "ops",
     PKG_ROOT / "parallel", PKG_ROOT / "sweep", PKG_ROOT / "streamcast",
+    PKG_ROOT / "geo",
 ]
 
 
@@ -500,6 +501,22 @@ class TestRepoGate:
             target == tree or target.is_relative_to(tree)
             for tree in LINT_TREES
         ), "consul_tpu/sweep left the linted trees"
+        violations = lint_paths([target])
+        assert violations == [], "\n".join(
+            v.format() for v in violations
+        )
+
+    def test_geo_plane_is_covered_and_clean(self):
+        # The geo/WAN subsystem (latency-delayed bandwidth-capped link
+        # plane + the adaptive anti-entropy controller) is traced code
+        # end to end; pin consul_tpu/geo into the zero-violations gate
+        # BY NAME so a tree reshuffle can't silently drop the newest
+        # traced subsystem from LINT_TREES.
+        target = PKG_ROOT / "geo"
+        assert any(
+            target == tree or target.is_relative_to(tree)
+            for tree in LINT_TREES
+        ), "consul_tpu/geo left the linted trees"
         violations = lint_paths([target])
         assert violations == [], "\n".join(
             v.format() for v in violations
