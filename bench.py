@@ -701,6 +701,97 @@ def main() -> None:
 
     jaxlint_peaks = section("jaxlint", _jaxlint, {})
 
+    # Program-level observability (consul_tpu/obs/profile.py): lower +
+    # compile every big-registry entrypoint and report what XLA says —
+    # cost_analysis flops/bytes-accessed per execution and the
+    # trace/compile wall split; execution wall is additionally
+    # measured per program under two LOUD budgets (skips recorded
+    # per-entry, never silent): OBS_EXECUTE_BUDGET_S (default 60 s
+    # cumulative execute wall; big CPU containers can't afford to
+    # re-run every 1M study) and the global BENCH_SECTION_BUDGET_S
+    # deadline, plus the MemAvailable guard the 1M sections use.
+    def _observability():
+        try:
+            import jax as _jax
+
+            from consul_tpu.obs.profile import profile_registry
+            from consul_tpu.sim.engine import jaxlint_registry
+
+            # Execution is an accelerator measurement: a CPU container
+            # re-running every 1M study would eat the whole bench
+            # budget, so it opts in via OBS_EXECUTE_BUDGET_S; real
+            # accelerators execute by default under a 60 s cumulative
+            # execute-wall budget (skips recorded per entry).
+            exec_env = os.environ.get("OBS_EXECUTE_BUDGET_S", "")
+            on_accel = _jax.default_backend() != "cpu"
+            exec_budget = float(exec_env or ("60" if on_accel else "0"))
+            mem_gb = _available_memory_gb()
+            mem_ok = mem_gb is None or mem_gb > 12.0
+            execute = exec_budget > 0 and mem_ok
+            # Why execution did NOT run, stamped per entry below —
+            # the guards themselves must not skip silently either.
+            exec_off_reason = None
+            if exec_budget > 0 and not mem_ok:
+                exec_off_reason = (
+                    f"MemAvailable {mem_gb:.1f} GB <= 12 GB guard"
+                )
+            elif exec_budget <= 0:
+                exec_off_reason = (
+                    "execution opt-in only on CPU backends "
+                    "(set OBS_EXECUTE_BUDGET_S)"
+                )
+            # The section bounds its own wall too (compiling the big
+            # sparse/dense programs costs minutes on CPU): headline
+            # program first so its flops number always lands, heavy
+            # compiles last, entries past the deadline skipped loudly.
+            obs_budget = float(
+                os.environ.get("OBS_SECTION_BUDGET_S", "240") or 0
+            )
+            deadline = (
+                time.monotonic() + obs_budget if obs_budget else None
+            )
+            if budget_s:
+                hard = t_start + budget_s
+                deadline = min(deadline or hard, hard)
+            programs = jaxlint_registry(include=("big",))
+            order = sorted(
+                programs,
+                key=lambda k: (
+                    k != "swim@1m",
+                    ("sparse" in k) or ("membership@16k" in k),
+                    k,
+                ),
+            )
+            profiles = profile_registry(
+                {k: programs[k] for k in order},
+                execute=execute,
+                execute_budget_s=exec_budget,
+                deadline=deadline,
+            )
+            out = {}
+            for p in profiles:
+                if (p.execute_s is None and p.execute_skipped is None
+                        and exec_off_reason):
+                    p.execute_skipped = exec_off_reason
+                entry = {
+                    "flops": p.flops,
+                    "bytes_accessed": p.bytes_accessed,
+                    "trace_s": round(p.trace_s, 3),
+                    "compile_s": round(p.compile_s, 3),
+                }
+                if p.execute_s is not None:
+                    entry["execute_s"] = round(p.execute_s, 3)
+                if p.execute_skipped:
+                    entry["execute_skipped"] = p.execute_skipped
+                if p.temp_bytes is not None:
+                    entry["temp_bytes"] = p.temp_bytes
+                out[p.name] = entry
+            return {"observability": out}
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"observability_error": str(e)[:200]}
+
+    observability = section("observability", _observability, {})
+
     # Host-plane KV/HTTP throughput vs the reference's published numbers
     # (bench/results-0.7.1.md: 3,780 PUT/s, 9,774 stale GET/s).  Run in
     # a clean subprocess: the host plane never touches JAX, and this
@@ -764,6 +855,7 @@ def main() -> None:
                     **membership,
                     **multichip,
                     **jaxlint_peaks,
+                    **observability,
                     **kv,
                 },
             }

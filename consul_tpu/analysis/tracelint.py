@@ -977,9 +977,12 @@ def lint_paths(paths: Iterable[str | Path],
 
 
 def default_paths() -> list[Path]:
-    """The simulation plane: models/, sim/, ops/ of this package."""
+    """The simulation plane: the traced trees of this package (the
+    same list tests/test_tracelint.py gates at zero violations)."""
     root = Path(__file__).resolve().parent.parent
-    return [root / "models", root / "sim", root / "ops"]
+    return [root / "models", root / "sim", root / "ops",
+            root / "parallel", root / "sweep", root / "streamcast",
+            root / "geo", root / "obs"]
 
 
 def main(argv: Optional[list[str]] = None) -> int:

@@ -262,6 +262,37 @@ def build_parser() -> argparse.ArgumentParser:
                          "make_async_remote_copy DMA kernel "
                          "(consul_tpu/ops/ring_exchange.py); backends "
                          "are bit-equal")
+    sp.add_argument("--metrics", action="store_true", dest="metrics",
+                    help="run the study with the in-scan telemetry "
+                         "seam on (consul_tpu/obs) and print the "
+                         "bridged /v1/agent/metrics-shaped snapshot "
+                         "under \"metrics\"")
+
+    sp = sub.add_parser(
+        "profile",
+        help="XLA cost/profile harness over the jaxlint registry "
+             "(consul_tpu/obs/profile.py): cost_analysis flops/bytes "
+             "+ compile-vs-execute wall split per entrypoint",
+    )
+    sp.set_defaults(fn=cmd_profile)
+    sp.add_argument("--set", default="small", dest="which",
+                    choices=("small", "big", "all"),
+                    help="registry tier to profile (default small; "
+                         "big = the 1M-node bench shapes)")
+    sp.add_argument("--entry", default="",
+                    help="profile only registry entries whose name "
+                         "contains this substring")
+    sp.add_argument("--execute", action="store_true",
+                    help="also execute each compiled program once on "
+                         "zero states and report execute-wall "
+                         "(analyses alone allocate nothing)")
+    sp.add_argument("--perfetto", default="", metavar="DIR",
+                    help="additionally run one small telemetry=on "
+                         "study under jax.profiler.trace(DIR) for "
+                         "perfetto/tensorboard trace capture (on-TPU "
+                         "trace capture path)")
+    sp.add_argument("--format", choices=("text", "json"),
+                    default="text")
 
     sp = sub.add_parser(
         "sweep", help="run a universe-sweep preset: U (seed, knob, "
@@ -1089,8 +1120,65 @@ async def cmd_sim(args) -> int:
         return 1
     out = run_scenario(args.scenario, seed=args.seed,
                        devices=args.devices or None,
-                       exchange=args.exchange or None)
+                       exchange=args.exchange or None,
+                       telemetry=args.metrics)
     print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+async def cmd_profile(args) -> int:
+    """XLA cost/profile harness (consul_tpu/obs/profile.py): lower +
+    compile each registered entrypoint and print what XLA reports —
+    cost_analysis flops/bytes-accessed, the memory census, and the
+    trace/compile(/execute) wall split.  JAX import stays local, like
+    ``cli sim``."""
+    from consul_tpu.obs.profile import profile_registry, run_with_profiler
+    from consul_tpu.sim.engine import jaxlint_registry
+
+    include = (
+        ("small", "big") if args.which == "all" else (args.which,)
+    )
+    programs = jaxlint_registry(include=include)
+    if args.entry:
+        programs = {
+            k: v for k, v in programs.items() if args.entry in k
+        }
+        if not programs:
+            print(f"Error: no registry entry matches {args.entry!r}",
+                  file=sys.stderr)
+            return 1
+    profiles = profile_registry(programs, execute=args.execute)
+    if args.perfetto:
+        # One small telemetry=on study under the profiler: the on-TPU
+        # trace-capture path (perfetto UI / tensorboard profile).
+        from consul_tpu.models.broadcast import BroadcastConfig
+        from consul_tpu.sim.engine import run_broadcast
+
+        run_with_profiler(
+            args.perfetto,
+            lambda: run_broadcast(
+                BroadcastConfig(n=4096, fanout=4, delivery="edges"),
+                steps=30, warmup=True, telemetry=True,
+            ),
+        )
+        print(f"perfetto trace written under {args.perfetto}",
+              file=sys.stderr)
+    if args.format == "json":
+        print(json.dumps({"programs": [p.to_json() for p in profiles]}))
+        return 0
+    rows = [("PROGRAM", "FLOPS", "BYTES", "TRACE_S", "COMPILE_S",
+             "EXECUTE_S")]
+    for p in profiles:
+        rows.append((
+            p.name,
+            "-" if p.flops is None else f"{p.flops:.3g}",
+            "-" if p.bytes_accessed is None else f"{p.bytes_accessed:.3g}",
+            f"{p.trace_s:.2f}",
+            f"{p.compile_s:.2f}",
+            (f"{p.execute_s:.3f}" if p.execute_s is not None
+             else (p.execute_skipped or "-")),
+        ))
+    _print_table(rows)
     return 0
 
 

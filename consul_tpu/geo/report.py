@@ -40,6 +40,10 @@ class GeoReport:
     # Sharded (shard_map) runs only — outbox budget misses, 0 means the
     # mesh exchanged every WAN message a single chip would have.
     shard_overflow: Optional[int] = None
+    # telemetry=True runs only (consul_tpu/obs): the [steps, M]
+    # Consul-named metrics trace and its ordered column names.
+    metric_names: tuple = ()
+    metrics_trace: Optional[np.ndarray] = None
 
     @property
     def seg_size(self) -> int:

@@ -123,6 +123,10 @@ class _Awareness:
     def __init__(self, max_mult: int):
         self._max = max_mult
         self.score = 0
+        # Gauge exists from construction (newMemberlist wires the
+        # awareness before the first probe), so /v1/agent/metrics
+        # reports a healthy score even before any delta fires.
+        metrics().set_gauge("memberlist.health.score", self.score)
 
     def apply_delta(self, delta: int) -> None:
         self.score = awareness_clamp(self.score + delta, self._max)

@@ -1,0 +1,59 @@
+"""consul_tpu.obs — the in-scan telemetry plane + XLA profile harness.
+
+``spec``    static per-entrypoint MetricSpec registry: Consul-style
+            metric names bound to pure in-scan emitters; the
+            ``telemetry=True`` seam on every scan entrypoint stacks
+            them into a [steps, M] trace.
+``bridge``  replays a trace into telemetry.Metrics (the
+            /v1/agent/metrics JSON shape) under the reference names.
+``profile`` lowers/compiles registry entrypoints and reads XLA's
+            cost_analysis / memory_analysis + compile-vs-execute walls.
+"""
+
+from consul_tpu.obs.bridge import bridge_report, bridge_trace
+from consul_tpu.obs.profile import (
+    ProgramProfile,
+    profile_program,
+    profile_registry,
+    run_with_profiler,
+)
+from consul_tpu.obs.spec import (
+    MetricSpec,
+    emit_local,
+    emit_metrics,
+    metric_count,
+    metric_names,
+    reduce_over_mesh,
+    sum_mask,
+)
+
+
+def __getattr__(name: str):
+    # PEP 562, mirroring obs/spec.py: METRIC_SPECS builds the spec
+    # families (and imports consul_tpu.models) on FIRST TOUCH only.
+    # An eager from-import here would defeat spec.py's lazy-build
+    # import-cycle protection — sim/engine.py imports obs.spec at its
+    # own top level, and models.lifeguard -> sim.faults -> sim
+    # re-enters the engine.
+    if name == "METRIC_SPECS":
+        from consul_tpu.obs import spec
+
+        return spec.METRIC_SPECS
+    raise AttributeError(name)
+
+__all__ = [
+    "METRIC_SPECS",
+    "MetricSpec",
+    "ProgramProfile",
+    "bridge_report",
+    "bridge_trace",
+    "emit_local",
+    "emit_metrics",
+    "metric_count",
+    "metric_names",
+    "profile_program",
+    "profile_registry",
+    "reduce_over_mesh",
+    "run_with_profiler",
+    "sum_mask",
+]

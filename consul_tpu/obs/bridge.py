@@ -1,0 +1,64 @@
+"""Host bridge: replay an in-scan metrics trace into telemetry.Metrics.
+
+The scan side (obs/spec.py) stacks one [M] vector per tick; this side
+turns one study's ``[steps, M]`` trace back
+into the process-global go-metrics-shaped sink (consul_tpu/telemetry.py)
+under the reference metric names — counters ``incr_counter`` once per
+tick with that tick's count, gauges ``set_gauge`` to the final tick's
+level — so ``metrics().snapshot()`` / the /v1/agent/metrics JSON shape
+now describes simulated studies exactly the way it describes a live
+agent's hot paths.  A sweep's ``[U, steps, M]`` trace bridges
+per-study: index the universe axis first (bridging a whole sweep into
+one labelled sink is an open ROADMAP item).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from consul_tpu.obs.spec import _specs
+from consul_tpu.telemetry import Metrics, metrics
+
+
+def bridge_trace(entrypoint: str, trace,
+                 sink: Optional[Metrics] = None) -> Metrics:
+    """Replay one study's ``[steps, M]`` trace into ``sink`` (the
+    process-global registry by default).
+
+    Counter columns land as one ``incr_counter(name, count_t)`` per
+    tick — ``Count`` = ticks, ``Sum`` = the study total, min/max/mean/
+    stddev the per-tick distribution; gauge columns land as the final
+    tick's level.  Returns the sink for chaining."""
+    sink = metrics() if sink is None else sink
+    specs = _specs(entrypoint)
+    # Builtin float (host-side aggregation precision), not np.float64:
+    # the traced plane stays x32 (tracelint R3).
+    arr = np.asarray(trace, dtype=float)
+    if arr.ndim != 2 or arr.shape[1] != len(specs):
+        raise ValueError(
+            f"expected a [steps, {len(specs)}] trace for "
+            f"{entrypoint!r}, got shape {arr.shape}"
+        )
+    for j, spec in enumerate(specs):
+        series = arr[:, j]
+        if spec.kind == "gauge":
+            sink.set_gauge(spec.name, float(series[-1]))
+        else:
+            for v in series:
+                sink.incr_counter(spec.name, float(v))
+    return sink
+
+
+def bridge_report(entrypoint: str, report,
+                  sink: Optional[Metrics] = None) -> Metrics:
+    """Bridge a run_* report that carries ``metrics_trace`` (a
+    telemetry=True study); loud when the study ran telemetry=off."""
+    trace = getattr(report, "metrics_trace", None)
+    if trace is None:
+        raise ValueError(
+            "report carries no metrics_trace — run the study with "
+            "telemetry=True"
+        )
+    return bridge_trace(entrypoint, trace, sink)

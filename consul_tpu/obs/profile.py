@@ -1,0 +1,163 @@
+"""Program-level observability: XLA cost/profile harness.
+
+The bench has always timed wall-clocks without looking inside the
+compiled programs; this module lowers and compiles each jaxlint-registry
+entrypoint (``jax.jit(fn).lower(args).compile()`` — the same
+``SimProgram`` specs jaxlint traces, so 1M-node configs profile without
+allocating device state) and reads what XLA says about the result:
+
+  * ``cost_analysis()``      — flops + bytes accessed per execution
+  * ``memory_analysis()``    — argument/output/temp/code sizes (the
+                               live-memory census of the executable)
+  * trace-wall vs compile-wall vs (optionally) execute-wall
+
+``cli profile`` prints the table; ``cli profile --perfetto DIR`` wraps a
+run in ``jax.profiler.trace`` for on-TPU trace capture; bench.py's
+"observability" section ships the numbers per big registry entrypoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class ProgramProfile:
+    """What XLA reports about one compiled registry entrypoint."""
+
+    name: str
+    entrypoint: str
+    n: int
+    trace_s: float
+    compile_s: float
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    # memory_analysis() census (bytes; None when the backend doesn't
+    # implement it).
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    execute_s: Optional[float] = None
+    execute_skipped: Optional[str] = None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        for k in ("trace_s", "compile_s", "execute_s"):
+            if d[k] is not None:
+                d[k] = round(d[k], 4)
+        return d
+
+
+def _concrete_args(abstract):
+    """Zero-filled device arrays matching a ShapeDtypeStruct pytree —
+    enough to EXECUTE a compiled study (states are plain arrays; the
+    zero key is as valid a PRNG key as any for timing)."""
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), abstract
+    )
+
+
+def profile_program(prog, execute: bool = False) -> ProgramProfile:
+    """Lower + compile one :class:`~consul_tpu.sim.engine.SimProgram`
+    and read XLA's cost/memory analyses.
+
+    ``execute=True`` additionally materializes zero states and times
+    one steady-state execution (compile warm, fresh donated buffers
+    per call) — callers gate this on memory/budget; the analyses
+    themselves allocate nothing."""
+    fn, args = prog.build()
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(*args)
+    trace_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    out = ProgramProfile(
+        name=prog.name, entrypoint=prog.entrypoint, n=prog.n,
+        trace_s=trace_s, compile_s=compile_s,
+    )
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            if ca.get("flops") is not None:
+                out.flops = float(ca["flops"])
+            if ca.get("bytes accessed") is not None:
+                out.bytes_accessed = float(ca["bytes accessed"])
+    except Exception:  # backend without cost analysis: fields stay None
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            out.argument_bytes = int(ma.argument_size_in_bytes)
+            out.output_bytes = int(ma.output_size_in_bytes)
+            out.temp_bytes = int(ma.temp_size_in_bytes)
+            out.generated_code_bytes = int(
+                ma.generated_code_size_in_bytes
+            )
+    except Exception:
+        pass
+    if execute:
+        # Warm run (donated buffers die with it), then a timed run on
+        # fresh zeros; np.asarray is the honest device->host fence
+        # (engine._timed discipline).
+        result = compiled(*_concrete_args(args))
+        jax.tree_util.tree_map(np.asarray, result)
+        t0 = time.perf_counter()
+        result = compiled(*_concrete_args(args))
+        jax.tree_util.tree_map(np.asarray, result)
+        out.execute_s = time.perf_counter() - t0
+    return out
+
+
+def profile_registry(programs: dict, execute: bool = False,
+                     execute_budget_s: float = 0.0,
+                     deadline: Optional[float] = None) -> list:
+    """Profile every registry entry; returns ``[ProgramProfile]`` in
+    registry order.
+
+    ``execute_budget_s`` bounds the cumulative execute-wall: once
+    spent, remaining entries keep their analyses but skip execution
+    LOUDLY (``execute_skipped``) — the BENCH_SECTION_BUDGET_S
+    discipline applied inside the section.  ``deadline`` (a
+    ``time.monotonic()`` value) skips everything once passed."""
+    profiles = []
+    exec_spent = 0.0
+    for prog in programs.values():
+        if deadline is not None and time.monotonic() >= deadline:
+            profiles.append(ProgramProfile(
+                name=prog.name, entrypoint=prog.entrypoint, n=prog.n,
+                trace_s=0.0, compile_s=0.0,
+                execute_skipped="section budget exhausted",
+            ))
+            continue
+        run_exec = execute and (
+            execute_budget_s <= 0.0 or exec_spent < execute_budget_s
+        )
+        p = profile_program(prog, execute=run_exec)
+        if execute and not run_exec:
+            p.execute_skipped = (
+                f"execute budget {execute_budget_s:.0f}s exhausted"
+            )
+        if p.execute_s is not None:
+            exec_spent += p.execute_s
+        profiles.append(p)
+    return profiles
+
+
+def run_with_profiler(log_dir: str, fn, *args, **kwargs):
+    """Run ``fn`` under ``jax.profiler.trace`` (perfetto/tensorboard
+    trace capture into ``log_dir``) and return its result — the
+    ``cli profile --perfetto DIR`` path for on-TPU trace capture."""
+    with jax.profiler.trace(log_dir):
+        result = fn(*args, **kwargs)
+        jax.tree_util.tree_map(np.asarray, result)
+    return result

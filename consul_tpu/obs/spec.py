@@ -1,0 +1,431 @@
+"""In-scan telemetry: the static per-entrypoint metric registry.
+
+Every scan family (sim/engine.py) returns its own trace tuple; this
+module gives them one shared metrics vocabulary — ordered Consul-style
+metric names (SURVEY.md §5: ``memberlist.health.score`` awareness.go:50,
+``serf.queue.Event`` serf.go:1675, ``consul.*`` study gauges) each bound
+to a pure ``(prev_state, next_state, tick_out, cfg) -> int32`` emitter.
+With ``telemetry=True`` a scan stacks one ``[M]`` vector per tick into a
+``[steps, M]`` float32 trace as an EXTRA scan output; the host bridge
+(obs/bridge.py) replays that trace into ``telemetry.Metrics`` under the
+reference names, so ``metrics().snapshot()`` (the /v1/agent/metrics JSON
+shape) describes simulated studies the way it describes a live agent.
+
+Exactness contract, pinned by tests/test_obs.py:
+
+  * every emitter reduces to an **int32 count** (order-free integer
+    sums), then the framework casts the assembled vector to float32 —
+    so the trace is bit-deterministic and the sharded twins reproduce
+    it exactly;
+  * ``reduce="sum"`` marks emitters that sum over the node-sharded
+    planes: the sharded scans (parallel/shard.py) compute them on the
+    local block and combine with ONE ``lax.psum`` over the mesh
+    (integer psum is exact in any grouping, so D == 1 is bit-equal to
+    the unsharded emission and D == 2 == D == 1);
+  * ``reduce="rep"`` marks emitters of replicated scalars (streamcast
+    window counters, the geo link census, cumulative overflow) that
+    every shard already holds identically — no psum.
+
+Emitters never touch the carry, the key derivations, or the existing
+trace streams: telemetry=off is the exact current program and
+telemetry=on is bit-equal on every existing output (both pinned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+# Model constants (VIEW_*/RANK_*/key_rank) are imported INSIDE the
+# per-family builders: sim/engine.py imports this module at its own
+# top level, and models.lifeguard -> sim.faults -> sim.__init__ ->
+# engine closes an import cycle through the package __init__s if this
+# module eagerly imports consul_tpu.models (the lazy-import discipline
+# of the engine's lifeguard/streamcast/geo scans).
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One metric of one scan family.
+
+    ``emit(prev, nxt, out, cfg)`` — pure function of the tick's
+    before/after states and its existing per-tick output tuple; must
+    return an int32 scalar (a count this tick for ``kind="counter"``,
+    a level for ``kind="gauge"``).  ``reduce`` states how the sharded
+    twins assemble the global value (module docstring)."""
+
+    name: str       # Consul-style metric name (the bridge emits it)
+    kind: str       # "counter" | "gauge" (bridge-side semantics)
+    reduce: str     # "sum" (psum over the mesh) | "rep" (replicated)
+    emit: Callable  # (prev, nxt, out, cfg) -> int32 scalar
+
+    def __post_init__(self):
+        if self.kind not in ("counter", "gauge"):
+            raise ValueError(f"bad kind {self.kind!r} for {self.name}")
+        if self.reduce not in ("sum", "rep"):
+            raise ValueError(
+                f"bad reduce {self.reduce!r} for {self.name}"
+            )
+
+
+def _i32(x) -> jax.Array:
+    return jnp.sum(x, dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Per-family emitters.  Each operates on per-node planes (reduce="sum")
+# or replicated scalars/outs (reduce="rep") ONLY — that split is what
+# lets the sharded twins emit the identical trace with one psum.
+# ---------------------------------------------------------------------------
+
+
+def _swim_specs() -> tuple:
+    """SwimState families (swim + lifeguard share the carry)."""
+    from consul_tpu.models.swim import (
+        VIEW_ALIVE,
+        VIEW_DEAD,
+        VIEW_SUSPECT,
+    )
+
+    return (
+        MetricSpec(
+            "memberlist.msg.suspect", "counter", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.view == VIEW_SUSPECT) & (p.view != VIEW_SUSPECT)
+            ),
+        ),
+        MetricSpec(
+            "memberlist.msg.dead", "counter", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.view == VIEW_DEAD) & (p.view != VIEW_DEAD)
+            ),
+        ),
+        # Refute landings: views overridden back to ALIVE by a
+        # higher-incarnation alive message (state.go:917 aliveNode).
+        MetricSpec(
+            "memberlist.msg.alive", "counter", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.view == VIEW_ALIVE) & (p.view != VIEW_ALIVE)
+            ),
+        ),
+        # TransmitLimitedQueue pressure: nodes holding any queued
+        # suspect/dead/refute broadcast (queue.go).
+        MetricSpec(
+            "memberlist.queue.broadcasts", "gauge", "sum",
+            lambda p, x, out, cfg: (
+                _i32(x.tx_suspect > 0)
+                + _i32(x.tx_dead > 0)
+                + _i32(x.tx_refute > 0)
+            ),
+        ),
+        # Aggregate Lifeguard NHM (awareness.go:50 emits per node; the
+        # population sum is the study-level gauge).
+        MetricSpec(
+            "memberlist.health.score", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.awareness),
+        ),
+        MetricSpec(
+            "consul.swim.suspecting", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.view == VIEW_SUSPECT),
+        ),
+        MetricSpec(
+            "consul.swim.dead_known", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.view == VIEW_DEAD),
+        ),
+    )
+
+
+def _lifeguard_specs() -> tuple:
+    return _swim_specs() + (
+        # Subject refutations this tick (incarnation bumps — the flap
+        # counter of the false-positive studies).
+        MetricSpec(
+            "consul.lifeguard.refutes", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.subject_inc - p.subject_inc).astype(jnp.int32)
+            ),
+        ),
+    )
+
+
+def _broadcast_specs() -> tuple:
+    return (
+        # Gossip messages offered this tick: live senders x fanout
+        # (state.go:566 gossip; the Poissonized aggregate mode offers
+        # the same count by construction).
+        MetricSpec(
+            "memberlist.gossip", "counter", "sum",
+            lambda p, x, out, cfg: (
+                _i32(p.knows & (p.tx_left > 0)) * cfg.fanout
+            ),
+        ),
+        # Event-queue depth: nodes still holding a queued rebroadcast
+        # (serf.go:1675 serf.queue.Event).
+        MetricSpec(
+            "serf.queue.Event", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.tx_left > 0),
+        ),
+        MetricSpec(
+            "consul.broadcast.infected", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.knows),
+        ),
+        MetricSpec(
+            "consul.broadcast.newly_infected", "counter", "sum",
+            lambda p, x, out, cfg: _i32(x.knows & ~p.knows),
+        ),
+    )
+
+
+def _membership_specs() -> tuple:
+    """Dense [n, n] view-matrix family: per-cell transitions are
+    position-stable, so the msg.* counters diff prev vs next cells."""
+    from consul_tpu.models.membership import (
+        RANK_DEAD,
+        RANK_SUSPECT,
+        key_rank,
+    )
+
+    def new_rank(p, x, rank):
+        return (
+            (key_rank(x.key) == rank) & (key_rank(p.key) != rank)
+        )
+
+    return (
+        MetricSpec(
+            "memberlist.msg.suspect", "counter", "sum",
+            lambda p, x, out, cfg: _i32(new_rank(p, x, RANK_SUSPECT)),
+        ),
+        MetricSpec(
+            "memberlist.msg.dead", "counter", "sum",
+            lambda p, x, out, cfg: _i32(new_rank(p, x, RANK_DEAD)),
+        ),
+        # Cells re-learned alive at a HIGHER key (refute landings; the
+        # key max-merge makes "changed to alive-rank" exactly that).
+        MetricSpec(
+            "memberlist.msg.alive", "counter", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.key > p.key) & (key_rank(x.key) == 0)
+            ),
+        ),
+        MetricSpec(
+            "memberlist.health.score", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.awareness),
+        ),
+        MetricSpec(
+            "consul.membership.suspect_cells", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.key >= 0) & (key_rank(x.key) == RANK_SUSPECT)
+            ),
+        ),
+        MetricSpec(
+            "consul.membership.known", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.key >= 0) & (key_rank(x.key) <= RANK_SUSPECT)
+            ),
+        ),
+    )
+
+
+def _sparse_specs() -> tuple:
+    """Top-K slot family: the sort-merge kernel PERMUTES slot columns
+    between ticks, so every emitter here is position-free (occupancy-
+    masked sums and cumulative-counter deltas only)."""
+    from consul_tpu.models.membership import RANK_SUSPECT, key_rank
+
+    return (
+        MetricSpec(
+            "consul.membership.suspect_cells", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.slot_subj >= 0) & (key_rank(x.key) == RANK_SUSPECT)
+            ),
+        ),
+        MetricSpec(
+            "consul.membership.dead_cells", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(
+                (x.slot_subj >= 0) & (key_rank(x.key) > RANK_SUSPECT)
+            ),
+        ),
+        MetricSpec(
+            "memberlist.health.score", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.awareness),
+        ),
+        # Cumulative state counters -> per-tick deltas.  Replicated in
+        # the sharded twin (the psum'd increments land in the carry).
+        MetricSpec(
+            "consul.membership.overflow", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.overflow - p.overflow).astype(jnp.int32)
+            ),
+        ),
+        MetricSpec(
+            "consul.membership.forgotten", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.forgotten - p.forgotten).astype(jnp.int32)
+            ),
+        ),
+    )
+
+
+def _streamcast_specs() -> tuple:
+    return (
+        # In-flight window occupancy (serf.queue.Event: the event
+        # queue depth of the streaming plane).
+        MetricSpec(
+            "serf.queue.Event", "gauge", "rep",
+            lambda p, x, out, cfg: _i32(x.slot_event >= 0),
+        ),
+        MetricSpec(
+            "consul.streamcast.window_overflow", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.window_overflow - p.window_overflow)
+                .astype(jnp.int32)
+            ),
+        ),
+        MetricSpec(
+            "consul.streamcast.offered", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.offered - p.offered).astype(jnp.int32)
+            ),
+        ),
+        MetricSpec(
+            "consul.streamcast.delivered", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.delivered - p.delivered).astype(jnp.int32)
+            ),
+        ),
+        MetricSpec(
+            "consul.streamcast.coalesced", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.coalesced - p.coalesced).astype(jnp.int32)
+            ),
+        ),
+        MetricSpec(
+            "consul.streamcast.chunks_held", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.chunks),
+        ),
+    )
+
+
+def _geo_specs() -> tuple:
+    """Geo/WAN family: the link census rides the existing per-tick out
+    tuple ``(per_segment, offered, admitted, queued, overflow,
+    wasted)`` — replicated link-plane values, identical on every shard
+    by construction (parallel/shard.py sharded_geo_scan)."""
+    return (
+        MetricSpec(
+            "consul.geo.wan.offered", "counter", "rep",
+            lambda p, x, out, cfg: _i32(out[1]),
+        ),
+        MetricSpec(
+            "consul.geo.wan.admitted", "counter", "rep",
+            lambda p, x, out, cfg: _i32(out[2]),
+        ),
+        MetricSpec(
+            "consul.geo.wan.queued", "gauge", "rep",
+            lambda p, x, out, cfg: _i32(out[3]),
+        ),
+        MetricSpec(
+            "consul.geo.wan.overflow", "counter", "rep",
+            lambda p, x, out, cfg: _i32(out[4]),
+        ),
+        MetricSpec(
+            "consul.geo.wan.wasted", "counter", "rep",
+            lambda p, x, out, cfg: (
+                (x.wasted - p.wasted).astype(jnp.int32)
+            ),
+        ),
+        MetricSpec(
+            "consul.geo.events_known", "gauge", "sum",
+            lambda p, x, out, cfg: _i32(x.knows),
+        ),
+    )
+
+
+# Ordered, static: the column order of every [steps, M] trace.  Keyed
+# by scan family (the ``track``-style entrypoint names the engine and
+# the sweep plane share).  Built lazily (first access per family) so
+# importing this module never touches consul_tpu.models — see the
+# import-cycle note at the top.
+_SPEC_BUILDERS: dict = {
+    "swim": _swim_specs,
+    "lifeguard": _lifeguard_specs,
+    "broadcast": _broadcast_specs,
+    "membership": _membership_specs,
+    "sparse": _sparse_specs,
+    "streamcast": _streamcast_specs,
+    "geo": _geo_specs,
+}
+_SPEC_CACHE: dict = {}
+
+
+def __getattr__(name: str):
+    # PEP 562: METRIC_SPECS stays importable as a plain dict while the
+    # per-family tuples build on first touch.
+    if name == "METRIC_SPECS":
+        return {e: _specs(e) for e in _SPEC_BUILDERS}
+    raise AttributeError(name)
+
+
+def metric_names(entrypoint: str) -> tuple:
+    """Ordered metric names of one scan family — column j of the
+    family's [steps, M] trace is ``metric_names(...)[j]``."""
+    return tuple(s.name for s in _specs(entrypoint))
+
+
+def metric_count(entrypoint: str) -> int:
+    return len(_specs(entrypoint))
+
+
+def _specs(entrypoint: str) -> tuple:
+    try:
+        if entrypoint not in _SPEC_CACHE:
+            _SPEC_CACHE[entrypoint] = _SPEC_BUILDERS[entrypoint]()
+        return _SPEC_CACHE[entrypoint]
+    except KeyError:
+        raise ValueError(
+            f"no metric specs for entrypoint {entrypoint!r} "
+            f"(have: {sorted(_SPEC_BUILDERS)})"
+        ) from None
+
+
+def emit_local(entrypoint: str, prev, nxt, out, cfg) -> jax.Array:
+    """The raw int32[M] metrics vector of one tick.
+
+    Unsharded scans cast this straight to the trace row; the sharded
+    twins call it on the LOCAL block and combine with
+    :func:`reduce_over_mesh`."""
+    specs = _specs(entrypoint)
+    return jnp.stack(
+        [s.emit(prev, nxt, out, cfg).astype(jnp.int32) for s in specs]
+    )
+
+
+def emit_metrics(entrypoint: str, prev, nxt, out, cfg) -> jax.Array:
+    """One float32[M] trace row (the unsharded emission)."""
+    return emit_local(entrypoint, prev, nxt, out, cfg).astype(
+        jnp.float32
+    )
+
+
+def sum_mask(entrypoint: str) -> tuple:
+    """Static bool[M]: which columns the sharded twins psum."""
+    return tuple(s.reduce == "sum" for s in _specs(entrypoint))
+
+
+def reduce_over_mesh(entrypoint: str, vec: jax.Array,
+                     axis_name: str) -> jax.Array:
+    """Assemble the global float32[M] trace row from a shard-local
+    int32[M] vector with ONE integer ``psum`` (exact in any grouping —
+    the D == 1 / D == 2 bit-equality contract): ``reduce="sum"``
+    columns contribute from every shard, replicated columns from shard
+    0 only (they are identical everywhere by construction, so one copy
+    IS the value).  Routing everything through the psum also keeps the
+    output replication provable — jaxlint J4's taint pass sees a
+    reducing collective, not a device-varying passthrough."""
+    me = jax.lax.axis_index(axis_name)
+    mask = jnp.asarray(sum_mask(entrypoint), jnp.bool_)
+    contrib = jnp.where(mask | (me == 0), vec, 0)
+    return jax.lax.psum(contrib, axis_name).astype(jnp.float32)

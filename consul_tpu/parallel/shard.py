@@ -171,17 +171,26 @@ def _rows(x: jax.Array, start: jax.Array, blk: int) -> jax.Array:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange")
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange",
+                              "telemetry")
 )
 def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
-                           mesh: Mesh, exchange: str = "alltoall"):
+                           mesh: Mesh, exchange: str = "alltoall",
+                           telemetry: bool = False):
     """Sharded twin of ``sim.engine.broadcast_scan``: returns
     ``(final_state, (infected[steps], overflow))`` with every per-node
     plane block-sharded over the mesh and ``overflow`` the total outbox
     budget misses (0 at D == 1 by construction).  ``exchange`` selects
     the outbox transport (:func:`exchange_outbox`); backends are
-    bit-equal, so the choice is purely a perf knob."""
+    bit-equal, so the choice is purely a perf knob.
+
+    ``telemetry`` appends the [steps, M] metrics trace
+    (consul_tpu/obs/spec.py) as the LAST output: the local block's
+    int32 emission combined with ONE integer ``psum`` over the mesh,
+    so D == 1 is bit-equal to the unsharded trace and D == 2 == D == 1
+    — same contract on every sharded scan below."""
     from consul_tpu.models.broadcast import BroadcastState
+    from consul_tpu.obs.spec import emit_local, reduce_over_mesh
     from consul_tpu.ops import bernoulli_mask, deliver_or, sample_peers
 
     n, fanout = cfg.n, cfg.fanout
@@ -250,24 +259,34 @@ def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
         infected = jax.lax.psum(
             jnp.sum(new_knows, dtype=jnp.int32), NODE_AXIS
         )
-        return (nxt, ov), infected
+        out = infected
+        if telemetry:
+            out = (infected, reduce_over_mesh(
+                "broadcast",
+                emit_local("broadcast", st, nxt, infected, cfg),
+                NODE_AXIS,
+            ))
+        return (nxt, ov), out
 
     def body(st, key):
         keys = jax.random.split(key, steps)
-        (final, ov), infected = jax.lax.scan(
+        (final, ov), outs = jax.lax.scan(
             tick, (st, jnp.int32(0)), keys
         )
-        return final, infected, ov
+        return final, outs, ov
 
     state_spec = BroadcastState(P(NODE_AXIS), P(NODE_AXIS), P())
     run = shard_map(
         body, mesh=mesh,
         in_specs=(state_spec, P()),
-        out_specs=(state_spec, P(), P()),
+        out_specs=(state_spec, (P(), P()) if telemetry else P(), P()),
         check_rep=False,
     )
-    final, infected, ov = run(state, key)
-    return final, (infected, ov)
+    final, outs, ov = run(state, key)
+    if telemetry:
+        infected, trace = outs
+        return final, (infected, ov, trace)
+    return final, (outs, ov)
 
 
 # ---------------------------------------------------------------------------
@@ -276,12 +295,14 @@ def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange"),
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange",
+                              "telemetry"),
     donate_argnums=(0,),
 )
 def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
                             mesh: Mesh, track: tuple = (),
-                            exchange: str = "alltoall"):
+                            exchange: str = "alltoall",
+                            telemetry: bool = False):
     """Sharded twin of ``sim.engine.membership_scan``: each device owns
     ``n/D`` observer ROWS of every [n, n] plane.  Gossip scatters route
     through the outbox; the push/pull row exchange gathers the budgeted
@@ -308,6 +329,7 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
         make_key,
     )
     from consul_tpu.models.membership_sparse import pp_initiator_budget
+    from consul_tpu.obs.spec import emit_local, reduce_over_mesh
     from consul_tpu.ops import (
         bernoulli_mask,
         sample_peers,
@@ -661,6 +683,12 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
                 NODE_AXIS,
             ),
         )
+        if telemetry:
+            out = (*out, reduce_over_mesh(
+                "membership",
+                emit_local("membership", st, nxt, out, cfg),
+                NODE_AXIS,
+            ))
         ov = ov + jax.lax.psum(ov_local, NODE_AXIS) + ov_repl
         return (nxt, ov), out
 
@@ -683,13 +711,17 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
         )
         return final, outs, ov
 
+    n_outs = 5 if telemetry else 4
     run = shard_map(
         body, mesh=mesh,
         in_specs=(state_spec, P()),
-        out_specs=(state_spec, (P(), P(), P(), P()), P()),
+        out_specs=(state_spec, tuple(P() for _ in range(n_outs)), P()),
         check_rep=False,
     )
     final, outs, ov = run(state, key)
+    if telemetry:
+        *outs, trace = outs
+        return final, (*outs, ov, trace)
     return final, (*outs, ov)
 
 
@@ -699,13 +731,15 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange"),
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange",
+                              "telemetry"),
     donate_argnums=(0,),
 )
 def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                                    steps: int, mesh: Mesh,
                                    track: tuple = (),
-                                   exchange: str = "alltoall"):
+                                   exchange: str = "alltoall",
+                                   telemetry: bool = False):
     """Sharded twin of ``sim.engine.sparse_membership_scan``: each
     device owns ``n/D`` observer rows of the [n, K] slot planes; the
     whole inbound stream — local gossip, compacted push/pull, and the
@@ -736,6 +770,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         pp_initiator_budget,
         settled_of,
     )
+    from consul_tpu.obs.spec import emit_local, reduce_over_mesh
     from consul_tpu.ops import (
         bernoulli_mask,
         row_locate,
@@ -1135,6 +1170,11 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             ),
             jnp.float32(n) * n - dead_cells,
         )
+        if telemetry:
+            out = (*out, reduce_over_mesh(
+                "sparse", emit_local("sparse", st, nxt, out, cfg),
+                NODE_AXIS,
+            ))
         return nxt, out
 
     state_spec = SparseMembershipState(
@@ -1156,10 +1196,11 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         keys = jax.random.split(key, steps)
         return jax.lax.scan(tick, st, keys)
 
+    n_outs = 5 if telemetry else 4
     run = shard_map(
         body, mesh=mesh,
         in_specs=(state_spec, P()),
-        out_specs=(state_spec, (P(), P(), P(), P())),
+        out_specs=(state_spec, tuple(P() for _ in range(n_outs))),
         check_rep=False,
     )
     return run(state, key)
@@ -1171,11 +1212,13 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange"),
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange",
+                              "telemetry"),
     donate_argnums=(0,),
 )
 def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
-                            mesh: Mesh, exchange: str = "alltoall"):
+                            mesh: Mesh, exchange: str = "alltoall",
+                            telemetry: bool = False):
     """Sharded twin of ``sim.engine.streamcast_scan``: each device owns
     ``n/D`` rows of the [n, W, E] chunk plane and the [n, W] budget
     plane; the in-flight window (slot_event/slot_birth and every
@@ -1191,6 +1234,7 @@ def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
 
     ``state`` is donated (jaxlint J3, same contract as the unsharded
     scan): callers pass a fresh init positionally."""
+    from consul_tpu.obs.spec import emit_local, reduce_over_mesh
     from consul_tpu.ops import bernoulli_mask, sample_peers
     from consul_tpu.streamcast.model import (
         _AUX_SALT,
@@ -1382,6 +1426,12 @@ def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
             coalesced=coalesced,
             tick=t + 1,
         )
+        if telemetry:
+            outs = (*outs, reduce_over_mesh(
+                "streamcast",
+                emit_local("streamcast", st, nxt, outs[:9], cfg),
+                NODE_AXIS,
+            ))
         return (nxt, ob_ov), outs
 
     def body(st, key):
@@ -1409,10 +1459,11 @@ def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         coalesced=P(),
         tick=P(),
     )
+    n_outs = 11 if telemetry else 10
     run = shard_map(
         body, mesh=mesh,
         in_specs=(state_spec, P()),
-        out_specs=(state_spec, tuple(P() for _ in range(10))),
+        out_specs=(state_spec, tuple(P() for _ in range(n_outs))),
         check_rep=False,
     )
     return run(state, key)
@@ -1424,11 +1475,13 @@ def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange"),
+    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange",
+                              "telemetry"),
     donate_argnums=(0,),
 )
 def sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
-                     mesh: Mesh, exchange: str = "alltoall"):
+                     mesh: Mesh, exchange: str = "alltoall",
+                     telemetry: bool = False):
     """Sharded twin of ``sim.engine.geo_scan``: segments are laid out
     CONTIGUOUSLY over the mesh (``segments % D == 0``, each device
     owning ``segments/D`` whole DCs), so ALL LAN traffic — the
@@ -1456,6 +1509,7 @@ def sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
         admit_link_units,
         expand_delivery_slots,
     )
+    from consul_tpu.obs.spec import emit_local, reduce_over_mesh
     from consul_tpu.ops import bernoulli_mask
     from consul_tpu.sim.faults import link_capacity_at
 
@@ -1632,6 +1686,11 @@ def sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
             known_hist=known_hist, ewma=ewma, wasted=wasted,
             tick=t + 1,
         )
+        if telemetry:
+            outs = (*outs, reduce_over_mesh(
+                "geo", emit_local("geo", st, nxt, outs[:6], cfg),
+                NODE_AXIS,
+            ))
         return (nxt, ob_ov), outs
 
     def body(st, key):
@@ -1651,10 +1710,11 @@ def sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
         wasted=P(),
         tick=P(),
     )
+    n_outs = 8 if telemetry else 7
     run = shard_map(
         body, mesh=mesh,
         in_specs=(state_spec, P()),
-        out_specs=(state_spec, tuple(P() for _ in range(7))),
+        out_specs=(state_spec, tuple(P() for _ in range(n_outs))),
         check_rep=False,
     )
     return run(state, key)

@@ -42,6 +42,7 @@ from consul_tpu.models.swim import (
     VIEW_DEAD,
     VIEW_SUSPECT,
 )
+from consul_tpu.obs.spec import emit_metrics, metric_names
 from consul_tpu.parallel import make_mesh, shard_state
 from consul_tpu.parallel.shard import (
     sharded_broadcast_scan,
@@ -57,24 +58,38 @@ from consul_tpu.sim.metrics import (
 )
 
 
-def _broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int):
+def _broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int,
+                    telemetry: bool = False):
     """Run ``steps`` gossip ticks; returns (final_state, infected[steps]).
 
     Unjitted impl: the public :data:`broadcast_scan` wraps it with cfg
     and steps static; the universe-sweep plane (consul_tpu/sweep) vmaps
     it with traced knob fields inside cfg, which a static jit argument
     could never carry (tracers don't hash).  Same split for every scan
-    entrypoint below."""
+    entrypoint below.
+
+    ``telemetry`` (positional-static, like every flag here) appends one
+    EXTRA output: the [steps, M] Consul-named metrics trace
+    (consul_tpu/obs/spec.py).  Carries, key derivations, and the
+    existing trace streams are untouched — telemetry=off is the exact
+    current program and telemetry=on is bit-equal on every existing
+    output (pinned by tests/test_obs.py; same contract on every scan
+    below)."""
 
     def tick(carry, k):
         nxt = broadcast_round(carry, k, cfg)
-        return nxt, jnp.sum(nxt.knows, dtype=jnp.int32)
+        out = jnp.sum(nxt.knows, dtype=jnp.int32)
+        if telemetry:
+            out = (out, emit_metrics("broadcast", carry, nxt, out, cfg))
+        return nxt, out
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
 
 
-broadcast_scan = jax.jit(_broadcast_scan, static_argnames=("cfg", "steps"))
+broadcast_scan = jax.jit(
+    _broadcast_scan, static_argnames=("cfg", "steps", "telemetry")
+)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "steps"))
@@ -95,25 +110,32 @@ def multidc_scan(state, key: jax.Array, cfg: MultiDCConfig, steps: int):
     return jax.lax.scan(tick, state, keys)
 
 
-def _swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int):
+def _swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int,
+               telemetry: bool = False):
     """Run ``steps`` ticks; returns (final_state, (suspecting, dead_known)).
     Unjitted impl of :data:`swim_scan` (see :func:`_broadcast_scan`)."""
 
     def tick(carry, k):
         nxt = swim_round(carry, k, cfg)
-        return nxt, (
+        out = (
             jnp.sum(nxt.view == VIEW_SUSPECT, dtype=jnp.int32),
             jnp.sum(nxt.view == VIEW_DEAD, dtype=jnp.int32),
         )
+        if telemetry:
+            out = (*out, emit_metrics("swim", carry, nxt, out, cfg))
+        return nxt, out
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
 
 
-swim_scan = jax.jit(_swim_scan, static_argnames=("cfg", "steps"))
+swim_scan = jax.jit(
+    _swim_scan, static_argnames=("cfg", "steps", "telemetry")
+)
 
 
-def _lifeguard_scan(state, key: jax.Array, cfg, steps: int):
+def _lifeguard_scan(state, key: jax.Array, cfg, steps: int,
+                    telemetry: bool = False):
     """Run ``steps`` fault-injected ticks of the Lifeguard model;
     returns (final_state, (suspecting, dead_known, fp_events, refutes,
     mean_awareness)).
@@ -144,17 +166,21 @@ def _lifeguard_scan(state, key: jax.Array, cfg, steps: int):
             (nxt.subject_inc - carry.subject_inc).astype(jnp.int32),
             jnp.mean(nxt.awareness.astype(jnp.float32)),
         )
+        if telemetry:
+            out = (*out, emit_metrics("lifeguard", carry, nxt, out, cfg))
         return nxt, out
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
 
 
-lifeguard_scan = jax.jit(_lifeguard_scan, static_argnames=("cfg", "steps"))
+lifeguard_scan = jax.jit(
+    _lifeguard_scan, static_argnames=("cfg", "steps", "telemetry")
+)
 
 
 def _membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
-                     track: tuple = ()):
+                     track: tuple = (), telemetry: bool = False):
     """Run ``steps`` ticks of the full-membership sim.
 
     Per tick, for each tracked subject j: how many OTHER nodes view j
@@ -184,6 +210,8 @@ def _membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
             jnp.sum(ranks == RANK_SUSPECT, dtype=jnp.int32),
             jnp.sum((nxt.key >= 0) & (ranks <= RANK_SUSPECT), dtype=jnp.int32),
         )
+        if telemetry:
+            out = (*out, emit_metrics("membership", carry, nxt, out, cfg))
         return nxt, out
 
     keys = jax.random.split(key, steps)
@@ -191,7 +219,7 @@ def _membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
 
 
 membership_scan = jax.jit(
-    _membership_scan, static_argnames=("cfg", "steps", "track"),
+    _membership_scan, static_argnames=("cfg", "steps", "track", "telemetry"),
     donate_argnums=(0,),
 )
 
@@ -228,6 +256,16 @@ def _check_exchange(exchange: str, mesh, sharded: bool = False) -> None:
         )
 
 
+def _trace_fields(entrypoint: str, trace) -> dict:
+    """Report kwargs of a telemetry=True study (empty when off)."""
+    if trace is None:
+        return {}
+    return {
+        "metric_names": metric_names(entrypoint),
+        "metrics_trace": np.asarray(trace),
+    }
+
+
 def run_broadcast(
     cfg: BroadcastConfig,
     steps: int,
@@ -237,6 +275,7 @@ def run_broadcast(
     mesh=None,
     warmup: bool = True,
     exchange: str = "alltoall",
+    telemetry: bool = False,
 ) -> BroadcastReport:
     """``mesh=`` alone selects the explicit multi-chip plane
     (consul_tpu/parallel/shard.py: per-device node blocks, outbox
@@ -244,7 +283,10 @@ def run_broadcast(
     ``report.overflow``; ``sharded=True`` keeps the legacy GSPMD
     placement path (shard_state over the unsharded program).
     ``exchange`` picks the outbox transport (``"alltoall"`` |
-    ``"ring"``, bit-equal; see parallel/shard.py:exchange_outbox)."""
+    ``"ring"``, bit-equal; see parallel/shard.py:exchange_outbox).
+    ``telemetry`` fills ``report.metrics_trace`` with the [steps, M]
+    Consul-named trace (consul_tpu/obs) — every existing output stays
+    bit-equal; same seam on every run_* below."""
     _check_exchange(exchange, mesh, sharded)
 
     def make_state():
@@ -257,11 +299,17 @@ def run_broadcast(
         # positional call shapes separately, and tests/benches call the
         # sharded scans positionally.
         def scan(st, k, c, s):
-            return sharded_broadcast_scan(st, k, c, s, mesh, exchange)
+            return sharded_broadcast_scan(
+                st, k, c, s, mesh, exchange, telemetry
+            )
 
-        _, (infected, ov), wall = _timed(
+        _, outs, wall = _timed(
             make_state, scan, key, cfg, steps, warmup
         )
+        if telemetry:
+            infected, ov, trace = outs
+        else:
+            (infected, ov), trace = outs, None
         return BroadcastReport(
             n=cfg.n,
             ticks=steps,
@@ -269,14 +317,22 @@ def run_broadcast(
             infected=np.asarray(infected),
             wall_s=wall,
             overflow=int(np.asarray(ov)),
+            **_trace_fields("broadcast", trace),
         )
-    _, infected, wall = _timed(make_state, broadcast_scan, key, cfg, steps, warmup)
+    if telemetry:
+        def scan(st, k, c, s):  # positional statics: see above
+            return broadcast_scan(st, k, c, s, True)
+    else:
+        scan = broadcast_scan
+    _, outs, wall = _timed(make_state, scan, key, cfg, steps, warmup)
+    infected, trace = outs if telemetry else (outs, None)
     return BroadcastReport(
         n=cfg.n,
         ticks=steps,
         tick_ms=cfg.profile.gossip_interval_ms,
         infected=np.asarray(infected),
         wall_s=wall,
+        **_trace_fields("broadcast", trace),
     )
 
 
@@ -322,11 +378,12 @@ def run_membership(
     mesh=None,
     warmup: bool = True,
     exchange: str = "alltoall",
+    telemetry: bool = False,
 ):
     """Full-membership study; ``track`` selects the subject columns whose
     detection curves come back per tick.  ``mesh=`` alone selects the
-    explicit multi-chip plane, ``exchange`` its outbox transport (see
-    :func:`run_broadcast`)."""
+    explicit multi-chip plane, ``exchange`` its outbox transport,
+    ``telemetry`` the metrics trace (see :func:`run_broadcast`)."""
     from consul_tpu.sim.metrics import MembershipReport
 
     _check_exchange(exchange, mesh, sharded)
@@ -341,12 +398,16 @@ def run_membership(
 
         def scan(st, k, c, s):  # positional statics: see run_broadcast
             return sharded_membership_scan(
-                st, k, c, s, mesh, track_t, exchange
+                st, k, c, s, mesh, track_t, exchange, telemetry
             )
 
-        _, (sus, dead, sus_cells, known, ov), wall = _timed(
+        _, outs, wall = _timed(
             make_state, scan, key, cfg, steps, warmup
         )
+        if telemetry:
+            sus, dead, sus_cells, known, ov, trace = outs
+        else:
+            (sus, dead, sus_cells, known, ov), trace = outs, None
         return MembershipReport(
             n=cfg.n,
             ticks=steps,
@@ -359,11 +420,25 @@ def run_membership(
             known_members=known,
             wall_s=wall,
             overflow=int(np.asarray(ov)),
+            **_trace_fields("membership", trace),
         )
-    scan = functools.partial(membership_scan, track=tuple(track))
-    _, (sus, dead, sus_cells, known), wall = _timed(
+    # telemetry=off keeps the exact pre-telemetry call shape (jit
+    # caches kw/positional binding styles separately — adding an
+    # explicit telemetry=False kw would mint a second identical
+    # program).
+    if telemetry:
+        scan = functools.partial(
+            membership_scan, track=tuple(track), telemetry=True
+        )
+    else:
+        scan = functools.partial(membership_scan, track=tuple(track))
+    _, outs, wall = _timed(
         make_state, scan, key, cfg, steps, warmup
     )
+    if telemetry:
+        sus, dead, sus_cells, known, trace = outs
+    else:
+        (sus, dead, sus_cells, known), trace = outs, None
     return MembershipReport(
         n=cfg.n,
         ticks=steps,
@@ -375,11 +450,12 @@ def run_membership(
         suspect_cells=sus_cells,
         known_members=known,
         wall_s=wall,
+        **_trace_fields("membership", trace),
     )
 
 
 def _sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
-                            track: tuple = ()):
+                            track: tuple = (), telemetry: bool = False):
     """Sparse-model twin of :func:`membership_scan`: per tracked subject
     j, how many observers hold a SUSPECT / DEAD slot for j, plus the
     global suspect-slot count and mean known-membership size.
@@ -432,6 +508,8 @@ def _sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
             # sum rides float32 (a gauge, not an exact count).
             jnp.float32(cfg.base.n) * cfg.base.n - dead_cells,
         )
+        if telemetry:
+            out = (*out, emit_metrics("sparse", carry, nxt, out, cfg))
         return nxt, out
 
     keys = jax.random.split(key, steps)
@@ -439,7 +517,8 @@ def _sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
 
 
 sparse_membership_scan = jax.jit(
-    _sparse_membership_scan, static_argnames=("cfg", "steps", "track"),
+    _sparse_membership_scan,
+    static_argnames=("cfg", "steps", "track", "telemetry"),
     donate_argnums=(0,),
 )
 
@@ -452,6 +531,7 @@ def run_membership_sparse(
     warmup: bool = True,
     mesh=None,
     exchange: str = "alltoall",
+    telemetry: bool = False,
 ):
     """Top-K sparse membership study (models/membership_sparse.py): the
     n ≥ 10⁵ regime the dense model's O(N²) state cannot reach, delivered
@@ -471,13 +551,23 @@ def run_membership_sparse(
 
         def scan(st, k, c, s):  # positional statics: see run_broadcast
             return sharded_sparse_membership_scan(
-                st, k, c, s, mesh, track_t, exchange
+                st, k, c, s, mesh, track_t, exchange, telemetry
             )
+    elif telemetry:
+        scan = functools.partial(
+            sparse_membership_scan, track=tuple(track), telemetry=True
+        )
     else:
+        # telemetry=off keeps the exact pre-telemetry call shape (see
+        # run_membership).
         scan = functools.partial(sparse_membership_scan, track=tuple(track))
-    final, (sus, dead, sus_cells, known), wall = _timed(
+    final, outs, wall = _timed(
         lambda: sparse_membership_init(cfg), scan, key, cfg, steps, warmup
     )
+    if telemetry:
+        sus, dead, sus_cells, known, trace = outs
+    else:
+        (sus, dead, sus_cells, known), trace = outs, None
     report = MembershipReport(
         n=cfg.base.n,
         ticks=steps,
@@ -489,6 +579,7 @@ def run_membership_sparse(
         suspect_cells=sus_cells,
         known_members=known,
         wall_s=wall,
+        **_trace_fields("sparse", trace),
     )
     return report, int(np.asarray(final.overflow))
 
@@ -500,6 +591,7 @@ def run_lifeguard(
     sharded: bool = False,
     mesh=None,
     warmup: bool = True,
+    telemetry: bool = False,
 ) -> FalsePositiveReport:
     """Fault-injected Lifeguard study (cfg: LifeguardConfig): the
     accuracy (FP-rate) workload.  Same single-scan/one-trace contract
@@ -511,9 +603,18 @@ def run_lifeguard(
         return shard_state(st, mesh or make_mesh()) if sharded else st
 
     key = jax.random.PRNGKey(seed)
-    _, (sus, dead, fp, refutes, aware), wall = _timed(
-        make_state, lifeguard_scan, key, cfg, steps, warmup
+    if telemetry:
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return lifeguard_scan(st, k, c, s, True)
+    else:
+        scan = lifeguard_scan
+    _, outs, wall = _timed(
+        make_state, scan, key, cfg, steps, warmup
     )
+    if telemetry:
+        sus, dead, fp, refutes, aware, trace = outs
+    else:
+        (sus, dead, fp, refutes, aware), trace = outs, None
     return FalsePositiveReport(
         n=cfg.n,
         ticks=steps,
@@ -528,10 +629,11 @@ def run_lifeguard(
         refutes=np.asarray(refutes),
         mean_awareness=np.asarray(aware),
         wall_s=wall,
+        **_trace_fields("lifeguard", trace),
     )
 
 
-def run_sweep(universe, warmup: bool = True):
+def run_sweep(universe, warmup: bool = True, telemetry: bool = False):
     """Run a universe sweep (consul_tpu/sweep): ONE jitted program
     advances all U universes — stacked carries, per-universe PRNG keys,
     knob values as vmapped [U] arrays — and the stacked per-tick
@@ -548,7 +650,7 @@ def run_sweep(universe, warmup: bool = True):
     from consul_tpu.sweep.frontier import summarize_sweep
     from consul_tpu.sweep.universe import make_sweep, stacked_init
 
-    sweep = make_sweep(universe.entrypoint, universe.U)
+    sweep = make_sweep(universe.entrypoint, universe.U, telemetry)
     keys = universe.keys()
     values = universe.knob_arrays()
 
@@ -565,10 +667,24 @@ def run_sweep(universe, warmup: bool = True):
     _final, outs = call()
     outs = jax.tree_util.tree_map(np.asarray, outs)
     wall = time.perf_counter() - t0
-    return summarize_sweep(universe, outs, wall)
+    trace = None
+    if telemetry:
+        # The batched [U, steps, M] trace rides as the LAST output of
+        # every telemetry=on scan; strip it before the per-model
+        # summarizer (whose tuple shapes are the telemetry=off ones).
+        *core, trace = outs
+        outs = tuple(core)
+        if universe.entrypoint == "broadcast":
+            outs = outs[0]  # unbatched broadcast out is a bare array
+    report = summarize_sweep(universe, outs, wall)
+    if trace is not None:
+        report.metric_names = metric_names(universe.entrypoint)
+        report.metrics_trace = np.asarray(trace)
+    return report
 
 
-def _streamcast_scan(state, key: jax.Array, cfg, steps: int):
+def _streamcast_scan(state, key: jax.Array, cfg, steps: int,
+                     telemetry: bool = False):
     """Run ``steps`` ticks of the pipelined event stream
     (consul_tpu/streamcast); returns ``(final_state, outs)`` with
     ``outs`` the per-tick window snapshots + cumulative counters
@@ -590,14 +706,17 @@ def _streamcast_scan(state, key: jax.Array, cfg, steps: int):
     sched = arrival_arrays(cfg, jax.random.fold_in(key, _SCHED_SALT))
 
     def tick(carry, k):
-        return streamcast_round(carry, k, cfg, sched)
+        nxt, out = streamcast_round(carry, k, cfg, sched)
+        if telemetry:
+            out = (*out, emit_metrics("streamcast", carry, nxt, out, cfg))
+        return nxt, out
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
 
 
 streamcast_scan = jax.jit(
-    _streamcast_scan, static_argnames=("cfg", "steps"),
+    _streamcast_scan, static_argnames=("cfg", "steps", "telemetry"),
     donate_argnums=(0,),
 )
 
@@ -609,6 +728,7 @@ def run_streamcast(
     warmup: bool = True,
     mesh=None,
     exchange: str = "alltoall",
+    telemetry: bool = False,
 ):
     """Sustained-load streamcast study (cfg: StreamcastConfig): the
     heavy-traffic workload — a continuous chunked event stream under
@@ -629,12 +749,21 @@ def run_streamcast(
     key = jax.random.PRNGKey(seed)
     if mesh is not None:
         def scan(st, k, c, s):  # positional statics: see run_broadcast
-            return sharded_streamcast_scan(st, k, c, s, mesh, exchange)
+            return sharded_streamcast_scan(
+                st, k, c, s, mesh, exchange, telemetry
+            )
+    elif telemetry:
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return streamcast_scan(st, k, c, s, True)
     else:
         scan = streamcast_scan
     final, outs, wall = _timed(
         lambda: streamcast_init(cfg), scan, key, cfg, steps, warmup
     )
+    if telemetry:
+        *outs, trace = outs
+    else:
+        trace = None
     if mesh is not None:
         *outs, shard_ov = outs
         shard_ov = int(np.asarray(shard_ov)[-1])
@@ -660,10 +789,12 @@ def run_streamcast(
         sent=np.asarray(sent),
         wall_s=wall,
         shard_overflow=shard_ov,
+        **_trace_fields("streamcast", trace),
     )
 
 
-def _geo_scan(state, key: jax.Array, cfg, steps: int):
+def _geo_scan(state, key: jax.Array, cfg, steps: int,
+              telemetry: bool = False):
     """Run ``steps`` LAN ticks of the geo/WAN plane
     (consul_tpu/geo.model.geo_round); returns ``(final_state, outs)``
     with ``outs`` the per-tick ``(per_segment, offered, admitted,
@@ -675,14 +806,18 @@ def _geo_scan(state, key: jax.Array, cfg, steps: int):
     from consul_tpu.geo.model import geo_round
 
     def tick(carry, k):
-        return geo_round(carry, k, cfg)
+        nxt, out = geo_round(carry, k, cfg)
+        if telemetry:
+            out = (*out, emit_metrics("geo", carry, nxt, out, cfg))
+        return nxt, out
 
     keys = jax.random.split(key, steps)
     return jax.lax.scan(tick, state, keys)
 
 
 geo_scan = jax.jit(
-    _geo_scan, static_argnames=("cfg", "steps"), donate_argnums=(0,),
+    _geo_scan, static_argnames=("cfg", "steps", "telemetry"),
+    donate_argnums=(0,),
 )
 
 
@@ -693,6 +828,7 @@ def run_geo(
     warmup: bool = True,
     mesh=None,
     exchange: str = "alltoall",
+    telemetry: bool = False,
 ):
     """Geo-distributed WAN study (cfg: GeoConfig): E concurrent events
     spread over S segments through latency-delayed, bandwidth-capped
@@ -714,12 +850,21 @@ def run_geo(
     key = jax.random.PRNGKey(seed)
     if mesh is not None:
         def scan(st, k, c, s):  # positional statics: see run_broadcast
-            return sharded_geo_scan(st, k, c, s, mesh, exchange)
+            return sharded_geo_scan(
+                st, k, c, s, mesh, exchange, telemetry
+            )
+    elif telemetry:
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return geo_scan(st, k, c, s, True)
     else:
         scan = geo_scan
     _final, outs, wall = _timed(
         lambda: geo_init(cfg), scan, key, cfg, steps, warmup
     )
+    if telemetry:
+        *outs, trace = outs
+    else:
+        trace = None
     if mesh is not None:
         *outs, shard_ov = outs
         shard_ov = int(np.asarray(shard_ov)[-1])
@@ -742,6 +887,7 @@ def run_geo(
         wasted=np.asarray(wasted),
         wall_s=wall,
         shard_overflow=shard_ov,
+        **_trace_fields("geo", trace),
     )
 
 
@@ -752,13 +898,23 @@ def run_swim(
     sharded: bool = False,
     mesh=None,
     warmup: bool = True,
+    telemetry: bool = False,
 ) -> SwimReport:
     def make_state():
         st = swim_init(cfg)
         return shard_state(st, mesh or make_mesh()) if sharded else st
 
     key = jax.random.PRNGKey(seed)
-    _, (sus, dead), wall = _timed(make_state, swim_scan, key, cfg, steps, warmup)
+    if telemetry:
+        def scan(st, k, c, s):  # positional statics: see run_broadcast
+            return swim_scan(st, k, c, s, True)
+    else:
+        scan = swim_scan
+    _, outs, wall = _timed(make_state, scan, key, cfg, steps, warmup)
+    if telemetry:
+        sus, dead, trace = outs
+    else:
+        (sus, dead), trace = outs, None
     return SwimReport(
         n=cfg.n,
         ticks=steps,
@@ -767,6 +923,7 @@ def run_swim(
         suspecting=np.asarray(sus),
         dead_known=np.asarray(dead),
         wall_s=wall,
+        **_trace_fields("swim", trace),
     )
 
 
@@ -976,6 +1133,68 @@ def jaxlint_registry(include=("small", "big"),
             # big traces cost ~5 s each).
             add_sharded("small", d, bcfg, 8, mcfg, 8, (3,),
                         scfg, 8, (3,), exchanges=("alltoall", "ring"))
+        # telemetry=on twins (consul_tpu/obs): every zero-findings gate
+        # walks the metrics-emission path of all seven entrypoints —
+        # and of the five sharded twins' psum assembly (alltoall only:
+        # the emission is transport-independent).
+        add("broadcast@small/telemetry", "broadcast_scan",
+            lambda: broadcast_init(bcfg),
+            lambda s, k: broadcast_scan(s, k, bcfg, 8, True), bcfg.n)
+        add("membership@small/telemetry", "membership_scan",
+            lambda: membership_init(mcfg),
+            lambda s, k: membership_scan(s, k, mcfg, 8, (3,), True),
+            mcfg.n)
+        add("sparse@small/telemetry", "sparse_membership_scan",
+            lambda: sparse_membership_init(scfg),
+            lambda s, k: sparse_membership_scan(
+                s, k, scfg, 8, (3,), True),
+            mcfg.n)
+        add("swim@small/telemetry", "swim_scan",
+            lambda: swim_init(swcfg),
+            lambda s, k: swim_scan(s, k, swcfg, 8, True), swcfg.n)
+        add("lifeguard@small/telemetry", "lifeguard_scan",
+            lambda: lifeguard_init(lgcfg),
+            lambda s, k: lifeguard_scan(s, k, lgcfg, 8, True), lgcfg.n)
+        add("streamcast@small/telemetry", "streamcast_scan",
+            lambda: streamcast_init(stcfg),
+            lambda s, k: streamcast_scan(s, k, stcfg, 8, True), stcfg.n)
+        add("geo@small/telemetry", "geo_scan",
+            lambda: geo_init(gecfg),
+            lambda s, k: geo_scan(s, k, gecfg, 8, True), gecfg.n)
+        for d in sharded_devices:
+            if d > len(jax.devices()):
+                continue
+            mesh_t = make_mesh(jax.devices()[:d])
+            add(f"sharded_broadcast@small/D{d}/telemetry",
+                "sharded_broadcast_scan",
+                lambda: broadcast_init(bcfg),
+                lambda s, k, m=mesh_t: sharded_broadcast_scan(
+                    s, k, bcfg, 8, m, "alltoall", True),
+                bcfg.n, devices=d, per_chip=True)
+            add(f"sharded_membership@small/D{d}/telemetry",
+                "sharded_membership_scan",
+                lambda: membership_init(mcfg),
+                lambda s, k, m=mesh_t: sharded_membership_scan(
+                    s, k, mcfg, 8, m, (3,), "alltoall", True),
+                mcfg.n, devices=d, per_chip=True)
+            add(f"sharded_sparse@small/D{d}/telemetry",
+                "sharded_sparse_membership_scan",
+                lambda: sparse_membership_init(scfg),
+                lambda s, k, m=mesh_t: sharded_sparse_membership_scan(
+                    s, k, scfg, 8, m, (3,), "alltoall", True),
+                scfg.base.n, devices=d, per_chip=True)
+            add(f"sharded_streamcast@small/D{d}/telemetry",
+                "sharded_streamcast_scan",
+                lambda: streamcast_init(stcfg),
+                lambda s, k, m=mesh_t: sharded_streamcast_scan(
+                    s, k, stcfg, 8, m, "alltoall", True),
+                stcfg.n, devices=d, per_chip=True)
+            add(f"sharded_geo@small/D{d}/telemetry",
+                "sharded_geo_scan",
+                lambda: geo_init(gecfg),
+                lambda s, k, m=mesh_t: sharded_geo_scan(
+                    s, k, gecfg, 8, m, "alltoall", True),
+                gecfg.n, devices=d, per_chip=True)
     if "big" in include:
         # The north-star shapes bench.py measures: 1M nodes for the
         # per-node-plane models (dense membership capped at its 16k
@@ -1078,15 +1297,17 @@ def jaxlint_registry(include=("small", "big"),
     from consul_tpu.sweep.universe import abstract_sweep_program
 
     def add_sweep(tag: str, model: str, cfg, steps: int, U: int,
-                  knobs: tuple, track: tuple, n: int) -> None:
+                  knobs: tuple, track: tuple, n: int,
+                  telemetry: bool = False) -> None:
         def build(model=model, cfg=cfg, steps=steps, U=U, knobs=knobs,
-                  track=track):
+                  track=track, telemetry=telemetry):
             return abstract_sweep_program(model, cfg, steps, U, knobs,
-                                          track)
+                                          track, telemetry)
 
-        programs[f"sweep_{model}@{tag}/U{U}"] = SimProgram(
-            name=f"sweep_{model}@{tag}/U{U}", entrypoint="sweep_scan",
-            build=build, n=n,
+        sfx = "/telemetry" if telemetry else ""
+        programs[f"sweep_{model}@{tag}/U{U}{sfx}"] = SimProgram(
+            name=f"sweep_{model}@{tag}/U{U}{sfx}",
+            entrypoint="sweep_scan", build=build, n=n,
         )
 
     if "small" in include:
@@ -1122,6 +1343,12 @@ def jaxlint_registry(include=("small", "big"),
         for model, cfg, steps, knobs, track, n in sw_small:
             for u in (1, 8):
                 add_sweep("small", model, cfg, steps, u, knobs, track, n)
+        # Batched telemetry twin: the [U, steps, M] trace plane under
+        # the zero-findings gates (one model suffices — the obs seam
+        # is shared by every vmapped impl).
+        sw_model, sw_cfg, sw_steps, sw_knobs, sw_track, sw_n = sw_small[0]
+        add_sweep("small", sw_model, sw_cfg, sw_steps, 8, sw_knobs,
+                  sw_track, sw_n, telemetry=True)
     if "big" in include:
         scfg100k = SparseMembershipConfig(
             base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,

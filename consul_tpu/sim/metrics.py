@@ -32,6 +32,10 @@ class BroadcastReport:
     # static per-shard budget (consul_tpu/parallel/shard.py); 0 means
     # the multi-chip run delivered exactly what a single chip would.
     overflow: Optional[int] = None
+    # telemetry=True runs only (consul_tpu/obs): the [steps, M]
+    # Consul-named metrics trace and its ordered column names.
+    metric_names: tuple = ()
+    metrics_trace: Optional[np.ndarray] = None
 
     def time_to_ms(self, frac: float) -> Optional[float]:
         t = time_to_fraction(self.infected, self.n, frac)
@@ -121,6 +125,10 @@ class MembershipReport:
     wall_s: float
     # Sharded (shard_map) runs only — see BroadcastReport.overflow.
     overflow: Optional[int] = None
+    # telemetry=True runs only (consul_tpu/obs): the [steps, M]
+    # Consul-named metrics trace and its ordered column names.
+    metric_names: tuple = ()
+    metrics_trace: Optional[np.ndarray] = None
 
     @property
     def rounds_per_sec(self) -> float:
@@ -190,6 +198,10 @@ class FalsePositiveReport:
     refutes: np.ndarray          # int32[ticks]
     mean_awareness: np.ndarray   # float32[ticks]
     wall_s: float
+    # telemetry=True runs only (consul_tpu/obs): the [steps, M]
+    # Consul-named metrics trace and its ordered column names.
+    metric_names: tuple = ()
+    metrics_trace: Optional[np.ndarray] = None
 
     @property
     def rounds_per_sec(self) -> float:
@@ -265,6 +277,10 @@ class SwimReport:
     suspecting: np.ndarray        # nodes viewing subject SUSPECT, per tick
     dead_known: np.ndarray        # nodes viewing subject DEAD, per tick
     wall_s: float
+    # telemetry=True runs only (consul_tpu/obs): the [steps, M]
+    # Consul-named metrics trace and its ordered column names.
+    metric_names: tuple = ()
+    metrics_trace: Optional[np.ndarray] = None
 
     @property
     def rounds_per_sec(self) -> float:

@@ -37,18 +37,34 @@ from consul_tpu.protocol import LAN, WAN
 from consul_tpu.sim.engine import run_broadcast, run_membership, run_swim
 
 
-def dev3(seed: int = 0) -> dict:
+
+def _metrics_out(entrypoint: str, rep) -> dict:
+    """Bridge a telemetry=True report into a FRESH telemetry.Metrics
+    (not the process-global agent sink) and return the
+    /v1/agent/metrics-shaped snapshot for the scenario summary."""
+    from consul_tpu.obs import bridge_report
+    from consul_tpu.telemetry import Metrics
+
+    return {"metrics": bridge_report(entrypoint, rep, Metrics()).snapshot()}
+
+
+def dev3(seed: int = 0, telemetry: bool = False) -> dict:
     """BASELINE config 1: 3-node dev pool, one user event (CPU-scale ref).
 
     The 3-node `agent -dev` LAN pool of the reference; at this size the
     exact edge simulation is the only sensible mode."""
     cfg = BroadcastConfig(n=3, profile=LAN, delivery="edges")
-    rep = run_broadcast(cfg, steps=10, seed=seed, warmup=False)
-    return {"scenario": "dev3", **rep.summary()}
+    rep = run_broadcast(cfg, steps=10, seed=seed, warmup=False,
+                        telemetry=telemetry)
+    return {
+        "scenario": "dev3",
+        **rep.summary(),
+        **(_metrics_out("broadcast", rep) if telemetry else {}),
+    }
 
 
 def probe1k(seed: int = 0, devices: int = None,
-            exchange: str = "alltoall") -> dict:
+            exchange: str = "alltoall", telemetry: bool = False) -> dict:
     """BASELINE config 2: 1k nodes, SWIM probe/ack, 1% induced failure.
 
     1% of 1000 = 10 CONCURRENT crashes in one full-membership program
@@ -69,7 +85,7 @@ def probe1k(seed: int = 0, devices: int = None,
     rep = run_membership(cfg, steps=300, seed=seed, track=failed,
                          warmup=False,
                          mesh=mesh_for(devices) if devices else None,
-                         exchange=exchange)
+                         exchange=exchange, telemetry=telemetry)
     first_sus = [rep.first_detection_ms(i) for i in range(len(failed))]
     live = cfg.n - len(failed)
     conv = [rep.dead_converged(i, live) for i in range(len(failed))]
@@ -88,11 +104,13 @@ def probe1k(seed: int = 0, devices: int = None,
         **({"devices": devices, "exchange_backend": exchange,
             "shard_overflow": rep.overflow}
            if devices else {}),
+        **(_metrics_out("membership", rep) if telemetry else {}),
     }
 
 
 def event100k(seed: int = 0, devices: int = None,
-              exchange: str = "alltoall") -> dict:
+              exchange: str = "alltoall",
+              telemetry: bool = False) -> dict:
     """BASELINE config 3: 100k-node event broadcast, LAN, fanout 4.
 
     ``devices`` runs the exact per-message path sharded over the first
@@ -105,21 +123,26 @@ def event100k(seed: int = 0, devices: int = None,
         cfg = BroadcastConfig(n=100_000, fanout=4, profile=LAN,
                               delivery="edges")
         rep = run_broadcast(cfg, steps=100, seed=seed,
-                            mesh=mesh_for(devices), exchange=exchange)
+                            mesh=mesh_for(devices), exchange=exchange,
+                            telemetry=telemetry)
         return {"scenario": "event100k", **rep.summary(),
                 "devices": devices, "exchange_backend": exchange,
-                "shard_overflow": rep.overflow}
+                "shard_overflow": rep.overflow,
+                **(_metrics_out("broadcast", rep) if telemetry else {})}
     cfg = BroadcastConfig(n=100_000, fanout=4, profile=LAN,
                           delivery="aggregate")
     # exchange threads through so a non-default transport without a
     # mesh is rejected by the engine, not silently dropped (same
     # loud-never-silent contract as probe1k).
-    rep = run_broadcast(cfg, steps=100, seed=seed, exchange=exchange)
-    return {"scenario": "event100k", **rep.summary()}
+    rep = run_broadcast(cfg, steps=100, seed=seed, exchange=exchange,
+                        telemetry=telemetry)
+    return {"scenario": "event100k", **rep.summary(),
+            **(_metrics_out("broadcast", rep) if telemetry else {})}
 
 
 def stream100k(seed: int = 0, n: int = 100_000, steps: int = 150,
-               devices: int = None, exchange: str = "alltoall") -> dict:
+               devices: int = None, exchange: str = "alltoall",
+               telemetry: bool = False) -> dict:
     """Sustained event stream at 100k nodes: Poisson arrivals of
     4-chunk events pipelined through an 8-slot window under a fixed
     2-slot/round budget (consul_tpu/streamcast) — the heavy-traffic
@@ -145,17 +168,19 @@ def stream100k(seed: int = 0, n: int = 100_000, steps: int = 150,
     )
     rep = run_streamcast(cfg, steps=steps, seed=seed, warmup=False,
                          mesh=mesh_for(devices) if devices else None,
-                         exchange=exchange)
+                         exchange=exchange, telemetry=telemetry)
     return {
         "scenario": "stream100k",
         **rep.summary(),
         **({"devices": devices, "exchange_backend": exchange}
            if devices else {}),
+        **(_metrics_out("streamcast", rep) if telemetry else {}),
     }
 
 
 def geo100k(seed: int = 0, n: int = 100_000, steps: int = 120,
-            devices: int = None, exchange: str = "alltoall") -> dict:
+            devices: int = None, exchange: str = "alltoall",
+            telemetry: bool = False) -> dict:
     """100k-node geo/WAN study (consul_tpu/geo): 8 DCs with
     Vivaldi-derived per-link latency, bandwidth-capped WAN links under
     a mid-run brownout, and adaptive anti-entropy between the bridge
@@ -192,11 +217,12 @@ def geo100k(seed: int = 0, n: int = 100_000, steps: int = 120,
     )
     rep = run_geo(cfg, steps=steps, seed=seed, warmup=False,
                   mesh=mesh_for(devices) if devices else None,
-                  exchange=exchange)
+                  exchange=exchange, telemetry=telemetry)
     return {
         "scenario": "geo100k",
         **rep.summary(),
         "vivaldi_rel_rtt_error": round(vinfo["rel_rtt_error"], 4),
+        **(_metrics_out("geo", rep) if telemetry else {}),
         **({"devices": devices, "exchange_backend": exchange}
            if devices else {}),
     }
@@ -318,13 +344,16 @@ SCENARIOS: dict[str, Callable[..., dict]] = {
 
 
 def run_scenario(name: str, seed: int = 0, devices: int = None,
-                 exchange: str = None) -> dict:
+                 exchange: str = None, telemetry: bool = False) -> dict:
     """Run a preset by name.  ``devices`` shards the node axis over the
     first D mesh devices for the scenarios that support it (probe1k,
     event100k, stream100k, geo100k); asking it of any other preset is an error,
     not a silent single-chip run.  ``exchange`` picks the outbox transport of the
     sharded plane and therefore requires ``devices`` — same
-    loud-never-silent contract."""
+    loud-never-silent contract.  ``telemetry`` runs the study with the
+    in-scan metrics seam on (consul_tpu/obs) and adds the bridged
+    /v1/agent/metrics-shaped snapshot under ``"metrics"`` (``cli sim
+    --metrics``); presets without the seam reject it loudly too."""
     import inspect
 
     try:
@@ -338,11 +367,18 @@ def run_scenario(name: str, seed: int = 0, devices: int = None,
             "--exchange selects the sharded plane's outbox transport "
             "and requires --devices"
         )
+    params = inspect.signature(fn).parameters
+    if telemetry and "telemetry" not in params:
+        raise ValueError(
+            f"scenario {name!r} does not support --metrics"
+        )
+    tele_kw = {"telemetry": True} if telemetry else {}
     if devices:
-        if "devices" not in inspect.signature(fn).parameters:
+        if "devices" not in params:
             raise ValueError(
                 f"scenario {name!r} does not support --devices"
             )
         return fn(seed=seed, devices=devices,
-                  **({"exchange": exchange} if exchange else {}))
-    return fn(seed=seed)
+                  **({"exchange": exchange} if exchange else {}),
+                  **tele_kw)
+    return fn(seed=seed, **tele_kw)

@@ -101,6 +101,10 @@ class StreamcastReport:
     # Sharded (shard_map) runs only: outbox budget misses —
     # see BroadcastReport.overflow.
     shard_overflow: int = None
+    # telemetry=True runs only (consul_tpu/obs): the [steps, M]
+    # Consul-named metrics trace and its ordered column names.
+    metric_names: tuple = ()
+    metrics_trace: np.ndarray = None
 
     @property
     def sim_seconds(self) -> float:

@@ -136,6 +136,10 @@ class SweepReport:
     values: dict                 # path -> np[U] knob values
     metrics: dict                # name -> np[U] per-universe metrics
     wall_s: float
+    # telemetry=True sweeps only (consul_tpu/obs): the batched
+    # [U, steps, M] Consul-named metrics trace + its column names.
+    metric_names: tuple = ()
+    metrics_trace: "np.ndarray" = None
 
     @property
     def universes_per_sec(self) -> float:

@@ -128,7 +128,8 @@ SWEEP_ENTRYPOINTS: dict = {
     "swim": _EntrypointSpec(
         name="swim",
         init=swim_init,
-        call=lambda s, k, c, steps, track: engine._swim_scan(s, k, c, steps),
+        call=lambda s, k, c, steps, track, telemetry=False:
+            engine._swim_scan(s, k, c, steps, telemetry),
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss", "suspicion_scale"}),
         aggregate_only=frozenset({"profile.gossip_nodes"}),
@@ -136,8 +137,8 @@ SWEEP_ENTRYPOINTS: dict = {
     "lifeguard": _EntrypointSpec(
         name="lifeguard",
         init=_lifeguard_init,
-        call=lambda s, k, c, steps, track: engine._lifeguard_scan(
-            s, k, c, steps),
+        call=lambda s, k, c, steps, track, telemetry=False:
+            engine._lifeguard_scan(s, k, c, steps, telemetry),
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss", "suspicion_scale", "ack_late"}),
         aggregate_only=frozenset({"profile.gossip_nodes"}),
@@ -146,8 +147,8 @@ SWEEP_ENTRYPOINTS: dict = {
     "broadcast": _EntrypointSpec(
         name="broadcast",
         init=lambda cfg: broadcast_init(cfg, origin=0),
-        call=lambda s, k, c, steps, track: engine._broadcast_scan(
-            s, k, c, steps),
+        call=lambda s, k, c, steps, track, telemetry=False:
+            engine._broadcast_scan(s, k, c, steps, telemetry),
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss"}),
         aggregate_only=frozenset({"fanout"}),
@@ -155,8 +156,8 @@ SWEEP_ENTRYPOINTS: dict = {
     "membership": _EntrypointSpec(
         name="membership",
         init=membership_init,
-        call=lambda s, k, c, steps, track: engine._membership_scan(
-            s, k, c, steps, track),
+        call=lambda s, k, c, steps, track, telemetry=False:
+            engine._membership_scan(s, k, c, steps, track, telemetry),
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss", "suspicion_scale"}),
         aggregate_only=frozenset(),
@@ -164,8 +165,9 @@ SWEEP_ENTRYPOINTS: dict = {
     "sparse": _EntrypointSpec(
         name="sparse",
         init=_sparse_init,
-        call=lambda s, k, c, steps, track: engine._sparse_membership_scan(
-            s, k, c, steps, track),
+        call=lambda s, k, c, steps, track, telemetry=False:
+            engine._sparse_membership_scan(
+                s, k, c, steps, track, telemetry),
         base_cfg=lambda c: c.base,
         knob_paths=frozenset({"base.loss", "base.suspicion_scale"}),
         aggregate_only=frozenset(),
@@ -178,8 +180,8 @@ SWEEP_ENTRYPOINTS: dict = {
     "streamcast": _EntrypointSpec(
         name="streamcast",
         init=_streamcast_init,
-        call=lambda s, k, c, steps, track: engine._streamcast_scan(
-            s, k, c, steps),
+        call=lambda s, k, c, steps, track, telemetry=False:
+            engine._streamcast_scan(s, k, c, steps, telemetry),
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss", "rate", "chunk_budget"}),
         aggregate_only=frozenset({"fanout"}),
@@ -195,8 +197,8 @@ SWEEP_ENTRYPOINTS: dict = {
     "geo": _EntrypointSpec(
         name="geo",
         init=_geo_init,
-        call=lambda s, k, c, steps, track: engine._geo_scan(
-            s, k, c, steps),
+        call=lambda s, k, c, steps, track, telemetry=False:
+            engine._geo_scan(s, k, c, steps, telemetry),
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss_lan", "loss_wan", "ae_gain"}),
         aggregate_only=frozenset(),
@@ -463,10 +465,13 @@ def stacked_init(universe: Universe):
     )
 
 
-@functools.lru_cache(maxsize=None)
-def make_sweep(entrypoint: str, U: int):
-    """The batched scan program for (entrypoint, U) — both positional-
-    static, mirroring the engine's jit-cache discipline.
+def make_sweep(entrypoint: str, U: int, telemetry: bool = False):
+    """The batched scan program for (entrypoint, U, telemetry) — all
+    positional-static, mirroring the engine's jit-cache discipline.
+    ``telemetry=True`` threads the in-scan metrics seam
+    (consul_tpu/obs) through the vmapped impl, so the stacked outputs
+    gain one [U, steps, M] trace plane as their LAST element — every
+    existing output stays bit-equal.
 
     Returns ONE jitted callable per (entrypoint, U) (lru-cached, so
     repeated calls share the jit cache and the knob *values* never
@@ -484,6 +489,14 @@ def make_sweep(entrypoint: str, U: int):
     scan impl; U=1 is bit-equal to the unbatched entrypoint (pinned
     per model in tests/test_sweep.py).
     """
+    # Normalized here (not via lru_cache on this function) so the
+    # 2-arg legacy call and an explicit telemetry=False share ONE
+    # cache entry — the one-program-per-(entrypoint, U) guard.
+    return _make_sweep(entrypoint, U, bool(telemetry))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sweep(entrypoint: str, U: int, telemetry: bool):
     if entrypoint not in SWEEP_ENTRYPOINTS:
         raise ValueError(
             f"unknown sweep entrypoint {entrypoint!r} "
@@ -503,7 +516,7 @@ def make_sweep(entrypoint: str, U: int):
 
         def one(state, key, vals):
             ucfg = apply_knobs(cfg, knobs, vals)
-            return spec.call(state, key, ucfg, steps, track)
+            return spec.call(state, key, ucfg, steps, track, telemetry)
 
         return jax.vmap(one)(stacked_state, keys, tuple(values))
 
@@ -515,13 +528,14 @@ def make_sweep(entrypoint: str, U: int):
 
 
 def abstract_sweep_program(entrypoint: str, cfg, steps: int, U: int,
-                           knobs: tuple = (), track: tuple = ()):
+                           knobs: tuple = (), track: tuple = (),
+                           telemetry: bool = False):
     """(fn, abstract args) of the batched program — the jaxlint-
     registry build shape (sim/engine.py jaxlint_registry) and the
     bench max-U-per-chip estimator both trace it: eval_shape states,
     zero device memory."""
     spec = SWEEP_ENTRYPOINTS[entrypoint]
-    sweep = make_sweep(entrypoint, U)
+    sweep = make_sweep(entrypoint, U, telemetry)
     state = jax.eval_shape(lambda: spec.init(cfg))
     stacked = jax.tree_util.tree_map(
         lambda s: jax.ShapeDtypeStruct((U,) + s.shape, s.dtype), state

@@ -14,27 +14,41 @@ endpoint read.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Optional
 
 
 class _Sample:
-    __slots__ = ("count", "total", "min", "max")
+    __slots__ = ("count", "total", "sumsq", "min", "max")
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
+        self.sumsq = 0.0
         self.min = float("inf")
         self.max = float("-inf")
 
     def add(self, value: float) -> None:
         self.count += 1
         self.total += value
+        self.sumsq += value * value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
 
+    def stddev(self) -> float:
+        """go-metrics AggregateSample.Stddev (inmem.go): sample
+        standard deviation, 0 below two observations."""
+        if self.count < 2:
+            return 0.0
+        num = self.count * self.sumsq - self.total * self.total
+        div = float(self.count * (self.count - 1))
+        return math.sqrt(num / div) if num > 0 else 0.0
+
     def snapshot(self, name: str) -> dict:
+        """The reference InmemSink DisplayMetrics SampledValue shape
+        (inmem_endpoint.go): aggregate stats + the Labels map."""
         mean = self.total / self.count if self.count else 0.0
         return {
             "Name": name,
@@ -43,6 +57,8 @@ class _Sample:
             "Min": round(self.min, 6) if self.count else 0.0,
             "Max": round(self.max, 6) if self.count else 0.0,
             "Mean": round(mean, 6),
+            "Stddev": round(self.stddev(), 6),
+            "Labels": {},
         }
 
 
@@ -79,8 +95,11 @@ class Metrics:
             return {
                 "Timestamp": time.strftime("%Y-%m-%d %H:%M:%S +0000 UTC",
                                            time.gmtime()),
+                # GaugeValue carries a Labels map in the reference
+                # DisplayMetrics shape (inmem_endpoint.go) — emitted
+                # (empty) so consumers see the exact JSON schema.
                 "Gauges": [
-                    {"Name": k, "Value": v}
+                    {"Name": k, "Value": v, "Labels": {}}
                     for k, v in sorted(self._gauges.items())
                 ],
                 "Counters": [

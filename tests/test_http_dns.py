@@ -202,6 +202,35 @@ class TestHTTPCatalogHealthAgent:
             status, _, data = await http_call(addr, "GET", "/v1/catalog/node/dev")
             assert status == 200 and data["Node"]["Node"] == "dev"
 
+    async def test_agent_metrics_memberlist_hot_path(self):
+        """/v1/agent/metrics (agent_endpoint.go AgentMetrics) carries
+        the memberlist hot-path gauges in the reference InmemSink
+        DisplayMetrics shape: the Lifeguard ``memberlist.health.score``
+        gauge (awareness.go:50 — wired at awareness construction, so a
+        healthy agent reports 0 rather than nothing) with the Labels
+        field, and Stddev on every aggregated sample."""
+        from consul_tpu.telemetry import metrics
+
+        metrics().reset()
+        async with dev_stack() as (_agent, addr, _, _):
+            # A first request so its http.request timer sample is
+            # aggregated before the snapshot below reads it.
+            await http_call(addr, "GET", "/v1/agent/self")
+            status, _, snap = await http_call(addr, "GET",
+                                              "/v1/agent/metrics")
+            assert status == 200
+            gauges = {g["Name"]: g for g in snap["Gauges"]}
+            score = gauges["memberlist.health.score"]
+            assert score["Value"] == 0  # healthy dev agent
+            assert score["Labels"] == {}
+            # DisplayMetrics sample shape (Stddev + Labels) on the
+            # timer samples the HTTP hot path just emitted.
+            samples = {s["Name"]: s for s in snap["Samples"]}
+            req = samples["http.request"]
+            for field in ("Count", "Sum", "Min", "Max", "Mean",
+                          "Stddev", "Labels"):
+                assert field in req
+
     async def test_status_and_members(self):
         async with dev_stack() as (_, addr, _, _):
             status, _, leader = await http_call(addr, "GET", "/v1/status/leader")

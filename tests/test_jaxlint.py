@@ -350,6 +350,72 @@ class TestPallasTraversal:
 # ---------------------------------------------------------------------------
 
 
+class TestTelemetryFootprint:
+    """J6 pins what telemetry=on costs in HBM: the persistent (output)
+    footprint delta of every on/off program pair is EXACTLY the
+    [steps, M] float32 trace plane the scan returns — telemetry adds
+    no hidden plane — and the live-peak delta is bounded by that plane
+    plus at most ONE elementwise emitter temporary over the model's
+    widest state plane (zero peak movement for five of the seven
+    families; the dense-membership diff masks are the exception)."""
+
+    STEPS = 8
+    # (off name, metric family, widest state-plane cells at small-n)
+    PAIRS = [
+        ("broadcast@small", "broadcast", 64),
+        ("membership@small", "membership", 48 * 48),
+        ("sparse@small", "sparse", 48 * 8),
+        ("swim@small", "swim", 64),
+        ("lifeguard@small", "lifeguard", 64),
+        ("streamcast@small", "streamcast", 64 * 4 * 2),
+        ("geo@small", "geo", 64 * 4),
+    ]
+
+    @staticmethod
+    def _out_bytes(tr):
+        from consul_tpu.analysis.jaxlint import _aval_bytes
+
+        return sum(_aval_bytes(v.aval) for v in tr.jaxpr.outvars)
+
+    def test_trace_plane_delta_exact(self, small_traces):
+        from consul_tpu.obs import metric_count
+
+        for name, family, _cells in self.PAIRS:
+            plane = self.STEPS * metric_count(family) * 4
+            delta = (self._out_bytes(small_traces[name + "/telemetry"])
+                     - self._out_bytes(small_traces[name]))
+            assert delta == plane, (name, delta, plane)
+
+    def test_sharded_trace_plane_delta_exact(self, small_traces):
+        from consul_tpu.obs import metric_count
+
+        for d in (1, 2):
+            for model in ("broadcast", "membership", "sparse",
+                          "streamcast", "geo"):
+                name = f"sharded_{model}@small/D{d}"
+                if name not in small_traces:
+                    continue  # single-device process
+                plane = self.STEPS * metric_count(model) * 4
+                delta = (
+                    self._out_bytes(small_traces[name + "/telemetry"])
+                    - self._out_bytes(small_traces[name])
+                )
+                assert delta == plane, (name, delta, plane)
+
+    def test_peak_delta_bounded_by_plane_plus_one_temp(
+            self, small_traces):
+        from consul_tpu.obs import metric_count
+
+        for name, family, cells in self.PAIRS:
+            plane = self.STEPS * metric_count(family) * 4
+            delta = (
+                estimate_peak(small_traces[name + "/telemetry"])
+                .total_bytes
+                - estimate_peak(small_traces[name]).total_bytes
+            )
+            assert 0 <= delta <= plane + 4 * cells, (name, delta)
+
+
 class TestPeakEstimator:
     N = 4096
 
@@ -432,6 +498,21 @@ class TestRepoGate:
             assert (
                 f"sharded_streamcast@small/D{d}/ring" in small_programs
             )
+
+    def test_registry_covers_telemetry_twins(self, small_programs):
+        # The in-scan telemetry plane (consul_tpu/obs): telemetry=on
+        # twins of all seven entrypoints, of the five sharded scans at
+        # D in {1, 2} (the one-psum trace assembly), and of one
+        # batched sweep — all under every zero-findings gate below.
+        for model in ("broadcast", "membership", "sparse", "swim",
+                      "lifeguard", "streamcast", "geo"):
+            assert f"{model}@small/telemetry" in small_programs
+        for d in (1, 2):
+            for model in ("broadcast", "membership", "sparse",
+                          "streamcast", "geo"):
+                assert (f"sharded_{model}@small/D{d}/telemetry"
+                        in small_programs)
+        assert "sweep_swim@small/U8/telemetry" in small_programs
 
     def test_small_registry_zero_findings(self, small_programs,
                                           small_traces):

@@ -1,0 +1,430 @@
+"""The in-scan telemetry plane (consul_tpu/obs).
+
+Contracts pinned here, per ISSUE 10:
+
+  * telemetry=off is the EXACT current program (no retrace when the
+    flag is passed explicitly; exactly one extra program per
+    entrypoint when on) and telemetry=on is bit-equal on every
+    existing output — per model, small-n;
+  * the sharded twins emit the identical [steps, M] trace through one
+    integer psum: D == 1 bit-equal to unsharded, D == 2 == D == 1;
+  * sweeps stack the trace to [U, steps, M] for free through vmap,
+    U == 1 bit-equal to the unbatched trace;
+  * the host bridge replays a trace into telemetry.Metrics under the
+    reference metric names (go-metrics DisplayMetrics shape:
+    Labels on gauges, Stddev on samples);
+  * the XLA profile harness (obs/profile.py) reads cost_analysis /
+    memory_analysis and the trace/compile/execute wall split.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+
+from consul_tpu.geo.model import GeoConfig
+from consul_tpu.models.broadcast import BroadcastConfig
+from consul_tpu.models.lifeguard import LifeguardConfig
+from consul_tpu.models.membership import MembershipConfig
+from consul_tpu.models.membership_sparse import SparseMembershipConfig
+from consul_tpu.models.swim import SwimConfig
+from consul_tpu.obs import (
+    METRIC_SPECS,
+    bridge_report,
+    bridge_trace,
+    metric_count,
+    metric_names,
+    profile_program,
+    profile_registry,
+    sum_mask,
+)
+from consul_tpu.sim.engine import (
+    run_broadcast,
+    run_geo,
+    run_lifeguard,
+    run_membership,
+    run_membership_sparse,
+    run_streamcast,
+    run_swim,
+    run_sweep,
+)
+from consul_tpu.streamcast.model import StreamcastConfig
+from consul_tpu.sweep import Universe
+from consul_tpu.telemetry import Metrics
+
+STEPS = 8
+
+# The registry-small shapes (sim/engine.py jaxlint_registry): reusing
+# them keeps this module's compiles shared with the rest of the suite.
+BCFG = BroadcastConfig(n=64, fanout=3, delivery="edges")
+MCFG = MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),))
+SCFG = SparseMembershipConfig(base=MCFG, k_slots=8)
+SWCFG = SwimConfig(n=64, subject=1, loss=0.05)
+LGCFG = LifeguardConfig(n=64, subject=1, subject_alive=True)
+STCFG = StreamcastConfig(n=64, events=12, chunks=2, window=4, fanout=3,
+                         chunk_budget=2, rate=0.4, names=3, loss=0.05,
+                         delivery="edges")
+GECFG = GeoConfig(n=64, segments=8, bridges_per_segment=2, events=4,
+                  wan_window=4, wan_msg_bytes=100,
+                  wan_capacity_bytes=800.0, wan_queue_bytes=1600.0,
+                  ae_batch=4, loss_wan=0.05)
+
+FAMILIES = ("swim", "lifeguard", "broadcast", "membership", "sparse",
+            "streamcast", "geo")
+
+# Dedicated shapes (n=32, used nowhere else in this module) for the
+# program-identity pins: the jit cache must be COLD there — the
+# "exactly one extra program" count would read 0 if an earlier test
+# had already compiled the telemetry=on program for the shared
+# configs.
+SWCFG_ID = SwimConfig(n=32, subject=1, loss=0.05)
+BCFG_ID = BroadcastConfig(n=32, fanout=3, delivery="edges")
+
+
+def _report(out):
+    """Normalize the run_* results (sparse returns (report, overflow))."""
+    return out[0] if isinstance(out, tuple) else out
+
+
+@functools.lru_cache(maxsize=None)
+def study(family: str, telemetry: bool = False, devices: int = 0):
+    """One compiled-and-executed study per distinct program, shared
+    across every test in this module."""
+    mesh = None
+    if devices:
+        from consul_tpu.parallel import make_mesh
+
+        mesh = make_mesh(jax.devices()[:devices])
+    kw = dict(steps=STEPS, seed=0, warmup=False, telemetry=telemetry)
+    if family == "swim":
+        assert not devices
+        return run_swim(SWCFG, **kw)
+    if family == "lifeguard":
+        assert not devices
+        return run_lifeguard(LGCFG, **kw)
+    if family == "broadcast":
+        return run_broadcast(BCFG, mesh=mesh, **kw)
+    if family == "membership":
+        return run_membership(MCFG, track=(3,), mesh=mesh, **kw)
+    if family == "sparse":
+        return run_membership_sparse(SCFG, track=(3,), mesh=mesh, **kw)
+    if family == "streamcast":
+        return run_streamcast(STCFG, mesh=mesh, **kw)
+    if family == "geo":
+        return run_geo(GECFG, mesh=mesh, **kw)
+    raise AssertionError(family)
+
+
+# ---------------------------------------------------------------------------
+# The static registry.
+# ---------------------------------------------------------------------------
+
+
+class TestMetricSpecs:
+    def test_every_scan_family_registered(self):
+        assert set(METRIC_SPECS) == set(FAMILIES)
+
+    def test_names_ordered_unique_and_consul_shaped(self):
+        for family in FAMILIES:
+            names = metric_names(family)
+            assert names, family
+            assert len(set(names)) == len(names), family
+            for n in names:
+                root = n.split(".", 1)[0]
+                assert root in ("memberlist", "serf", "consul"), n
+
+    def test_issue_named_series_present(self):
+        # The four series ISSUE 10 names explicitly.
+        assert "memberlist.msg.suspect" in metric_names("swim")
+        assert "serf.queue.Event" in metric_names("broadcast")
+        assert ("consul.streamcast.window_overflow"
+                in metric_names("streamcast"))
+        assert "consul.geo.wan.admitted" in metric_names("geo")
+
+    def test_kinds_and_reduce_modes(self):
+        for family, specs in METRIC_SPECS.items():
+            assert metric_count(family) == len(specs)
+            assert len(sum_mask(family)) == len(specs)
+            for s in specs:
+                assert s.kind in ("counter", "gauge")
+                assert s.reduce in ("sum", "rep")
+
+    def test_unknown_family_rejected_loudly(self):
+        with pytest.raises(ValueError, match="no metric specs"):
+            metric_names("multidc")
+
+
+# ---------------------------------------------------------------------------
+# Bit-equality + program identity (the retrace guard).
+# ---------------------------------------------------------------------------
+
+
+def _existing_outputs(report):
+    """The pre-telemetry output arrays of a run_* report."""
+    d = {}
+    for k, v in vars(report).items():
+        if k in ("metrics_trace", "metric_names", "wall_s"):
+            continue
+        if isinstance(v, np.ndarray):
+            d[k] = v
+    return d
+
+
+class TestBitEquality:
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_telemetry_on_is_bit_equal_on_every_output(self, family):
+        off = _report(study(family, False))
+        on = _report(study(family, True))
+        outs_off = _existing_outputs(off)
+        outs_on = _existing_outputs(on)
+        assert set(outs_off) == set(outs_on) and outs_off
+        for k in outs_off:
+            assert (outs_off[k] == outs_on[k]).all(), (family, k)
+        if family == "sparse":
+            assert study(family, False)[1] == study(family, True)[1]
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_trace_shape_dtype_and_integrality(self, family):
+        rep = _report(study(family, True))
+        trace = rep.metrics_trace
+        assert trace.shape == (STEPS, metric_count(family))
+        assert trace.dtype == np.float32
+        # Every emitter reduces to an int32 count — the exactness
+        # contract the sharded psum assembly relies on.
+        assert (trace == np.round(trace)).all()
+        assert rep.metric_names == metric_names(family)
+        assert _report(study(family, False)).metrics_trace is None
+
+
+class TestProgramIdentity:
+    """telemetry is positional-static: the off call shape (flag
+    OMITTED — the run_* seams' discipline, since jit caches omitted
+    defaults and explicit positionals separately, the standing
+    kw/positional gotcha) never retraces, and telemetry=True compiles
+    exactly ONE extra program per entrypoint with reruns cached."""
+
+    CASES = [
+        ("swim_scan", "swim_scan",
+         lambda scan, st, k: scan(st, k, SWCFG_ID, STEPS)),
+        ("broadcast_scan", "broadcast_scan",
+         lambda scan, st, k: scan(st, k, BCFG_ID, STEPS)),
+    ]
+
+    @pytest.mark.parametrize("name,entry,call",
+                             CASES, ids=[c[0] for c in CASES])
+    def test_off_identity_and_one_extra_program_when_on(
+            self, name, entry, call):
+        from consul_tpu.analysis.guards import TraceGuard
+        from consul_tpu.models.broadcast import broadcast_init
+        from consul_tpu.models.swim import swim_init
+        from consul_tpu.sim import engine
+
+        scan = getattr(engine, entry)
+        init = {
+            "swim_scan": lambda: swim_init(SWCFG_ID),
+            "broadcast_scan": lambda: broadcast_init(BCFG_ID),
+        }[entry]
+        key = jax.random.PRNGKey(0)
+        call(scan, init(), key)  # the off program (may be cache-warm)
+        guard = TraceGuard(scan, max_traces=0)
+        # Repeated off calls: zero new programs — the flag's existence
+        # did not change the off program's cache identity.
+        call(scan, init(), key)
+        call(scan, init(), key)
+        guard.check()
+        on_guard = TraceGuard(scan, max_traces=1)
+        call(lambda st, k, c, s: scan(st, k, c, s, True), init(), key)
+        call(lambda st, k, c, s: scan(st, k, c, s, True), init(), key)
+        on_guard.check()
+        assert on_guard.traces == 1  # exactly one extra program
+
+
+# ---------------------------------------------------------------------------
+# Sharded twins: the one-psum trace assembly.
+# ---------------------------------------------------------------------------
+
+
+# Two families stay tier-1 (the node-plane psum case and the
+# replicated-counter case — broadcast and streamcast are the cheapest
+# compiles of each kind); the other three ride the slow tier per the
+# standing long-horizon offload policy — each parametrization compiles
+# two fresh sharded programs, and the assembly they exercise is the
+# same reduce_over_mesh path.
+SHARDED = ("broadcast", "streamcast")
+SHARDED_SLOW = ("membership", "sparse", "geo")
+
+
+class TestShardedParity:
+    @pytest.mark.parametrize("family", SHARDED)
+    def test_d1_bit_equal_and_d2_equals_d1(self, family):
+        self._check(family)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("family", SHARDED_SLOW)
+    def test_d1_bit_equal_and_d2_equals_d1_slow_tier(self, family):
+        self._check(family)
+
+    def _check(self, family):
+        un = _report(study(family, True))
+        d1 = _report(study(family, True, devices=1))
+        d2 = _report(study(family, True, devices=2))
+        assert (un.metrics_trace == d1.metrics_trace).all(), family
+        assert (d1.metrics_trace == d2.metrics_trace).all(), family
+        # The existing outputs ride along bit-equal too.
+        for k, v in _existing_outputs(un).items():
+            assert (v == _existing_outputs(d2)[k]).all(), (family, k)
+
+
+# ---------------------------------------------------------------------------
+# Sweep plane: [U, steps, M] through vmap.
+# ---------------------------------------------------------------------------
+
+
+class TestSweepTelemetry:
+    def test_u1_bit_equal_to_unbatched_trace(self):
+        u1 = Universe(entrypoint="swim", cfg=SWCFG, steps=STEPS,
+                      seeds=(0,))
+        rep = run_sweep(u1, warmup=False, telemetry=True)
+        un = study("swim", True)
+        assert rep.metrics_trace.shape == (1, STEPS,
+                                           metric_count("swim"))
+        assert (rep.metrics_trace[0] == un.metrics_trace).all()
+        assert rep.metric_names == metric_names("swim")
+
+    def test_u2_stacks_and_off_is_unchanged(self):
+        u2 = Universe(entrypoint="broadcast", cfg=BCFG, steps=STEPS,
+                      seeds=(0, 1))
+        on = run_sweep(u2, warmup=False, telemetry=True)
+        off = run_sweep(u2, warmup=False)
+        assert on.metrics_trace.shape == (2, STEPS,
+                                          metric_count("broadcast"))
+        assert off.metrics_trace is None
+        # Existing sweep metrics bit-equal with telemetry on.
+        for name, v in off.metrics.items():
+            assert (np.asarray(v) == np.asarray(on.metrics[name])).all()
+
+
+# ---------------------------------------------------------------------------
+# Host bridge: trace -> telemetry.Metrics under the reference names.
+# ---------------------------------------------------------------------------
+
+
+class TestBridge:
+    def test_counter_and_gauge_semantics(self):
+        rep = _report(study("broadcast", True))
+        sink = bridge_report("broadcast", rep, Metrics())
+        snap = sink.snapshot()
+        trace = rep.metrics_trace
+        names = metric_names("broadcast")
+        counters = {c["Name"]: c for c in snap["Counters"]}
+        gauges = {g["Name"]: g for g in snap["Gauges"]}
+        for j, spec in enumerate(METRIC_SPECS["broadcast"]):
+            col = trace[:, j]
+            if spec.kind == "counter":
+                assert counters[spec.name]["Count"] == STEPS
+                assert counters[spec.name]["Sum"] == pytest.approx(
+                    float(col.sum())
+                )
+            else:
+                assert gauges[spec.name]["Value"] == float(col[-1])
+        assert set(counters) | set(gauges) == set(names)
+
+    def test_snapshot_is_the_agent_metrics_shape(self):
+        snap = bridge_report(
+            "swim", study("swim", True), Metrics()
+        ).snapshot()
+        assert set(snap) == {"Timestamp", "Gauges", "Counters",
+                             "Samples"}
+        for g in snap["Gauges"]:
+            assert set(g) == {"Name", "Value", "Labels"}
+        for c in snap["Counters"]:
+            assert {"Name", "Count", "Sum", "Min", "Max", "Mean",
+                    "Stddev", "Labels"} <= set(c)
+
+    def test_stddev_matches_sample_formula(self):
+        m = Metrics()
+        vals = [1.0, 2.0, 4.0, 8.0]
+        for v in vals:
+            m.add_sample("x", v)
+        samples = {s["Name"]: s for s in m.snapshot()["Samples"]}
+        assert samples["x"]["Stddev"] == pytest.approx(
+            float(np.std(vals, ddof=1)), abs=1e-6
+        )
+        m2 = Metrics()
+        m2.add_sample("one", 3.0)
+        assert m2.snapshot()["Samples"][0]["Stddev"] == 0.0
+
+    def test_bad_trace_and_missing_trace_rejected_loudly(self):
+        with pytest.raises(ValueError, match="expected a"):
+            bridge_trace("swim", np.zeros((4, 3), np.float32),
+                         Metrics())
+        with pytest.raises(ValueError, match="telemetry=True"):
+            bridge_report("swim", study("swim", False), Metrics())
+        with pytest.raises(ValueError, match="no metric specs"):
+            bridge_trace("multidc", np.zeros((4, 3), np.float32),
+                         Metrics())
+
+    def test_scenario_metrics_snapshot(self):
+        # cli sim --metrics rides run_scenario(telemetry=True): the
+        # preset returns the bridged snapshot; presets without the
+        # seam reject it loudly.
+        from consul_tpu.sim.scenarios import run_scenario
+
+        out = run_scenario("dev3", telemetry=True)
+        assert out["metrics"]["Counters"] or out["metrics"]["Gauges"]
+        names = {c["Name"] for c in out["metrics"]["Counters"]}
+        assert "memberlist.gossip" in names
+        with pytest.raises(ValueError, match="--metrics"):
+            run_scenario("suspect1m", telemetry=True)
+
+
+# ---------------------------------------------------------------------------
+# XLA cost/profile harness.
+# ---------------------------------------------------------------------------
+
+
+def _tiny_registry():
+    from consul_tpu.sim.engine import jaxlint_registry
+
+    regs = jaxlint_registry(include=("small",), sharded_devices=())
+    return {"broadcast@small": regs["broadcast@small"],
+            "swim@small": regs["swim@small"]}
+
+
+class TestProfileHarness:
+    def test_cost_and_walls(self):
+        prog = _tiny_registry()["broadcast@small"]
+        p = profile_program(prog, execute=True)
+        assert p.trace_s > 0 and p.compile_s > 0
+        assert p.execute_s is not None and p.execute_s > 0
+        # CPU XLA implements both analyses; accept None only as an
+        # explicit backend gap, never a crash.
+        if p.flops is not None:
+            assert p.flops > 0
+        if p.bytes_accessed is not None:
+            assert p.bytes_accessed > 0
+        if p.output_bytes is not None:
+            assert p.output_bytes > 0
+        json_row = p.to_json()
+        assert json_row["name"] == "broadcast@small"
+
+    def test_execute_budget_skips_loudly(self):
+        profiles = profile_registry(
+            _tiny_registry(), execute=True, execute_budget_s=1e-9
+        )
+        assert profiles[0].execute_s is not None
+        assert profiles[1].execute_s is None
+        assert "exhausted" in profiles[1].execute_skipped
+
+    def test_deadline_skips_everything_loudly(self):
+        import time
+
+        profiles = profile_registry(
+            _tiny_registry(), deadline=time.monotonic() - 1.0
+        )
+        assert all(
+            p.execute_skipped == "section budget exhausted"
+            for p in profiles
+        )

@@ -27,7 +27,7 @@ PKG_ROOT = pathlib.Path(consul_tpu.__file__).resolve().parent
 LINT_TREES = [
     PKG_ROOT / "models", PKG_ROOT / "sim", PKG_ROOT / "ops",
     PKG_ROOT / "parallel", PKG_ROOT / "sweep", PKG_ROOT / "streamcast",
-    PKG_ROOT / "geo",
+    PKG_ROOT / "geo", PKG_ROOT / "obs",
 ]
 
 
@@ -458,6 +458,19 @@ class TestRepoGate:
         assert any(
             target.is_relative_to(tree) for tree in LINT_TREES
         ), "ops/ring_exchange.py left the linted trees"
+        assert lint_paths([target]) == []
+
+    def test_obs_plane_is_covered_and_clean(self):
+        # The in-scan telemetry plane (metric emitters run INSIDE
+        # every scan body; the bridge/profile halves are host code in
+        # the same tree) is traced code; pin consul_tpu/obs/ into the
+        # gate BY NAME so a tree reshuffle can't silently drop the
+        # newest traced subsystem from LINT_TREES.
+        target = PKG_ROOT / "obs"
+        assert any(
+            target == tree or target.is_relative_to(tree)
+            for tree in LINT_TREES
+        ), "consul_tpu/obs left the linted trees"
         assert lint_paths([target]) == []
 
     def test_parallel_plane_is_covered_and_clean(self):
