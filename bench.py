@@ -701,6 +701,80 @@ def main() -> None:
 
     jaxlint_peaks = section("jaxlint", _jaxlint, {})
 
+    # Program analysis (consul_tpu/analysis): wall time per static
+    # pass — tracelint (AST), jaxlint (jaxpr shapes/bytes), rangelint
+    # (jaxpr values) — over the big registry, plus the certified-
+    # narrowing table for the sparse slot planes: per plane the proven
+    # minimal dtype and the per-state-copy HBM delta at 1M AND at the
+    # 10M-node capacity target (the registry scale hook).  Abstract
+    # tracing only; the section rides BENCH_SECTION_BUDGET_S like
+    # every other.
+    def _analysis():
+        try:
+            import time as _t
+
+            from consul_tpu.analysis import rangelint as _rl
+            from consul_tpu.analysis import tracelint as _tl
+            from consul_tpu.analysis.jaxlint import analyze_jaxpr
+            from consul_tpu.sim.engine import jaxlint_registry
+
+            out = {}
+            t0 = _t.monotonic()
+            viols = _tl.lint_paths(_tl.default_paths())
+            out["tracelint_wall_s"] = round(_t.monotonic() - t0, 2)
+            out["tracelint_violations"] = len(viols)
+            programs = jaxlint_registry(include=("big",))
+            n_jl = n_rl = 0
+            t_jl = t_rl = t_tr = 0.0
+            certs_1m = {}
+            for name, spec in programs.items():
+                t0 = _t.monotonic()
+                traced = spec.trace()
+                t_tr += _t.monotonic() - t0
+                t0 = _t.monotonic()
+                found, _peak = analyze_jaxpr(
+                    name, traced, budget_bytes=16 << 30
+                )
+                n_jl += len(found)
+                t_jl += _t.monotonic() - t0
+                t0 = _t.monotonic()
+                rep = _rl.analyze_spec(name, spec, traced=traced)
+                n_rl += len(rep.findings)
+                t_rl += _t.monotonic() - t0
+                if name == "sparse@1m":
+                    certs_1m = {c.plane: c for c in rep.certificates}
+            out.update({
+                "trace_wall_s": round(t_tr, 2),
+                "jaxlint_wall_s": round(t_jl, 2),
+                "rangelint_wall_s": round(t_rl, 2),
+                "jaxlint_findings": n_jl,
+                "rangelint_findings": n_rl,
+            })
+            led = _rl.narrowing_ledger(
+                programs["sparse@1m"], 10_000_000
+            )
+            certs_10m = {c.plane: c for c in led.certificates}
+            out["rangelint_findings_at_10m"] = len(led.findings)
+            table = []
+            for plane, c in sorted(certs_1m.items()):
+                c10 = certs_10m.get(plane)
+                table.append({
+                    "plane": plane,
+                    "dtype": c.dtype,
+                    "proven_dtype": c.minimal,
+                    "range": [c.lo, c.hi],
+                    "hbm_delta_per_copy_1m": c.saved_bytes,
+                    "hbm_delta_per_copy_10m": (
+                        c10.saved_bytes if c10 else None
+                    ),
+                })
+            out["narrowing_certificates_sparse"] = table
+            return {"analysis": out}
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"analysis_error": str(e)[:200]}
+
+    analysis = section("analysis", _analysis, {})
+
     # Program-level observability (consul_tpu/obs/profile.py): lower +
     # compile every big-registry entrypoint and report what XLA says —
     # cost_analysis flops/bytes-accessed per execution and the
@@ -855,6 +929,7 @@ def main() -> None:
                     **membership,
                     **multichip,
                     **jaxlint_peaks,
+                    **analysis,
                     **observability,
                     **kv,
                 },

@@ -1,7 +1,8 @@
 """Correctness tooling for the simulation plane.
 
-Three complementary passes keep the "whole study = one XLA program"
-invariant (and its HBM budget) true as the codebase grows:
+Four complementary passes keep the "whole study = one XLA program"
+invariant (its HBM budget, and now its VALUE contracts) true as the
+codebase grows:
 
 * :mod:`consul_tpu.analysis.tracelint` — an AST-based static pass (8
   rules R1-R8) that catches trace-breaking code shapes before they
@@ -15,6 +16,12 @@ invariant (and its HBM budget) true as the codebase grows:
   shard_map collective consistency, baked constants, and a peak-HBM
   footprint estimate gated against a per-chip budget.  CLI:
   ``python -m consul_tpu.cli jaxlint``.
+* :mod:`consul_tpu.analysis.rangelint` — an interval-domain abstract
+  interpreter (rules J7-J9) over the same traced programs: proven
+  integer-overflow freedom with per-plane narrowing certificates, PRNG
+  key lineage, and loud-accounting (silent-drop) checks.  CLI:
+  ``python -m consul_tpu.cli check`` (all three passes, one merged
+  JSON) or ``python -m consul_tpu.analysis.rangelint``.
 * :mod:`consul_tpu.analysis.guards` — runtime retrace counters for the
   jitted study entrypoints, surfaced to tests as
   ``@pytest.mark.single_trace``.
@@ -48,9 +55,94 @@ _EXPORTS = {
     "estimate_peak": "jaxlint",
     "lint_programs": "jaxlint",
     "peak_bytes_report": "jaxlint",
+    "RANGELINT_RULES": "rangelint",
+    "Bound": "rangelint",
+    "NarrowingCertificate": "rangelint",
+    "RangeReport": "rangelint",
+    "analyze_program": "rangelint",
+    "analyze_spec": "rangelint",
+    "lint_registry": "rangelint",
+    "narrowing_ledger": "rangelint",
 }
 
 __all__ = sorted(_EXPORTS)
+
+
+def run_check(include=("small", "big"), budget_gb: float = 16.0,
+              paths=None) -> dict:
+    """The ``cli check`` umbrella: tracelint (AST) + jaxlint (jaxpr
+    shapes/bytes) + rangelint (jaxpr values) in one pass, tracing each
+    registry program ONCE and sharing it between the two jaxpr passes.
+
+    Returns the merged machine-readable dict (``--format json``'s
+    payload): per-pass findings, per-pass wall seconds, the jaxlint
+    peak-bytes map, the rangelint narrowing certificates, and
+    ``clean``.  Callers own the exit-code contract (nonzero on any
+    finding)."""
+    import time as _time
+
+    from consul_tpu.analysis import jaxlint as _jl
+    from consul_tpu.analysis import rangelint as _rl
+    from consul_tpu.analysis import tracelint as _tl
+
+    out: dict = {"wall_s": {}}
+
+    t0 = _time.monotonic()
+    from pathlib import Path as _Path
+
+    files: list = []
+    for p in (paths or _tl.default_paths()):
+        p = _Path(p)
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    violations = _tl.lint_paths(files)
+    out["tracelint"] = {
+        "violations": [v.to_json() for v in violations],
+        "files": len(files),
+    }
+    out["wall_s"]["tracelint"] = round(_time.monotonic() - t0, 2)
+
+    from consul_tpu.sim.engine import jaxlint_registry
+
+    programs = jaxlint_registry(include=include)
+    budget_bytes = int(budget_gb * (1 << 30))
+    jl_findings, peaks = [], {}
+    rl_findings, certs = [], {}
+    t_trace = t_jl = t_rl = 0.0
+    for name, spec in programs.items():
+        t0 = _time.monotonic()
+        traced = spec.trace()
+        t_trace += _time.monotonic() - t0
+        t0 = _time.monotonic()
+        found, peak = _jl.analyze_jaxpr(
+            name, traced,
+            budget_bytes=budget_bytes if spec.budgeted else None,
+        )
+        jl_findings.extend(found)
+        peaks[name] = peak
+        t_jl += _time.monotonic() - t0
+        t0 = _time.monotonic()
+        rep = _rl.analyze_spec(name, spec, traced=traced)
+        rl_findings.extend(rep.findings)
+        if rep.certificates:
+            certs[name] = rep.certificates
+        t_rl += _time.monotonic() - t0
+    out["jaxlint"] = {
+        "findings": [f.to_json() for f in jl_findings],
+        "programs": len(programs),
+        "peak_bytes": {n: p.chip_bytes for n, p in peaks.items()},
+    }
+    out["rangelint"] = {
+        "findings": [f.to_json() for f in rl_findings],
+        "programs": len(programs),
+        "certificates": {
+            n: [c.to_json() for c in cs] for n, cs in certs.items()
+        },
+    }
+    out["wall_s"]["trace"] = round(t_trace, 2)
+    out["wall_s"]["jaxlint"] = round(t_jl, 2)
+    out["wall_s"]["rangelint"] = round(t_rl, 2)
+    out["clean"] = not (violations or jl_findings or rl_findings)
+    return out
 
 
 def __getattr__(name):

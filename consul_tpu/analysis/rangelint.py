@@ -1,0 +1,1677 @@
+"""rangelint: interval-domain abstract interpretation over the jaxpr plane.
+
+tracelint sees the code you wrote; jaxlint sees the shapes and bytes of
+the program XLA receives; this module reasons about the *values* that
+flow through it.  Every registered simulation entrypoint
+(``sim.engine.jaxlint_registry`` — eval_shape states, make_jaxpr
+programs, zero device memory) is walked by an abstract interpreter
+whose domain is one integer/float interval per array (a scalar
+abstraction: the interval bounds every element).  Input intervals come
+from the registry's bound metadata (``SimProgram.bounds``: node ids in
+[-1, n-1], ticks in [0, steps], budgets from the config — the
+exactness-ladder contracts as numbers); ``lax.scan`` bodies run to a
+carry fixpoint with trip-count widening (see below); everything else
+is straightforward transfer functions with a dtype-range top.
+
+Rules (``--list-rules`` prints this table):
+
+  J7  integer-overflow      a signed-integer op whose exact result
+                            range (computed in unbounded integers from
+                            the derived bounds) escapes its result
+                            dtype — silent int32/int16/int8 wraparound
+                            at the declared config.  Unsigned ops are
+                            exempt: u32 wraparound is defined and the
+                            threefry/randint lowering relies on it.
+                            Dual output: a **narrowing certificate**
+                            per state plane — the minimal signed dtype
+                            that provably holds the plane's fixpoint
+                            value range, with the per-copy HBM delta
+                            (the ledger ``membership_sparse.py``'s
+                            applied CONF_DTYPE/TX_DTYPE narrowing is
+                            read from, at the declared n and at the
+                            10M-node target via ``SimProgram.scale``).
+  J8  prng-key-lineage      a PRNG key consumed by two draw sites,
+                            split twice, drawn from after being split,
+                            or carried across scan ticks unfolded while
+                            the body draws from it.  Key provenance is
+                            tracked through wrap/unwrap/split/fold_in;
+                            the salted-fold_in discipline (fold_in with
+                            a distinct literal salt alongside a split,
+                            the streamcast/sweep schedule idiom) is
+                            explicitly legal.
+  J9  loud-accounting       a masked drop/evict site inside a scan body
+                            — a droppable scatter (FILL_OR_DROP mode,
+                            indices not provably in bounds) whose index
+                            derives from a boolean mask — where NO
+                            mask-derived value reaches the scan outputs
+                            outside the scatter itself: units can
+                            vanish without a carried counter seeing
+                            them (the offered == delivered + ...
+                            identities this repo pins test-by-test,
+                            now checked structurally).
+
+The fixpoint and its widening
+-----------------------------
+
+A scan carry is iterated: ``c1 = c0 ∪ f(c0)``, ``c2 = c1 ∪ f(c1)``.
+If ``c2 == c1`` the carry converged (most planes do: clamps and
+``min``/``max`` against config budgets close the interval).  Otherwise
+the per-iteration growth ``d = c2 - c1`` is extrapolated over the
+scan's static trip count (``hi = hi(c1) + d·(length-1)``) and verified
+with one more body application: if the widened carry grows by more
+than ``d`` again (super-linear growth), it falls to the dtype top
+(unknown) rather than a wrong bound.  J7 only fires on intervals whose
+every input was *derived* (never on a dtype-range top), so precision
+loss can cost certificates but never invents findings.
+
+Provenance mirrors jaxlint: ``<program>: file:line J7 message``, with
+the equation's primitive when the source map is empty.  ``cli check``
+runs this pass alongside tracelint and jaxlint with one merged JSON
+and the shared exit-code contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import sys
+from typing import Any, Callable, Iterable, Optional
+
+from consul_tpu.analysis.jaxlint import (
+    Finding,
+    _src,
+    _sub_jaxprs,
+    format_bytes,
+)
+
+RULES: dict[str, str] = {
+    "J7": "integer-overflow: a signed-int op whose derived result range "
+          "escapes its dtype (silent wraparound); unsigned ops exempt",
+    "J8": "prng-key-lineage: a key drawn twice, split twice, drawn after "
+          "a split, or carried across ticks unfolded while drawn from",
+    "J9": "loud-accounting: a mask-gated droppable scatter in a scan "
+          "body whose mask reaches no scan output — silent unit loss",
+}
+
+# Package-level alias (tracelint owns RULES, jaxlint owns JAXLINT_RULES).
+RANGELINT_RULES = RULES
+
+_INF = float("inf")
+
+
+# ---------------------------------------------------------------------------
+# Bound metadata (the registry's input contract).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Bound:
+    """Value bound of one program-input leaf: ``Bound(lo, hi)`` claims
+    every element lies in [lo, hi]; ``Bound.any()`` claims nothing
+    (PRNG keys, planes with no derivable contract).  Bound instances
+    are pytree LEAVES, so a bounds pytree stays congruent with the
+    state pytree it describes."""
+
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+
+    @staticmethod
+    def any() -> "Bound":
+        return Bound(None, None)
+
+    @property
+    def known(self) -> bool:
+        return self.lo is not None and self.hi is not None
+
+
+# ---------------------------------------------------------------------------
+# The interval domain.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class IV:
+    """One abstract value: [lo, hi] over every element; ``known`` means
+    the interval was *derived* (bounds/constants/transfer rules), not a
+    dtype-range default — only derived intervals may raise J7."""
+
+    lo: float
+    hi: float
+    known: bool
+
+    def hull(self, other: "IV") -> "IV":
+        return IV(min(self.lo, other.lo), max(self.hi, other.hi),
+                  self.known and other.known)
+
+    def contains(self, other: "IV") -> bool:
+        return self.lo <= other.lo and other.hi <= self.hi
+
+
+def _dtype_of(v) -> Any:
+    return getattr(getattr(v, "aval", v), "dtype", None)
+
+
+def _shape_of(v) -> tuple:
+    return tuple(getattr(getattr(v, "aval", v), "shape", ()))
+
+
+def _dtype_name(d) -> str:
+    return str(d)
+
+
+def _is_key(d) -> bool:
+    return _dtype_name(d).startswith("key<")
+
+
+def _is_bool(d) -> bool:
+    return _dtype_name(d) == "bool"
+
+
+def _is_int(d) -> bool:
+    name = _dtype_name(d)
+    return name.startswith("int") or name.startswith("uint")
+
+
+def _is_signed_int(d) -> bool:
+    return _dtype_name(d).startswith("int")
+
+
+def _is_float(d) -> bool:
+    name = _dtype_name(d)
+    return name.startswith("float") or name.startswith("bfloat")
+
+
+def _int_range(d) -> tuple[int, int]:
+    import numpy as np
+
+    info = np.iinfo(_dtype_name(d))
+    return int(info.min), int(info.max)
+
+
+def _top(aval) -> IV:
+    d = _dtype_of(aval)
+    if d is None or _is_key(d):
+        return IV(-_INF, _INF, False)
+    if _is_bool(d):
+        return IV(0, 1, True)
+    if _is_int(d):
+        lo, hi = _int_range(d)
+        return IV(lo, hi, False)
+    return IV(-_INF, _INF, False)
+
+
+_SIGNED_MINIMA = ("int8", "int16", "int32")
+
+
+def minimal_signed_dtype(lo: float, hi: float) -> Optional[str]:
+    """Smallest signed dtype holding [lo, hi], None past int32."""
+    import numpy as np
+
+    for name in _SIGNED_MINIMA:
+        info = np.iinfo(name)
+        if info.min <= lo and hi <= info.max:
+            return name
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class NarrowingCertificate:
+    """J7's dual output for one state plane: the proven fixpoint value
+    range, the minimal safe signed dtype, and the per-state-copy HBM
+    delta narrowing it would buy (elements × itemsize delta — the J6
+    carry/peak currency)."""
+
+    program: str
+    plane: str
+    dtype: str
+    lo: int
+    hi: int
+    minimal: str
+    elements: int
+    bytes_now: int
+    bytes_minimal: int
+
+    @property
+    def saved_bytes(self) -> int:
+        return self.bytes_now - self.bytes_minimal
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["saved_bytes"] = self.saved_bytes
+        return out
+
+
+@dataclasses.dataclass
+class RangeReport:
+    findings: list
+    certificates: list
+
+
+# ---------------------------------------------------------------------------
+# Abstract values carried per jaxpr var.
+# ---------------------------------------------------------------------------
+
+
+class AV:
+    """Interval + provenance for one var: ``origin`` is the program
+    input-leaf index the value IS (identity through call boundaries
+    only), ``token`` the PRNG-key lineage node."""
+
+    __slots__ = ("iv", "origin", "token")
+
+    def __init__(self, iv: IV, origin: Optional[int] = None, token=None):
+        self.iv = iv
+        self.origin = origin
+        self.token = token
+
+
+class _Token:
+    """A PRNG key lineage node."""
+
+    __slots__ = ("id", "desc")
+    _next = [0]
+
+    def __init__(self, desc: str):
+        self.id = _Token._next[0]
+        _Token._next[0] += 1
+        self.desc = desc
+
+
+class _Frame:
+    """One jaxpr evaluation frame: env + def-sites, retained for the
+    J9 walk of scan bodies."""
+
+    def __init__(self, jaxpr):
+        self.jaxpr = jaxpr
+        self.env: dict = {}
+        self.def_eqn: dict = {}
+        self.children: list = []  # (eqn, _Frame)
+
+
+def _lit_iv(val) -> IV:
+    import numpy as np
+
+    try:
+        arr = np.asarray(val)
+        if arr.dtype == bool:
+            return IV(float(arr.min()), float(arr.max()), True)
+        if arr.dtype.kind in "iu":
+            return IV(int(arr.min()), int(arr.max()), True)
+        if arr.dtype.kind == "f":
+            if arr.size and np.all(np.isfinite(arr)):
+                return IV(float(arr.min()), float(arr.max()), True)
+            return IV(-_INF, _INF, False)
+    except (TypeError, ValueError):
+        pass
+    return IV(-_INF, _INF, False)
+
+
+def _tdiv(a: float, b: float) -> float:
+    """Truncating integer division (XLA div semantics)."""
+    if b == 0:
+        return 0
+    if a == -_INF or a == _INF or b in (-_INF, _INF):
+        return 0 if b in (-_INF, _INF) else math.copysign(_INF, a * b)
+    q = abs(int(a)) // abs(int(b))
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+# ---------------------------------------------------------------------------
+# The interpreter.
+# ---------------------------------------------------------------------------
+
+_SCAN_FIX_ITERS = 2
+_DRAW_PRIMS = frozenset({"random_bits", "threefry2x32"})
+_SHAPE_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims", "transpose",
+    "rev", "copy", "real", "imag", "stop_gradient", "reduce_precision",
+    "optimization_barrier",
+})
+_PASS_COLLECTIVES = frozenset({
+    "pmax", "pmin", "all_gather", "all_to_all", "ppermute", "pshuffle",
+})
+
+
+class _Interp:
+    def __init__(self, program: str, rules: frozenset[str]):
+        self.program = program
+        self.rules = rules
+        self.findings: list[Finding] = []
+        # ``noisy`` gates J7 reports; flags are sound in EVERY pass
+        # (interval transfer is monotone: an under-approximate entry
+        # that overflows implies the true entry overflows), deduped by
+        # site.  ``record`` gates J8 token uses and J9 scatter notes to
+        # the single final pass per scan body.
+        self.noisy = True
+        self.record = True
+        self.saturate = False
+        self.scan_depth = 0
+        self.axis_sizes: dict = {}
+        # J8: token -> {"draw": [where...], "split": [...], "fold": [...]}
+        self.token_uses: dict = {}
+        self.split_children: dict = {}   # (split token id, start) -> token
+        self.fold_children: dict = {}    # (token id, salt) -> token
+        # J7 certificates: origin index -> entry-fixpoint IV.
+        self.carry_fix: dict[int, IV] = {}
+        self._flagged: set = set()
+
+    # -- reporting --------------------------------------------------------
+
+    def report(self, eqn, rule: str, message: str) -> None:
+        if rule not in self.rules or not self.noisy:
+            return
+        where = _src(eqn) if eqn is not None else ""
+        prim = getattr(getattr(eqn, "primitive", None), "name", "")
+        key = (rule, where, prim)
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        self.findings.append(
+            Finding(self.program, rule, message, where=where)
+        )
+
+    def _settle(self, eqn, iv: IV, outvar) -> IV:
+        """Clamp an exact-arithmetic result to its dtype, flagging J7
+        on proven signed escape.  Under ``saturate`` (the widening
+        verification mode) escapes clamp WITHOUT poisoning ``known`` —
+        the verify pass models saturating semantics to find the
+        tightest wrap-free invariant, and the final exact pass then
+        flags any op that still escapes from it."""
+        d = _dtype_of(outvar)
+        if d is None or not _is_int(d):
+            return iv
+        lo_d, hi_d = _int_range(d)
+        if iv.known and (iv.lo < lo_d or iv.hi > hi_d):
+            if self.saturate:
+                return IV(max(iv.lo, lo_d), min(iv.hi, hi_d), True)
+            if _is_signed_int(d) and eqn is not None:
+                self.report(
+                    eqn, "J7",
+                    f"{eqn.primitive.name} result range "
+                    f"[{int(iv.lo)}, {int(iv.hi)}] escapes "
+                    f"{_dtype_name(d)} [{lo_d}, {hi_d}] — silent "
+                    "wraparound (widen the plane, clamp the operand, or "
+                    "restructure the expression)",
+                )
+            return IV(lo_d, hi_d, False)
+        return IV(max(iv.lo, lo_d), min(iv.hi, hi_d), iv.known)
+
+    def record_use(self, token, kind: str, eqn) -> None:
+        if token is None or not self.record:
+            return
+        self.token_uses.setdefault(token, {}).setdefault(kind, []).append(
+            (eqn, self.scan_depth)
+        )
+
+    # -- frame evaluation -------------------------------------------------
+
+    def read(self, frame: _Frame, v) -> AV:
+        if hasattr(v, "val"):  # Literal
+            return AV(_lit_iv(v.val))
+        av = frame.env.get(v)
+        if av is None:
+            av = AV(_top(v))
+            frame.env[v] = av
+        return av
+
+    def write(self, frame: _Frame, v, av: AV, eqn=None) -> None:
+        frame.env[v] = av
+        if eqn is not None:
+            frame.def_eqn[v] = eqn
+
+    def eval_jaxpr(self, jaxpr, consts,
+                   in_avs: list[AV]) -> tuple[list[AV], _Frame]:
+        frame = _Frame(jaxpr)
+        for v, c in zip(jaxpr.constvars, consts):
+            self.write(frame, v, AV(_lit_iv(c)))
+        for v, av in zip(jaxpr.invars, in_avs):
+            # Intersect the handed-in interval with the var's dtype
+            # range (call boundaries may narrow dtypes).
+            top = _top(v)
+            iv = av.iv
+            if _is_int(_dtype_of(v) or 0) and iv.known:
+                iv = IV(max(iv.lo, top.lo), min(iv.hi, top.hi), True)
+            elif not iv.known:
+                iv = top
+            self.write(frame, v, AV(iv, av.origin, av.token))
+        for eqn in jaxpr.eqns:
+            try:
+                outs = self.eval_eqn(frame, eqn)
+            except Exception:  # pragma: no cover - analysis must not die
+                outs = [AV(_top(o)) for o in eqn.outvars]
+            for o, av in zip(eqn.outvars, outs):
+                if type(o).__name__ != "DropVar":
+                    self.write(frame, o, av, eqn)
+        outs = [self.read(frame, v) for v in jaxpr.outvars]
+        return outs, frame
+
+    # -- equation dispatch ------------------------------------------------
+
+    def eval_eqn(self, frame: _Frame, eqn) -> list[AV]:
+        prim = eqn.primitive.name
+        ins = [self.read(frame, v) for v in eqn.invars]
+        handler = getattr(self, "_p_" + prim.replace("-", "_"), None)
+        if handler is not None:
+            return handler(frame, eqn, ins)
+        if prim in _SHAPE_PRIMS:
+            a = ins[0]
+            return [AV(a.iv, a.origin, a.token) for _ in eqn.outvars]
+        if prim in _PASS_COLLECTIVES:
+            return [AV(ins[0].iv) for _ in eqn.outvars]
+        if prim in _DRAW_PRIMS:
+            for a in ins:
+                self.record_use(a.token, "draw", eqn)
+            return [AV(_top(o)) for o in eqn.outvars]
+        subs = _sub_jaxprs(eqn)
+        if subs and prim in ("pjit", "closed_call", "core_call",
+                            "custom_jvp_call", "custom_vjp_call",
+                            "remat", "checkpoint", "custom_vmap_call"):
+            name, sub, consts = subs[0]
+            outs, child = self.eval_jaxpr(
+                sub, consts, ins[:len(sub.invars)]
+            )
+            frame.children.append((eqn, child))
+            outs = outs[:len(eqn.outvars)]
+            outs += [AV(_top(o)) for o in eqn.outvars[len(outs):]]
+            return [
+                AV(self._settle(None, av.iv, o), av.origin, av.token)
+                for av, o in zip(outs, eqn.outvars)
+            ]
+        if prim == "scan":
+            return self._eval_scan(frame, eqn, ins)
+        if prim == "while":
+            return self._eval_while(frame, eqn, ins)
+        if prim in ("cond", "switch"):
+            return self._eval_cond(frame, eqn, ins)
+        if prim == "shard_map":
+            return self._eval_shard_map(frame, eqn, ins)
+        if prim == "pallas_call":
+            return [AV(_top(o)) for o in eqn.outvars]
+        return [AV(_top(o)) for o in eqn.outvars]
+
+    # -- arithmetic -------------------------------------------------------
+
+    def _binop(self, frame, eqn, ins, f) -> list[AV]:
+        a, b = ins[0].iv, ins[1].iv
+        if not (a.known and b.known):
+            return [AV(_top(eqn.outvars[0]))]
+        cands = [f(x, y) for x in (a.lo, a.hi) for y in (b.lo, b.hi)]
+        iv = IV(min(cands), max(cands), True)
+        return [AV(self._settle(eqn, iv, eqn.outvars[0]))]
+
+    def _p_add(self, frame, eqn, ins):
+        return self._binop(frame, eqn, ins, lambda x, y: x + y)
+
+    def _p_sub(self, frame, eqn, ins):
+        return self._binop(frame, eqn, ins, lambda x, y: x - y)
+
+    def _p_mul(self, frame, eqn, ins):
+        return self._binop(frame, eqn, ins, lambda x, y: x * y)
+
+    def _p_max(self, frame, eqn, ins):
+        a, b = ins[0].iv, ins[1].iv
+        iv = IV(max(a.lo, b.lo), max(a.hi, b.hi), a.known and b.known)
+        return [AV(self._settle(None, iv, eqn.outvars[0]))]
+
+    def _p_min(self, frame, eqn, ins):
+        a, b = ins[0].iv, ins[1].iv
+        iv = IV(min(a.lo, b.lo), min(a.hi, b.hi), a.known and b.known)
+        return [AV(self._settle(None, iv, eqn.outvars[0]))]
+
+    def _p_div(self, frame, eqn, ins):
+        a, b = ins[0].iv, ins[1].iv
+        d = _dtype_of(eqn.outvars[0])
+        if not (a.known and b.known) or (b.lo <= 0 <= b.hi):
+            return [AV(_top(eqn.outvars[0]))]
+        if _is_int(d):
+            return self._binop(frame, eqn, ins, _tdiv)
+        return self._binop(
+            frame, eqn, ins, lambda x, y: x / y if y else 0.0
+        )
+
+    def _p_rem(self, frame, eqn, ins):
+        a, b = ins[0].iv, ins[1].iv
+        out = eqn.outvars[0]
+        if not b.known or b.lo <= 0:
+            return [AV(_top(out))]
+        hi = b.hi - 1 if _is_int(_dtype_of(out)) else b.hi
+        if a.known and a.lo >= 0:
+            return [AV(IV(0, min(a.hi, hi), True))]
+        return [AV(IV(-hi, hi, a.known))]
+
+    def _p_neg(self, frame, eqn, ins):
+        a = ins[0].iv
+        iv = IV(-a.hi, -a.lo, a.known)
+        return [AV(self._settle(eqn, iv, eqn.outvars[0]))]
+
+    def _p_abs(self, frame, eqn, ins):
+        a = ins[0].iv
+        lo = 0.0 if a.lo <= 0 <= a.hi else min(abs(a.lo), abs(a.hi))
+        iv = IV(lo, max(abs(a.lo), abs(a.hi)), a.known)
+        return [AV(self._settle(eqn, iv, eqn.outvars[0]))]
+
+    def _p_sign(self, frame, eqn, ins):
+        return [AV(IV(-1, 1, True))]
+
+    def _p_clamp(self, frame, eqn, ins):
+        # clamp(a, x, b) = min(max(x, a), b): each bound is the
+        # monotone composition at that endpoint — in particular the
+        # result's LOWER bound caps at b.lo (an element whose cap is
+        # b.lo can be pulled down to it), never b.hi.
+        lo_b, x, hi_b = ins[0].iv, ins[1].iv, ins[2].iv
+        lo = min(max(x.lo, lo_b.lo), hi_b.lo)
+        hi = min(max(x.hi, lo_b.hi), hi_b.hi)
+        known = x.known and lo_b.known and hi_b.known
+        return [AV(IV(min(lo, hi), max(lo, hi), known))]
+
+    def _p_integer_pow(self, frame, eqn, ins):
+        a = ins[0].iv
+        y = eqn.params.get("y", 2)
+        if not a.known or y < 0:
+            return [AV(_top(eqn.outvars[0]))]
+        cands = [a.lo ** y, a.hi ** y]
+        if a.lo <= 0 <= a.hi:
+            cands.append(0)
+        iv = IV(min(cands), max(cands), True)
+        return [AV(self._settle(eqn, iv, eqn.outvars[0]))]
+
+    def _p_shift_left(self, frame, eqn, ins):
+        a, s = ins[0].iv, ins[1].iv
+        if not (a.known and s.known) or s.lo < 0 or s.hi > 63:
+            return [AV(_top(eqn.outvars[0]))]
+        cands = [int(x) << int(t) for x in (a.lo, a.hi)
+                 for t in (s.lo, s.hi)]
+        iv = IV(min(cands), max(cands), True)
+        return [AV(self._settle(eqn, iv, eqn.outvars[0]))]
+
+    def _p_shift_right_arithmetic(self, frame, eqn, ins):
+        a, s = ins[0].iv, ins[1].iv
+        if not (a.known and s.known) or s.lo < 0 or s.hi > 63:
+            return [AV(_top(eqn.outvars[0]))]
+        cands = [int(x) >> int(t) for x in (a.lo, a.hi)
+                 for t in (s.lo, s.hi)]
+        return [AV(IV(min(cands), max(cands), True))]
+
+    def _p_shift_right_logical(self, frame, eqn, ins):
+        a, s = ins[0].iv, ins[1].iv
+        if a.known and a.lo >= 0 and s.known and 0 <= s.lo <= s.hi <= 63:
+            cands = [int(x) >> int(t) for x in (a.lo, a.hi)
+                     for t in (s.lo, s.hi)]
+            return [AV(IV(min(cands), max(cands), True))]
+        return [AV(_top(eqn.outvars[0]))]
+
+    def _bitwise(self, frame, eqn, ins, op: str) -> list[AV]:
+        out = eqn.outvars[0]
+        if _is_bool(_dtype_of(out)):
+            return [AV(IV(0, 1, True))]
+        a, b = ins[0].iv, ins[1].iv
+        # Two's-complement masking: x & m with a known non-negative m
+        # lands in [0, m] regardless of x's sign.
+        if op == "and":
+            for m, other in ((b, a), (a, b)):
+                if m.known and m.lo >= 0:
+                    if other.known and other.lo >= 0:
+                        return [AV(IV(0, min(m.hi, other.hi), True))]
+                    return [AV(IV(0, m.hi, True))]
+            return [AV(_top(out))]
+        if a.known and b.known and a.lo >= 0 and b.lo >= 0:
+            bits = max(int(a.hi).bit_length(), int(b.hi).bit_length())
+            return [AV(IV(0, (1 << bits) - 1, True))]
+        return [AV(_top(out))]
+
+    def _p_and(self, frame, eqn, ins):
+        return self._bitwise(frame, eqn, ins, "and")
+
+    def _p_or(self, frame, eqn, ins):
+        return self._bitwise(frame, eqn, ins, "or")
+
+    def _p_xor(self, frame, eqn, ins):
+        return self._bitwise(frame, eqn, ins, "xor")
+
+    def _p_not(self, frame, eqn, ins):
+        out = eqn.outvars[0]
+        if _is_bool(_dtype_of(out)):
+            return [AV(IV(0, 1, True))]
+        return [AV(_top(out))]
+
+    def _p_convert_element_type(self, frame, eqn, ins):
+        a = ins[0].iv
+        out = eqn.outvars[0]
+        d_out = _dtype_of(out)
+        d_in = _dtype_of(eqn.invars[0])
+        if _is_key(d_out) or d_in is None or _is_key(d_in):
+            return [AV(_top(out))]
+        if not a.known:
+            return [AV(_top(out), ins[0].origin, ins[0].token)]
+        if _is_float(d_in) and _is_int(d_out):
+            if a.lo == -_INF or a.hi == _INF:
+                return [AV(_top(out))]
+            iv = IV(math.floor(a.lo), math.ceil(a.hi), True)
+        else:
+            iv = a
+        return [AV(self._settle(eqn, iv, out), ins[0].origin,
+                   ins[0].token)]
+
+    # -- comparisons / selection -----------------------------------------
+
+    def _cmp(self, frame, eqn, ins):
+        return [AV(IV(0, 1, True))]
+
+    _p_eq = _p_ne = _p_lt = _p_le = _p_gt = _p_ge = _cmp
+    _p_is_finite = _cmp
+
+    def _p_select_n(self, frame, eqn, ins):
+        cases = ins[1:]
+        # Decidable predicate refinement: ``x % d`` lowers to
+        # ``select_n(r < 0, r + d, r)`` — when the comparison is
+        # decidable from the operand intervals, only the taken branch
+        # contributes (select_n picks case[int(pred)]: case 0 on
+        # False).
+        decided = self._decide_pred(frame, eqn.invars[0])
+        if decided is not None and len(cases) == 2:
+            chosen = cases[1] if decided else cases[0]
+            return [AV(chosen.iv, None, chosen.token)]
+        floormod = self._floor_mod_iv(frame, eqn)
+        if floormod is not None:
+            return [AV(floormod)]
+        iv = cases[0].iv
+        for c in cases[1:]:
+            iv = iv.hull(c.iv)
+        token = None
+        tokens = {id(c.token) for c in cases if c.token is not None}
+        if len(tokens) == 1:
+            token = next(c.token for c in cases if c.token is not None)
+        return [AV(iv, None, token)]
+
+    def _floor_mod_iv(self, frame, eqn) -> Optional[IV]:
+        """Recognize jnp.remainder's sign-fixup lowering —
+        ``select_n(fixup, rem(x, y), rem(x, y) + y)`` with a known
+        positive divisor — whose result is the floor-mod in
+        [0, y - 1] regardless of the dividend (the ring-buffer index
+        idiom ``t % L``)."""
+        if len(eqn.invars) != 3:
+            return None
+        case0, case1 = eqn.invars[1], eqn.invars[2]
+        if hasattr(case0, "val") or hasattr(case1, "val"):
+            return None
+        d0 = frame.def_eqn.get(case0)
+        if d0 is None or d0.primitive.name != "rem":
+            return None
+        div = self.read(frame, d0.invars[1]).iv
+        if not (div.known and div.lo > 0):
+            return None
+        d1 = frame.def_eqn.get(case1)
+        if d1 is None or d1.primitive.name != "add":
+            return None
+        operands = list(d1.invars)
+        if case0 not in operands:
+            return None
+        other = operands[1] if operands[0] is case0 else operands[0]
+        o_iv = self.read(frame, other).iv
+        if o_iv.known and o_iv.lo == div.lo and o_iv.hi == div.hi:
+            return IV(0, div.hi - 1, True)
+        return None
+
+    def _decide_pred(self, frame, pred_var, depth: int = 0
+                     ) -> Optional[bool]:
+        """True/False when a bool predicate is decided by its defining
+        comparison tree's intervals, else None.  Walks and/or/not/xor
+        compositions (the ``remainder`` sign-fixup lowering) to a small
+        depth."""
+        if depth > 6:
+            return None
+        if hasattr(pred_var, "val"):
+            try:
+                import numpy as np
+
+                arr = np.asarray(pred_var.val)
+                if arr.dtype == bool and arr.size and (
+                    arr.min() == arr.max()
+                ):
+                    return bool(arr.min())
+            except (TypeError, ValueError):
+                return None
+            return None
+        eqn = frame.def_eqn.get(pred_var)
+        if eqn is None:
+            return None
+        prim = eqn.primitive.name
+        if prim in ("broadcast_in_dim", "reshape", "squeeze",
+                    "convert_element_type"):
+            return self._decide_pred(frame, eqn.invars[0], depth + 1)
+        if prim in ("and", "or", "xor"):
+            a = self._decide_pred(frame, eqn.invars[0], depth + 1)
+            b = self._decide_pred(frame, eqn.invars[1], depth + 1)
+            if prim == "and":
+                if a is False or b is False:
+                    return False
+                if a is True and b is True:
+                    return True
+                return None
+            if prim == "or":
+                if a is True or b is True:
+                    return True
+                if a is False and b is False:
+                    return False
+                return None
+            if a is None or b is None:
+                return None
+            return a != b
+        if prim == "not":
+            a = self._decide_pred(frame, eqn.invars[0], depth + 1)
+            return None if a is None else not a
+        if prim not in ("lt", "le", "gt", "ge", "eq", "ne"):
+            return None
+        if prim in ("eq", "ne") and all(
+            _is_bool(_dtype_of(v) or 0) or hasattr(v, "val")
+            for v in eqn.invars
+        ):
+            # bool != bool (the remainder sign-mismatch test): decide
+            # each side as a predicate.
+            a = self._decide_pred(frame, eqn.invars[0], depth + 1)
+            b = self._decide_pred(frame, eqn.invars[1], depth + 1)
+            if a is not None and b is not None:
+                return (a != b) if prim == "ne" else (a == b)
+            return None
+        x = self.read(frame, eqn.invars[0]).iv
+        y = self.read(frame, eqn.invars[1]).iv
+        if not (x.known and y.known):
+            return None
+        if prim == "lt":
+            if x.hi < y.lo:
+                return True
+            if x.lo >= y.hi:
+                return False
+        elif prim == "le":
+            if x.hi <= y.lo:
+                return True
+            if x.lo > y.hi:
+                return False
+        elif prim == "gt":
+            if x.lo > y.hi:
+                return True
+            if x.hi <= y.lo:
+                return False
+        elif prim == "ge":
+            if x.lo >= y.hi:
+                return True
+            if x.hi < y.lo:
+                return False
+        elif prim == "eq":
+            if x.hi < y.lo or y.hi < x.lo:
+                return False
+            if x.lo == x.hi == y.lo == y.hi:
+                return True
+        elif prim == "ne":
+            if x.hi < y.lo or y.hi < x.lo:
+                return True
+            if x.lo == x.hi == y.lo == y.hi:
+                return False
+        return None
+
+    # -- structure --------------------------------------------------------
+
+    def _p_concatenate(self, frame, eqn, ins):
+        iv = ins[0].iv
+        for a in ins[1:]:
+            iv = iv.hull(a.iv)
+        return [AV(iv)]
+
+    def _p_pad(self, frame, eqn, ins):
+        return [AV(ins[0].iv.hull(ins[1].iv))]
+
+    def _p_iota(self, frame, eqn, ins):
+        shape = _shape_of(eqn.outvars[0])
+        dim = eqn.params.get("dimension", 0)
+        hi = (shape[dim] - 1) if shape else 0
+        return [AV(IV(0, max(hi, 0), True))]
+
+    def _p_slice(self, frame, eqn, ins):
+        a = ins[0]
+        token = a.token
+        if token is not None and getattr(token, "desc", "") == "split":
+            starts = tuple(eqn.params.get("start_indices", ()))
+            key = (token.id, starts)
+            child = self.split_children.get(key)
+            if child is None:
+                child = _Token("child")
+                self.split_children[key] = child
+            token = child
+        return [AV(a.iv, None, token)]
+
+    def _p_dynamic_slice(self, frame, eqn, ins):
+        a = ins[0]
+        token = a.token
+        if token is not None and getattr(token, "desc", "") == "split":
+            token = _Token("child")  # traced index: assume fresh child
+        return [AV(a.iv, None, token)]
+
+    def _p_dynamic_update_slice(self, frame, eqn, ins):
+        return [AV(ins[0].iv.hull(ins[1].iv))]
+
+    def _p_gather(self, frame, eqn, ins):
+        iv = ins[0].iv
+        mode = str(eqn.params.get("mode", ""))
+        if "FILL" in mode or "DROP" in mode:
+            iv = iv.hull(IV(0, 0, True))
+        return [AV(iv, None, ins[0].token)]
+
+    def _p_sort(self, frame, eqn, ins):
+        return [AV(a.iv) for a in ins]
+
+    def _p_top_k(self, frame, eqn, ins):
+        shape = _shape_of(eqn.invars[0])
+        hi = (shape[-1] - 1) if shape else 0
+        return [AV(ins[0].iv), AV(IV(0, max(hi, 0), True))]
+
+    def _p_argmax(self, frame, eqn, ins):
+        shape = _shape_of(eqn.invars[0])
+        axes = eqn.params.get("axes", (len(shape) - 1,))
+        hi = 1
+        for a in axes:
+            hi *= shape[a]
+        return [AV(IV(0, max(hi - 1, 0), True))]
+
+    _p_argmin = _p_argmax
+
+    # -- reductions -------------------------------------------------------
+
+    def _reduced_count(self, eqn) -> int:
+        shape = _shape_of(eqn.invars[0])
+        axes = eqn.params.get("axes", ())
+        count = 1
+        for a in axes:
+            count *= shape[a]
+        return max(count, 1)
+
+    def _p_reduce_sum(self, frame, eqn, ins):
+        a = ins[0].iv
+        if not a.known:
+            return [AV(_top(eqn.outvars[0]))]
+        m = self._reduced_count(eqn)
+        iv = IV(min(a.lo * m, a.lo), max(a.hi * m, a.hi), True)
+        return [AV(self._settle(eqn, iv, eqn.outvars[0]))]
+
+    def _p_reduce_max(self, frame, eqn, ins):
+        return [AV(ins[0].iv)]
+
+    _p_reduce_min = _p_reduce_max
+
+    def _p_reduce_and(self, frame, eqn, ins):
+        return [AV(IV(0, 1, True))]
+
+    _p_reduce_or = _p_reduce_and
+
+    def _p_reduce_prod(self, frame, eqn, ins):
+        return [AV(_top(eqn.outvars[0]))]
+
+    def _p_cumsum(self, frame, eqn, ins):
+        a = ins[0].iv
+        out = eqn.outvars[0]
+        if not a.known:
+            return [AV(_top(out))]
+        shape = _shape_of(eqn.invars[0])
+        axis = eqn.params.get("axis", 0)
+        m = shape[axis] if shape else 1
+        iv = IV(min(a.lo * m, a.lo), max(a.hi * m, a.hi), True)
+        return [AV(self._settle(eqn, iv, out))]
+
+    def _p_cummax(self, frame, eqn, ins):
+        return [AV(ins[0].iv)]
+
+    _p_cummin = _p_cummax
+
+    def _p_dot_general(self, frame, eqn, ins):
+        a, b = ins[0].iv, ins[1].iv
+        out = eqn.outvars[0]
+        if not (a.known and b.known):
+            return [AV(_top(out))]
+        dims = eqn.params.get("dimension_numbers")
+        shape = _shape_of(eqn.invars[0])
+        k = 1
+        try:
+            for ax in dims[0][0]:
+                k *= shape[ax]
+        except Exception:
+            k = 1
+        cands = [x * y for x in (a.lo, a.hi) for y in (b.lo, b.hi)]
+        iv = IV(min(cands) * k, max(cands) * k, True)
+        iv = IV(min(iv.lo, iv.hi), max(iv.lo, iv.hi), True)
+        return [AV(self._settle(eqn, iv, out))]
+
+    # -- scatter family ---------------------------------------------------
+
+    def _scatter_common(self, frame, eqn, ins, combine: str) -> list[AV]:
+        op, idx, upd = ins[0].iv, ins[1], ins[2].iv
+        out = eqn.outvars[0]
+        if combine == "set":
+            iv = op.hull(upd)
+        elif combine in ("max", "min"):
+            iv = op.hull(upd)
+        elif combine == "add":
+            if op.known and upd.known:
+                if eqn.params.get("unique_indices"):
+                    # One update per cell by contract.
+                    n_upd = 1
+                else:
+                    n_upd = 1
+                    for dsz in _shape_of(eqn.invars[2]):
+                        n_upd *= dsz
+                iv = IV(op.lo + n_upd * min(upd.lo, 0),
+                        op.hi + n_upd * max(upd.hi, 0), True)
+                iv = self._settle(eqn, iv, out)
+            else:
+                iv = _top(out)
+        else:
+            iv = _top(out)
+        if self.scan_depth > 0 and self.record:
+            self._note_scatter(frame, eqn, ins)
+        return [AV(iv)]
+
+    def _p_scatter(self, frame, eqn, ins):
+        return self._scatter_common(frame, eqn, ins, "set")
+
+    def _p_scatter_add(self, frame, eqn, ins):
+        return self._scatter_common(frame, eqn, ins, "add")
+
+    def _p_scatter_max(self, frame, eqn, ins):
+        return self._scatter_common(frame, eqn, ins, "max")
+
+    def _p_scatter_min(self, frame, eqn, ins):
+        return self._scatter_common(frame, eqn, ins, "min")
+
+    def _p_scatter_mul(self, frame, eqn, ins):
+        return self._scatter_common(frame, eqn, ins, "mul")
+
+    def _note_scatter(self, frame, eqn, ins) -> None:
+        """Queue a scatter for the J9 walk of the enclosing scan body."""
+        frame.children.append((eqn, None))
+
+    # -- randomness -------------------------------------------------------
+
+    def _p_random_wrap(self, frame, eqn, ins):
+        a = ins[0]
+        token = a.token
+        if token is None:
+            token = _Token("wrap")
+        return [AV(_top(eqn.outvars[0]), None, token)]
+
+    def _p_random_unwrap(self, frame, eqn, ins):
+        return [AV(_top(eqn.outvars[0]), None, ins[0].token)]
+
+    def _p_random_seed(self, frame, eqn, ins):
+        return [AV(_top(eqn.outvars[0]), None, _Token("seed"))]
+
+    def _p_random_split(self, frame, eqn, ins):
+        self.record_use(ins[0].token, "split", eqn)
+        return [AV(_top(eqn.outvars[0]), None, _Token("split"))]
+
+    def _p_random_fold_in(self, frame, eqn, ins):
+        parent = ins[0].token
+        salt_v = eqn.invars[1]
+        salt = None
+        if hasattr(salt_v, "val"):
+            try:
+                salt = int(salt_v.val)
+            except (TypeError, ValueError):
+                salt = None
+        self.record_use(parent, "fold", eqn)
+        if parent is not None and salt is not None:
+            key = (parent.id, salt)
+            child = self.fold_children.get(key)
+            if child is None:
+                child = _Token("fold")
+                self.fold_children[key] = child
+            return [AV(_top(eqn.outvars[0]), None, child)]
+        return [AV(_top(eqn.outvars[0]), None, _Token("fold"))]
+
+    def _p_random_bits(self, frame, eqn, ins):
+        self.record_use(ins[0].token, "draw", eqn)
+        return [AV(_top(eqn.outvars[0]))]
+
+    def _p_threefry2x32(self, frame, eqn, ins):
+        for a in ins:
+            self.record_use(a.token, "draw", eqn)
+        return [AV(_top(o)) for o in eqn.outvars]
+
+    # -- collectives ------------------------------------------------------
+
+    def _p_psum(self, frame, eqn, ins):
+        names = eqn.params.get("axes", ()) or ()
+        size = 1
+        for nm in names if isinstance(names, (tuple, list)) else (names,):
+            if isinstance(nm, str):
+                size *= self.axis_sizes.get(nm, 1)
+        outs = []
+        for a, o in zip(ins, eqn.outvars):
+            if a.iv.known:
+                iv = IV(min(a.iv.lo * size, a.iv.lo),
+                        max(a.iv.hi * size, a.iv.hi), True)
+                outs.append(AV(self._settle(eqn, iv, o)))
+            else:
+                outs.append(AV(_top(o)))
+        return outs
+
+    def _p_axis_index(self, frame, eqn, ins):
+        name = eqn.params.get("axis_name")
+        size = self.axis_sizes.get(name, None)
+        if size is None:
+            return [AV(_top(eqn.outvars[0]))]
+        return [AV(IV(0, size - 1, True))]
+
+    # -- control flow -----------------------------------------------------
+
+    def _eval_shard_map(self, frame, eqn, ins):
+        subs = _sub_jaxprs(eqn)
+        if not subs:
+            return [AV(_top(o)) for o in eqn.outvars]
+        mesh = eqn.params.get("mesh")
+        saved = dict(self.axis_sizes)
+        if mesh is not None:
+            self.axis_sizes.update(dict(getattr(mesh, "shape", {})))
+        name, sub, consts = subs[0]
+        outs, child = self.eval_jaxpr(sub, consts, ins[:len(sub.invars)])
+        frame.children.append((eqn, child))
+        self.axis_sizes = saved
+        outs = outs[:len(eqn.outvars)]
+        outs += [AV(_top(o)) for o in eqn.outvars[len(outs):]]
+        return [AV(av.iv) for av in outs]
+
+    def _eval_cond(self, frame, eqn, ins):
+        subs = _sub_jaxprs(eqn)
+        ops = ins[1:]
+        merged: Optional[list[AV]] = None
+        for name, sub, consts in subs:
+            outs, child = self.eval_jaxpr(sub, consts,
+                                          ops[:len(sub.invars)])
+            frame.children.append((eqn, child))
+            if merged is None:
+                merged = [AV(av.iv) for av in outs]
+            else:
+                merged = [
+                    AV(m.iv.hull(o.iv)) for m, o in zip(merged, outs)
+                ]
+        if merged is None:
+            return [AV(_top(o)) for o in eqn.outvars]
+        merged = merged[:len(eqn.outvars)]
+        merged += [AV(_top(o)) for o in eqn.outvars[len(merged):]]
+        return merged
+
+    def _eval_while(self, frame, eqn, ins):
+        cn = eqn.params.get("cond_nconsts", 0)
+        bn = eqn.params.get("body_nconsts", 0)
+        subs = {n: (s, c) for n, s, c in _sub_jaxprs(eqn)}
+        body, bconsts = subs.get("body_jaxpr", (None, ()))
+        if body is None:
+            return [AV(_top(o)) for o in eqn.outvars]
+        bconst_avs = ins[cn:cn + bn]
+        carry = [AV(a.iv) for a in ins[cn + bn:]]
+        record, self.record = self.record, False
+        self.scan_depth += 1
+        converged = False
+        for _ in range(_SCAN_FIX_ITERS):
+            outs, _ = self.eval_jaxpr(body, bconsts, bconst_avs + carry)
+            nxt = [c.iv.hull(o.iv) for c, o in zip(carry, outs)]
+            if all(c.iv.contains(n) for c, n in zip(carry, nxt)):
+                converged = True
+                break
+            carry = [AV(n) for n in nxt]
+        if not converged:
+            # Unknown trip count: unstable carries fall to dtype top.
+            outs, _ = self.eval_jaxpr(body, bconsts, bconst_avs + carry)
+            carry = [
+                AV(c.iv if c.iv.contains(o.iv) else
+                   _top(v))
+                for c, o, v in zip(carry, outs,
+                                   body.invars[bn:])
+            ]
+        self.record = record
+        outs, child = self.eval_jaxpr(body, bconsts, bconst_avs + carry)
+        frame.children.append((eqn, child))
+        self.scan_depth -= 1
+        return [AV(c.iv.hull(o.iv)) for c, o in zip(carry, outs)]
+
+    def _eval_scan(self, frame, eqn, ins):
+        params = eqn.params
+        nc = params.get("num_consts", 0)
+        ncarry = params.get("num_carry", 0)
+        length = int(params.get("length", 1))
+        subs = _sub_jaxprs(eqn)
+        if not subs:
+            return [AV(_top(o)) for o in eqn.outvars]
+        _, body, consts = subs[0]
+        const_avs = ins[:nc]
+        init_avs = ins[nc:nc + ncarry]
+        xs_avs = [AV(a.iv, None, _Token("xs") if a.token is not None
+                     else None)
+                  for a in ins[nc + ncarry:]]
+        carry = [AV(a.iv) for a in init_avs]
+
+        record, self.record = self.record, False
+        self.scan_depth += 1
+        history = [[c.iv for c in carry]]
+        converged = False
+        for _ in range(_SCAN_FIX_ITERS):
+            outs, _ = self.eval_jaxpr(body, consts,
+                                      const_avs + carry + xs_avs)
+            nxt = [c.iv.hull(o.iv) for c, o in zip(carry, outs)]
+            if all(c.iv.contains(n) for c, n in zip(carry, nxt)):
+                converged = True
+                break
+            carry = [AV(n) for n in nxt]
+            history.append(nxt)
+        if not converged and len(history) >= 3:
+            # Trip-count widening: extrapolate the observed per-tick
+            # growth over the remaining iterations, cap at the dtype
+            # range (a carried ENTRY is representable by definition),
+            # then verify under SATURATING semantics — the tightest
+            # wrap-free invariant survives, and the final exact pass
+            # below flags any op that still escapes from it.
+            c1, c2 = history[-2], history[-1]
+            widened = []
+            deltas = []
+            for a, b, v in zip(c1, c2, body.invars[nc:nc + ncarry]):
+                dh = b.hi - a.hi
+                dl = a.lo - b.lo
+                deltas.append((dl, dh))
+                if not b.known:
+                    widened.append(_top(v))
+                    continue
+                lo = b.lo - dl * max(length - 2, 0)
+                hi = b.hi + dh * max(length - 2, 0)
+                iv = IV(lo, hi, True)
+                d = _dtype_of(v)
+                if d is not None and _is_int(d):
+                    lo_d, hi_d = _int_range(d)
+                    iv = IV(max(lo, lo_d), min(hi, hi_d), True)
+                widened.append(iv)
+            carry = [AV(w) for w in widened]
+            noisy_w, self.noisy = self.noisy, False
+            self.saturate = True
+            outs, _ = self.eval_jaxpr(body, consts,
+                                      const_avs + carry + xs_avs)
+            stable = []
+            for w, o, (dl, dh), v, c0 in zip(
+                widened, outs, deltas,
+                body.invars[nc:nc + ncarry], history[0],
+            ):
+                if w.contains(o.iv):
+                    # Strict post-fixpoint under saturation:
+                    # hull(init, f(W)) is a tighter invariant (entries
+                    # start at init; any entry in it maps into f(W)).
+                    acc = c0.hull(o.iv)
+                elif (o.iv.lo >= w.lo - max(dl, 0) - 1
+                        and o.iv.hi <= w.hi + max(dh, 0) + 1):
+                    # Growth stayed within the observed per-tick delta:
+                    # keep the trip-count extrapolation.
+                    acc = w.hull(o.iv)
+                else:
+                    acc = _top(v)
+                stable.append(acc)
+            # One narrowing iteration: re-apply f from the tightened
+            # candidate (it can only shrink clamped planes further).
+            carry = [AV(x) for x in stable]
+            outs, _ = self.eval_jaxpr(body, consts,
+                                      const_avs + carry + xs_avs)
+            final = []
+            for w, o, c0, v in zip(stable, outs, history[0],
+                                   body.invars[nc:nc + ncarry]):
+                if w.contains(o.iv):
+                    final.append(c0.hull(o.iv))
+                else:
+                    final.append(w)
+            carry = [AV(x) for x in final]
+            self.saturate = False
+            self.noisy = noisy_w
+        self.record = record
+
+        # Certificates: entry-fixpoint intervals of carries fed by
+        # program-input planes.  Unknown fixpoints are recorded too —
+        # a plane that IS carried but whose fixpoint was lost must not
+        # fall back to its init bound (the init is not an invariant).
+        if self.record:
+            for a, c in zip(init_avs, carry):
+                if a.origin is not None:
+                    prev = self.carry_fix.get(a.origin)
+                    self.carry_fix[a.origin] = (
+                        c.iv if prev is None else prev.hull(c.iv)
+                    )
+
+        # J8 carry-key discipline: tokens thread through the body once.
+        carry_in = [
+            AV(c.iv, None, a.token) for c, a in zip(carry, init_avs)
+        ]
+        outs, child = self.eval_jaxpr(
+            body, consts, const_avs + carry_in + xs_avs
+        )
+        frame.children.append((eqn, child))
+        if self.record:
+            for i, (a, o) in enumerate(zip(carry_in, outs[:ncarry])):
+                if (a.token is not None and o.token is a.token
+                        and self.token_uses.get(a.token, {}).get("draw")):
+                    self.report(
+                        eqn, "J8",
+                        "scan carry reuses an unfolded PRNG key across "
+                        f"ticks (carry position {i}): the body draws "
+                        "from the carried key and passes it through "
+                        "unchanged — every tick sees the same stream "
+                        "(split it, or fold_in the tick index)",
+                    )
+            self._check_loud_accounting(child)
+        self.scan_depth -= 1
+
+        carry_out = [
+            AV(c.iv.hull(o.iv)) for c, o in zip(carry, outs[:ncarry])
+        ]
+        ys = [AV(o.iv) for o in outs[ncarry:]]
+        outs_all = carry_out + ys
+        outs_all = outs_all[:len(eqn.outvars)]
+        outs_all += [AV(_top(o)) for o in eqn.outvars[len(outs_all):]]
+        return outs_all
+
+    # -- J9: loud accounting ---------------------------------------------
+
+    def _index_piece_ivs(self, frame: _Frame, idx_var) -> list[IV]:
+        """Per-column intervals of a scatter's index matrix, refined
+        through the ``concatenate`` that built it when possible."""
+        seen = 0
+        v = idx_var
+        while seen < 4:
+            if hasattr(v, "val"):
+                return [_lit_iv(v.val)]
+            eqn = frame.def_eqn.get(v)
+            if eqn is None:
+                break
+            prim = eqn.primitive.name
+            if prim in ("reshape", "squeeze", "broadcast_in_dim",
+                        "transpose", "convert_element_type"):
+                v = eqn.invars[0]
+                seen += 1
+                continue
+            if prim == "concatenate":
+                return [self.read(frame, p).iv for p in eqn.invars]
+            break
+        shape = _shape_of(idx_var)
+        width = shape[-1] if shape else 1
+        return [self.read(frame, idx_var).iv] * max(width, 1)
+
+    def _bool_ancestors(self, frame: _Frame, var, limit: int = 4000):
+        out = []
+        stack = [var]
+        visited = set()
+        while stack and len(visited) < limit:
+            v = stack.pop()
+            if id(v) in visited:
+                continue
+            visited.add(id(v))
+            d = _dtype_of(v)
+            if d is not None and _is_bool(d):
+                out.append(v)
+            if hasattr(v, "val"):
+                continue
+            eqn = frame.def_eqn.get(v)
+            if eqn is not None:
+                for iv_ in eqn.invars:
+                    if not hasattr(iv_, "val"):
+                        stack.append(iv_)
+        return out
+
+    def _check_loud_accounting(self, body_frame: _Frame) -> None:
+        """Walk a scan body's frames for mask-gated droppable scatters
+        whose mask never escapes to the body outputs."""
+        if "J9" not in self.rules:
+            return
+
+        def frames(fr: _Frame):
+            yield fr
+            for _, child in fr.children:
+                if child is not None:
+                    yield from frames(child)
+
+        for fr in frames(body_frame):
+            consumers: dict = {}
+            for eqn in fr.jaxpr.eqns:
+                for v in eqn.invars:
+                    if not hasattr(v, "val"):
+                        consumers.setdefault(v, []).append(eqn)
+            outset = {v for v in fr.jaxpr.outvars if not hasattr(v, "val")}
+            for eqn, child in fr.children:
+                if child is not None or not (
+                    eqn.primitive.name.startswith("scatter")
+                ):
+                    continue
+                self._check_one_scatter(fr, eqn, consumers, outset)
+
+    def _check_one_scatter(self, fr: _Frame, eqn, consumers, outset):
+        mode = str(eqn.params.get("mode"))
+        if "CLIP" in mode or "PROMISE" in mode:
+            return
+        operand_shape = _shape_of(eqn.invars[0])
+        dnums = eqn.params.get("dimension_numbers")
+        dims = tuple(getattr(dnums, "scatter_dims_to_operand_dims", ()))
+        pieces = self._index_piece_ivs(fr, eqn.invars[1])
+        in_bounds = True
+        for i, d in enumerate(dims):
+            iv = pieces[i] if i < len(pieces) else pieces[-1]
+            size = operand_shape[d] if d < len(operand_shape) else 0
+            if not (iv.known and 0 <= iv.lo and iv.hi <= size - 1):
+                in_bounds = False
+                break
+        if in_bounds:
+            return
+        masks = self._bool_ancestors(fr, eqn.invars[1])
+        if not masks:
+            return  # not mask-gated: OOB hygiene is J7's side
+        # Forward reachability: some mask-derived value must reach the
+        # body outputs through a path other than this scatter.
+        target = set(map(id, outset))
+        for m in masks:
+            stack = [m]
+            visited = set()
+            while stack:
+                v = stack.pop()
+                if id(v) in visited:
+                    continue
+                visited.add(id(v))
+                if id(v) in target:
+                    return  # counted somewhere: loud
+                for ceqn in consumers.get(v, ()):
+                    if ceqn is eqn:
+                        continue
+                    for o in ceqn.outvars:
+                        if type(o).__name__ != "DropVar":
+                            stack.append(o)
+        self.report(
+            eqn, "J9",
+            f"{eqn.primitive.name} can drop masked units (index range "
+            "not provably in bounds) and no value derived from its mask "
+            "reaches the scan outputs — a silent drop/evict; count it "
+            "into a carried counter (offered == delivered + dropped)",
+        )
+
+    # -- J8 finalization --------------------------------------------------
+
+    def finalize_keys(self) -> None:
+        if "J8" not in self.rules:
+            return
+        for token, uses in self.token_uses.items():
+            draws = uses.get("draw", [])
+            splits = uses.get("split", [])
+            if len(draws) >= 2:
+                eqn = draws[1][0]
+                self.report(
+                    eqn, "J8",
+                    "PRNG key consumed by two draw sites — the second "
+                    "draw replays the first one's stream (split the key "
+                    "or fold_in a distinct salt)",
+                )
+            if len(splits) >= 2:
+                eqn = splits[1][0]
+                self.report(
+                    eqn, "J8",
+                    "PRNG key split twice — both splits derive the SAME "
+                    "children (use one split, or fold_in distinct salts "
+                    "first)",
+                )
+            if draws and splits and len(draws) < 2 and len(splits) < 2:
+                eqn = draws[0][0]
+                self.report(
+                    eqn, "J8",
+                    "PRNG key drawn from after being split — the draw "
+                    "correlates with the split's children (draw from a "
+                    "split child or a salted fold_in instead)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def _flatten_bounds(args, bounds) -> list[Optional[Bound]]:
+    import jax
+
+    flat_args = jax.tree_util.tree_leaves(args)
+    if bounds is None:
+        return [None] * len(flat_args)
+    flat_bounds = jax.tree_util.tree_leaves(
+        bounds, is_leaf=lambda x: isinstance(x, Bound)
+    )
+    if len(flat_bounds) != len(flat_args):
+        raise ValueError(
+            f"bounds pytree has {len(flat_bounds)} leaves, args have "
+            f"{len(flat_args)} — they must be congruent"
+        )
+    return [b if isinstance(b, Bound) else None for b in flat_bounds]
+
+
+def _leaf_names(args) -> list[str]:
+    import jax
+
+    paths = jax.tree_util.tree_flatten_with_path(args)[0]
+    return [jax.tree_util.keystr(p) for p, _ in paths]
+
+
+def analyze_program(program: str, closed_jaxpr, *,
+                    bounds: Optional[list] = None,
+                    leaf_names: Optional[list[str]] = None,
+                    rules: Optional[Iterable[str]] = None,
+                    ) -> RangeReport:
+    """Run the interval interpreter over one traced program.  ``bounds``
+    is a flat list (aligned with the program's invars) of
+    :class:`Bound`/None; ``leaf_names`` the matching display names."""
+    active = frozenset(rules) if rules is not None else frozenset(RULES)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {sorted(unknown)}; known: {sorted(RULES)}"
+        )
+    jaxpr = closed_jaxpr.jaxpr
+    interp = _Interp(program, active)
+    in_avs = []
+    n_in = len(jaxpr.invars)
+    bounds = list(bounds or [None] * n_in)
+    bounds += [None] * (n_in - len(bounds))
+    names = list(leaf_names or [])
+    names += [f"arg{i}" for i in range(len(names), n_in)]
+    for i, (v, b) in enumerate(zip(jaxpr.invars, bounds)):
+        d = _dtype_of(v)
+        token = None
+        if d is not None and (_is_key(d) or (
+            _dtype_name(d) == "uint32" and _shape_of(v)[-1:] == (2,)
+        )):
+            token = _Token("input")
+        if b is not None and b.known:
+            in_avs.append(AV(IV(b.lo, b.hi, True), origin=i, token=token))
+        else:
+            in_avs.append(AV(_top(v), origin=i, token=token))
+    interp.eval_jaxpr(jaxpr, tuple(closed_jaxpr.consts), in_avs)
+    interp.finalize_keys()
+
+    certs: list[NarrowingCertificate] = []
+    if "J7" in active:
+        for i, (v, b) in enumerate(zip(jaxpr.invars, bounds)):
+            d = _dtype_of(v)
+            if d is None or not _is_signed_int(d):
+                continue
+            iv = interp.carry_fix.get(i)
+            if iv is None:
+                # Never carried through a scan: the input bound IS the
+                # whole-program value range.
+                if b is not None and b.known:
+                    iv = IV(b.lo, b.hi, True)
+                else:
+                    continue
+            if not iv.known or iv.lo == -_INF or iv.hi == _INF:
+                continue
+            minimal = minimal_signed_dtype(iv.lo, iv.hi)
+            if minimal is None:
+                continue
+            import numpy as np
+
+            elements = 1
+            for dsz in _shape_of(v):
+                elements *= dsz
+            cur_size = np.dtype(_dtype_name(d)).itemsize
+            min_size = np.dtype(minimal).itemsize
+            certs.append(NarrowingCertificate(
+                program=program, plane=names[i],
+                dtype=_dtype_name(d), lo=int(iv.lo), hi=int(iv.hi),
+                minimal=minimal, elements=elements,
+                bytes_now=elements * cur_size,
+                bytes_minimal=elements * min_size,
+            ))
+    return RangeReport(findings=interp.findings, certificates=certs)
+
+
+def analyze_spec(name: str, spec, traced=None,
+                 rules: Optional[Iterable[str]] = None) -> RangeReport:
+    """Trace + analyze one :class:`~consul_tpu.sim.engine.SimProgram`,
+    consuming its bound metadata when present.  Pass ``traced`` to
+    reuse a ClosedJaxpr already traced by another pass (``cli check``
+    traces each program once for jaxlint AND rangelint)."""
+    fn_args = spec.build()
+    args = fn_args[1]
+    bounds = None
+    names = _leaf_names(args)
+    bound_fn = getattr(spec, "bounds", None)
+    if bound_fn is not None:
+        bounds = _flatten_bounds(args, bound_fn())
+    return analyze_program(
+        name, traced if traced is not None else spec.trace(),
+        bounds=bounds, leaf_names=names, rules=rules,
+    )
+
+
+def lint_registry(programs: dict,
+                  rules: Optional[Iterable[str]] = None,
+                  ) -> tuple[list, dict]:
+    """Analyze a registry of SimProgram specs.  Returns (findings,
+    {program: [NarrowingCertificate, ...]})."""
+    findings: list = []
+    certs: dict = {}
+    for name, spec in programs.items():
+        fn_args = spec.build()
+        bounds = None
+        bound_fn = getattr(spec, "bounds", None)
+        if bound_fn is not None:
+            bounds = _flatten_bounds(fn_args[1], bound_fn())
+        report = analyze_program(
+            name, spec.trace(), bounds=bounds,
+            leaf_names=_leaf_names(fn_args[1]), rules=rules,
+        )
+        findings.extend(report.findings)
+        certs[name] = report.certificates
+    return findings, certs
+
+
+def narrowing_ledger(spec, at_n: int) -> RangeReport:
+    """The 10M-node reading: re-trace ``spec`` via its ``scale`` hook at
+    population ``at_n`` and analyze — the certificate table (and any J7
+    finding) against the real capacity target rather than the declared
+    config."""
+    scale = getattr(spec, "scale", None)
+    if scale is None:
+        raise ValueError(f"{spec.name} has no scale hook")
+    return analyze_spec(f"{spec.name}@n={at_n}", scale(at_n))
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rangelint",
+        description="interval-domain abstract interpretation over the "
+                    "registered simulation entrypoints (J7 overflow + "
+                    "narrowing certificates, J8 key lineage, J9 loud "
+                    "accounting; abstract tracing only)",
+    )
+    parser.add_argument("--rules", default="",
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        dest="list_rules")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--set", choices=("small", "big", "all"),
+                        default="all", dest="which")
+    parser.add_argument("--at-n", type=int, default=0, dest="at_n",
+                        help="additionally read the narrowing ledger at "
+                             "this population via the registry's scale "
+                             "hooks (e.g. 10000000)")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    import os
+
+    from consul_tpu.analysis.jaxlint import _backend_initialized
+
+    if not _backend_initialized():
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    try:
+        from consul_tpu.sim.engine import jaxlint_registry
+
+        include = (("small", "big") if args.which == "all"
+                   else (args.which,))
+        programs = jaxlint_registry(include=include)
+        findings, certs = lint_registry(programs, rules=rules)
+        ledgers = {}
+        if args.at_n:
+            for name, spec in programs.items():
+                if getattr(spec, "scale", None) is None:
+                    continue
+                rep = narrowing_ledger(spec, args.at_n)
+                ledgers[name] = rep
+                findings.extend(rep.findings)
+    except ValueError as e:
+        print(f"rangelint: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "programs": len(programs),
+            "certificates": {
+                n: [c.to_json() for c in cs]
+                for n, cs in certs.items() if cs
+            },
+            "ledger": {
+                n: [c.to_json() for c in rep.certificates]
+                for n, rep in ledgers.items()
+            },
+        }))
+    else:
+        for f in findings:
+            print(f.format())
+        shown = 0
+        for n, cs in sorted(certs.items()):
+            for c in cs:
+                if c.saved_bytes > 0 and shown < 40:
+                    print(
+                        f"rangelint: {n}: {c.plane} {c.dtype} "
+                        f"[{c.lo}, {c.hi}] -> {c.minimal} "
+                        f"(saves {format_bytes(c.saved_bytes)}/copy)",
+                        file=sys.stderr,
+                    )
+                    shown += 1
+    if findings:
+        print(f"rangelint: {len(findings)} finding(s) in "
+              f"{len(programs)} program(s)", file=sys.stderr)
+        return 1
+    if args.format != "json":
+        print(f"rangelint: clean ({len(programs)} program(s))",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

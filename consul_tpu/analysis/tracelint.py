@@ -62,6 +62,16 @@ Rules (``--list-rules`` prints this table):
                                 (``state.x = ...``, ``x[i] = ...``) —
                                 use ``dataclasses.replace``/
                                 ``._replace``/``.at[].set``
+  R9  kw-static-call            a static flag of a module-level jitted
+                                twin (``scan = jax.jit(_impl,
+                                static_argnames=(...))``) passed by
+                                KEYWORD at a call site or bound by
+                                keyword through ``functools.partial``
+                                — jit caches keyword and positional
+                                call shapes separately, so each
+                                spelling mints its own compiled
+                                program (the standing jit-cache
+                                gotcha; call statics positionally)
 
 Suppression: append ``# tracelint: disable=R3`` (or a comma list, or
 bare ``disable`` for all rules) to the offending line, with a
@@ -97,6 +107,10 @@ RULES: dict[str, str] = {
           "fails under jit — use vmap/lax.scan",
     "R8": "carry-mutation: traced state is immutable — use "
           "dataclasses.replace/._replace/.at[].set functional updates",
+    "R9": "kw-static-call: a static flag of a jitted twin passed by "
+          "KEYWORD at a call site (or functools.partial) — jit caches "
+          "keyword and positional bindings separately, so each spelling "
+          "compiles its own program (call statics positionally)",
 }
 
 # Array constructors that must pin a dtype, with the positional index at
@@ -781,10 +795,57 @@ class _ModuleLinter:
 
     def run(self) -> list[Violation]:
         self._collect_transform_bodies()
+        self._collect_jitted_twins()
         self._check_module_wide()
         for node in self.tree.body:
             self._lint_scope(node, outer_taint={})
         return self.reporter.violations
+
+    # -- R9: jitted-twin call-site discipline ----------------------------
+
+    def _collect_jitted_twins(self) -> None:
+        """Module-level ``NAME = jax.jit(fn, static_argnames=(...))``
+        assignments: NAME is a jitted twin whose statics must be passed
+        positionally at call sites (the kw/positional jit-cache
+        gotcha)."""
+        self.jitted_twins: dict[str, frozenset[str]] = {}
+        for node in self.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            spec = _match_jit(node.value, self.imports)
+            if spec is None or not spec.static_names:
+                continue
+            self.jitted_twins[node.targets[0].id] = frozenset(
+                spec.static_names
+            )
+
+    def _check_kw_static_call(self, node: ast.Call) -> None:
+        """R9 at one call site: direct twin calls and
+        ``functools.partial(twin, ...)`` bindings."""
+        target: Optional[str] = None
+        fn = self.imports.resolve(_dotted(node.func))
+        if fn in ("functools.partial", "partial"):
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = node.args[0].id
+        elif isinstance(node.func, ast.Name):
+            target = node.func.id
+        if target is None:
+            return
+        statics = self.jitted_twins.get(target)
+        if not statics:
+            return
+        for kw in node.keywords:
+            if kw.arg in statics:
+                self.reporter.report(
+                    node, "R9",
+                    f"static arg {kw.arg!r} of jitted twin {target}() "
+                    "passed by keyword — jit caches kw and positional "
+                    "bindings separately, so this spelling compiles a "
+                    "separate program from the positional call sites "
+                    "(pass it positionally)",
+                )
 
     # -- traced-function discovery --------------------------------------
 
@@ -871,6 +932,7 @@ class _ModuleLinter:
     def _check_module_wide(self) -> None:
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Call):
+                self._check_kw_static_call(node)
                 resolved = self.imports.resolve(_dotted(node.func))
                 pos = _CTOR_DTYPE_POS.get(resolved or "")
                 if pos is not None:

@@ -238,6 +238,44 @@ def build_parser() -> argparse.ArgumentParser:
                     help="lint JAXLINT_PROGRAMS from a Python file "
                          "instead of the engine registry")
 
+    sp = sub.add_parser(
+        "rangelint",
+        help="interval-domain abstract interpretation over the "
+             "registered entrypoints (rules J7-J9 + narrowing "
+             "certificates)",
+    )
+    sp.set_defaults(fn=cmd_rangelint)
+    sp.add_argument("--rules", default="",
+                    help="comma-separated rule ids, e.g. J7 "
+                         "(default: all)")
+    sp.add_argument("--list-rules", action="store_true",
+                    dest="list_rules", help="enumerate rules and exit")
+    sp.add_argument("--format", choices=["text", "json"], default="text",
+                    dest="format")
+    sp.add_argument("--set", choices=["small", "big", "all"],
+                    default="all", dest="which")
+    sp.add_argument("--at-n", type=int, default=0, dest="at_n",
+                    help="also read the narrowing ledger at this "
+                         "population via the registry scale hooks "
+                         "(e.g. 10000000)")
+
+    sp = sub.add_parser(
+        "check",
+        help="the umbrella pass: tracelint + jaxlint + rangelint in "
+             "one run, each registry program traced once, merged "
+             "--format json, shared exit-code contract",
+    )
+    sp.set_defaults(fn=cmd_check)
+    sp.add_argument("--format", choices=["text", "json"], default="text",
+                    dest="format")
+    sp.add_argument("--set", choices=["small", "big", "all"],
+                    default="small", dest="which",
+                    help="registry slice for the jaxpr passes "
+                         "(default small; big adds the 1M configs)")
+    sp.add_argument("--budget-gb", type=float, default=16.0,
+                    dest="budget_gb",
+                    help="per-chip HBM budget for jaxlint J6")
+
     # simulator -----------------------------------------------------------
     sp = sub.add_parser(
         "sim", help="run a TPU-simulator scenario preset"
@@ -1101,6 +1139,79 @@ async def cmd_jaxlint(args) -> int:
     if args.module:
         argv.extend(["--module", args.module])
     return jaxlint_main(argv)
+
+
+async def cmd_rangelint(args) -> int:
+    """Interval-domain analysis over the registered entrypoints
+    (consul_tpu.analysis.rangelint): J7 overflow certification + the
+    narrowing-certificate ledger, J8 PRNG key lineage, J9 loud
+    accounting.  Mirrors ``cli jaxlint``'s exit-code contract."""
+    from consul_tpu.analysis.rangelint import main as rangelint_main
+
+    argv = []
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    if args.format != "text":
+        argv.extend(["--format", args.format])
+    if args.which != "all":
+        argv.extend(["--set", args.which])
+    if args.at_n:
+        argv.extend(["--at-n", str(args.at_n)])
+    return rangelint_main(argv)
+
+
+async def cmd_check(args) -> int:
+    """The umbrella subcommand: tracelint + jaxlint + rangelint in one
+    pass (each registry program traced ONCE, shared by both jaxpr
+    passes), with merged ``--format json`` output and the shared
+    exit-code contract (0 clean, 1 findings)."""
+    import os as _os
+
+    from consul_tpu.analysis.jaxlint import _backend_initialized
+
+    if not _backend_initialized():
+        _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            _os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    from consul_tpu.analysis import run_check
+
+    include = (
+        ("small", "big") if args.which == "all" else (args.which,)
+    )
+    out = run_check(include=include, budget_gb=args.budget_gb)
+    if args.format == "json":
+        print(json.dumps(out))
+        return 0 if out["clean"] else 1
+    for v in out["tracelint"]["violations"]:
+        print(f"{v['path']}:{v['line']}:{v['col']} {v['rule']} "
+              f"{v['message']}")
+    for key in ("jaxlint", "rangelint"):
+        for f in out[key]["findings"]:
+            where = f["where"] or "<program>"
+            print(f"{f['program']}: {where} {f['rule']} {f['message']}")
+    n_bad = (len(out["tracelint"]["violations"])
+             + len(out["jaxlint"]["findings"])
+             + len(out["rangelint"]["findings"]))
+    walls = ", ".join(
+        f"{k} {v}s" for k, v in out["wall_s"].items()
+    )
+    n_certs = sum(
+        1 for cs in out["rangelint"]["certificates"].values()
+        for c in cs if c["saved_bytes"] > 0
+    )
+    print(
+        f"check: {'clean' if out['clean'] else f'{n_bad} finding(s)'} "
+        f"({out['tracelint']['files']} file(s), "
+        f"{out['jaxlint']['programs']} program(s), "
+        f"{n_certs} narrowing certificate(s); {walls})",
+        file=sys.stderr,
+    )
+    return 0 if out["clean"] else 1
 
 
 async def cmd_sim(args) -> int:

@@ -260,10 +260,11 @@ def membership_round(
     present = t >= join_tick
     crashed = t >= fail_tick
     leaving = present & (t >= leave_tick) & ~crashed
+    # Clamp-then-add: NEVER rows saturate at NEVER instead of computing
+    # a masked NEVER + grace wrap (rangelint J7 proves this add exact).
     departed = present & ~crashed & (
-        t >= jnp.where(
-            leave_tick == NEVER, NEVER, leave_tick + cfg.leave_grace_ticks
-        )
+        t >= jnp.minimum(leave_tick, NEVER - cfg.leave_grace_ticks)
+        + cfg.leave_grace_ticks
     )
     participates = present & ~crashed & ~departed
 

@@ -90,7 +90,32 @@ from consul_tpu.ops import (
 
 DEFAULT_KEY = 0  # make_key(0, RANK_ALIVE): the steady-state cell
 
+# Certified narrowings (rangelint J7, consul_tpu/analysis/rangelint.py):
+# the interval analysis proves the carried value ranges of two slot
+# planes from config bounds, so they ship narrow and the [n, K] state
+# drops 5 bytes/cell (int32 -> int8 + int16):
+#   confirms  in [0, confirmations_k] (suspicion_mult - 2, single
+#             digits for every profile) — int8 with orders of headroom;
+#   tx        in [0, tx_limit] = retransmit_mult * ceil(log10(n + 1))
+#             (< 100 even at n = 10M), transient dips to -fanout during
+#             the budget spend before the maximum(., 0) clamp — int16
+#             rather than the certificate-minimal int8 purely for
+#             headroom on exotic retransmit_mult configs (guarded in
+#             SparseMembershipConfig.__post_init__).
+# All in-round arithmetic on these planes stays dtype-preserving so the
+# scan carry round-trips; cross-plane math (merge precedence, timeout
+# scaling) never mixes them into wider lanes.
+CONF_DTYPE = jnp.int8
+TX_DTYPE = jnp.int16
+
 _CHUNK = 1 << 18  # chunk for _scan_chunks: bounds per-chunk temps
+
+# Loud-accounting counters saturate here instead of wrapping: a counter
+# that wraps past int32 reads as small-or-zero — the one silent failure
+# mode the exactness ladder exists to prevent.  The cap leaves headroom
+# for one worst-case per-tick increment (the full arrival stream) under
+# rangelint J7's exact-add proof: cap + A_max < 2^31 at n = 10M.
+COUNTER_CAP = 1 << 29
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,14 +140,25 @@ class SparseMembershipConfig:
             )
         if self.k_slots < 2:
             raise ValueError("k_slots must be >= 2")
+        limit = self.base.tx_limit
+        if limit > jnp.iinfo(TX_DTYPE).max - self.base.fanout:
+            raise ValueError(
+                f"tx_limit {limit} exceeds the certified {TX_DTYPE.__name__} "
+                "tx plane (see the narrowing note at module top)"
+            )
+        if self.base.confirmations_k > jnp.iinfo(CONF_DTYPE).max:
+            raise ValueError(
+                f"confirmations_k {self.base.confirmations_k} exceeds the "
+                f"certified {CONF_DTYPE.__name__} confirms plane"
+            )
 
 
 class SparseMembershipState(NamedTuple):
     slot_subj: jax.Array        # int32[n, K] — subject ids, -1 empty
     key: jax.Array              # int32[n, K]
     suspect_since: jax.Array    # int32[n, K]
-    confirms: jax.Array         # int32[n, K]
-    tx: jax.Array               # int32[n, K]
+    confirms: jax.Array         # CONF_DTYPE[n, K] (certified narrowing)
+    tx: jax.Array               # TX_DTYPE[n, K] (certified narrowing)
     own_inc: jax.Array          # int32[n]
     awareness: jax.Array        # int32[n]
     probe_pending_at: jax.Array # int32[n]
@@ -179,8 +215,8 @@ def sparse_membership_init(cfg: SparseMembershipConfig) -> SparseMembershipState
         slot_subj=slot_subj,
         key=jnp.zeros((n, K), jnp.int32),
         suspect_since=jnp.full((n, K), NEVER, jnp.int32),
-        confirms=jnp.zeros((n, K), jnp.int32),
-        tx=jnp.zeros((n, K), jnp.int32),
+        confirms=jnp.zeros((n, K), CONF_DTYPE),
+        tx=jnp.zeros((n, K), TX_DTYPE),
         own_inc=jnp.zeros((n,), jnp.int32),
         awareness=jnp.zeros((n,), jnp.int32),
         probe_pending_at=jnp.full((n,), NEVER, jnp.int32),
@@ -323,7 +359,8 @@ def _merge_arrivals(
             new_subj, key_m, since, conf, tx, key_rx, sus_rx
         )
     return ((new_subj, key_m, since, conf, tx), key_rx, sus_rx,
-            overflow + dropped, forgotten + forgot)
+            jnp.minimum(overflow, COUNTER_CAP) + dropped,
+            jnp.minimum(forgotten, COUNTER_CAP) + forgot)
 
 
 def _view_of(slot_subj, slot_key, who: jax.Array, subj: jax.Array):
@@ -358,10 +395,11 @@ def sparse_membership_round(
     present = jnp.ones((n,), bool)
     crashed = t >= fail_tick
     leaving = present & (t >= leave_tick) & ~crashed
+    # Clamp-then-add: NEVER rows saturate at NEVER instead of computing
+    # a masked NEVER + grace wrap (rangelint J7 proves this add exact).
     departed = present & ~crashed & (
-        t >= jnp.where(
-            leave_tick == NEVER, NEVER, leave_tick + base.leave_grace_ticks
-        )
+        t >= jnp.minimum(leave_tick, NEVER - base.leave_grace_ticks)
+        + base.leave_grace_ticks
     )
     participates = present & ~crashed & ~departed
 
@@ -420,9 +458,16 @@ def sparse_membership_round(
         key_rank(val_g) == RANK_SUSPECT, key_inc(val_g), -1
     )
 
-    spend = jnp.where(msg_valid, F, 0)
+    spend = jnp.where(msg_valid, F, 0).astype(tx.dtype)
+    # unique_indices: top_k returns distinct slots per row, so every
+    # (row, slot) pair lands once — lets XLA skip the combiner sort and
+    # lets rangelint J7 bound the cell delta by ONE update (the n·M
+    # worst case would spuriously escape the narrowed TX_DTYPE).
     tx = jnp.maximum(
-        tx.at[jnp.repeat(rows, M), sslot.ravel()].add(-spend.ravel()), 0
+        tx.at[jnp.repeat(rows, M), sslot.ravel()].add(
+            -spend.ravel(), unique_indices=True
+        ),
+        0,
     )
 
     # -- 2. push/pull ---------------------------------------------------
@@ -452,7 +497,7 @@ def sparse_membership_round(
             got, who = jax.lax.top_k(pp_ok.astype(jnp.int32), I)
             who = who.astype(jnp.int32)
             sel = got > 0
-            overflow = overflow + (
+            overflow = jnp.minimum(overflow, COUNTER_CAP) + (
                 jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got)
             )
             pwho = partner[who]
@@ -542,7 +587,7 @@ def sparse_membership_round(
         & (sus_rx >= key_inc(old_key))
     )
     new_confirms = jnp.minimum(
-        confirms + confirming.astype(jnp.int32), base.confirmations_k
+        confirms + confirming.astype(confirms.dtype), base.confirmations_k
     )
     gained_conf = confirming & (new_confirms > confirms)
     confirms = jnp.where(changed, 0, new_confirms)
@@ -593,8 +638,10 @@ def sparse_membership_round(
                 slots_p, settled_of(slots_p), need, probe_subject, n, K,
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
-            forgotten = forgotten + forgot
-            overflow = overflow + jnp.sum((need & ~can).astype(jnp.int32))
+            forgotten = jnp.minimum(forgotten, COUNTER_CAP) + forgot
+            overflow = jnp.minimum(overflow, COUNTER_CAP) + jnp.sum(
+                (need & ~can).astype(jnp.int32)
+            )
             mslot = jnp.where(can, choice, mslot)
         mview = jnp.where(
             mslot >= 0, key_m[rows, jnp.maximum(mslot, 0)], DEFAULT_KEY
@@ -672,7 +719,9 @@ def densify(state: SparseMembershipState, n: int):
     key = key.ravel().at[flat].set(state.key.ravel(), mode="drop").reshape(n, n)
     since = since.ravel().at[flat].set(
         state.suspect_since.ravel(), mode="drop").reshape(n, n)
+    # The narrowed planes widen back to the dense int32 layout here.
     conf = conf.ravel().at[flat].set(
-        state.confirms.ravel(), mode="drop").reshape(n, n)
-    tx = tx.ravel().at[flat].set(state.tx.ravel(), mode="drop").reshape(n, n)
+        state.confirms.astype(jnp.int32).ravel(), mode="drop").reshape(n, n)
+    tx = tx.ravel().at[flat].set(
+        state.tx.astype(jnp.int32).ravel(), mode="drop").reshape(n, n)
     return key, since, conf, tx

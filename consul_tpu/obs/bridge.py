@@ -1,15 +1,17 @@
 """Host bridge: replay an in-scan metrics trace into telemetry.Metrics.
 
 The scan side (obs/spec.py) stacks one [M] vector per tick; this side
-turns one study's ``[steps, M]`` trace back
-into the process-global go-metrics-shaped sink (consul_tpu/telemetry.py)
-under the reference metric names — counters ``incr_counter`` once per
-tick with that tick's count, gauges ``set_gauge`` to the final tick's
-level — so ``metrics().snapshot()`` / the /v1/agent/metrics JSON shape
-now describes simulated studies exactly the way it describes a live
-agent's hot paths.  A sweep's ``[U, steps, M]`` trace bridges
-per-study: index the universe axis first (bridging a whole sweep into
-one labelled sink is an open ROADMAP item).
+turns a study's ``[steps, M]`` trace — or a whole sweep's
+``[U, steps, M]`` trace — back into the process-global
+go-metrics-shaped sink (consul_tpu/telemetry.py) under the reference
+metric names — counters ``incr_counter`` once per tick with that
+tick's count, gauges ``set_gauge`` to the final tick's level — so
+``metrics().snapshot()`` / the /v1/agent/metrics JSON shape describes
+simulated studies exactly the way it describes a live agent's hot
+paths.  A sweep's universes land as SEPARATE series under the same
+metric names with the universe index as a metric Label
+(``{"universe": "3"}``) — the reference DisplayMetrics label shape, so
+one snapshot carries the whole swept family side by side.
 """
 
 from __future__ import annotations
@@ -23,38 +25,51 @@ from consul_tpu.telemetry import Metrics, metrics
 
 
 def bridge_trace(entrypoint: str, trace,
-                 sink: Optional[Metrics] = None) -> Metrics:
-    """Replay one study's ``[steps, M]`` trace into ``sink`` (the
-    process-global registry by default).
+                 sink: Optional[Metrics] = None,
+                 labels: Optional[dict] = None) -> Metrics:
+    """Replay a ``[steps, M]`` study trace — or a ``[U, steps, M]``
+    whole-sweep trace — into ``sink`` (the process-global registry by
+    default).
 
     Counter columns land as one ``incr_counter(name, count_t)`` per
     tick — ``Count`` = ticks, ``Sum`` = the study total, min/max/mean/
     stddev the per-tick distribution; gauge columns land as the final
-    tick's level.  Returns the sink for chaining."""
+    tick's level.  A 3-D trace bridges per-universe: universe ``u``'s
+    series carry ``{"universe": str(u)}`` merged over ``labels``.
+    Returns the sink for chaining."""
     sink = metrics() if sink is None else sink
     specs = _specs(entrypoint)
     # Builtin float (host-side aggregation precision), not np.float64:
     # the traced plane stays x32 (tracelint R3).
     arr = np.asarray(trace, dtype=float)
+    if arr.ndim == 3 and arr.shape[2] == len(specs):
+        for u in range(arr.shape[0]):
+            u_labels = dict(labels or {})
+            u_labels["universe"] = str(u)
+            bridge_trace(entrypoint, arr[u], sink, labels=u_labels)
+        return sink
     if arr.ndim != 2 or arr.shape[1] != len(specs):
         raise ValueError(
-            f"expected a [steps, {len(specs)}] trace for "
-            f"{entrypoint!r}, got shape {arr.shape}"
+            f"expected a [steps, {len(specs)}] (or [U, steps, "
+            f"{len(specs)}]) trace for {entrypoint!r}, got shape "
+            f"{arr.shape}"
         )
     for j, spec in enumerate(specs):
         series = arr[:, j]
         if spec.kind == "gauge":
-            sink.set_gauge(spec.name, float(series[-1]))
+            sink.set_gauge(spec.name, float(series[-1]), labels=labels)
         else:
             for v in series:
-                sink.incr_counter(spec.name, float(v))
+                sink.incr_counter(spec.name, float(v), labels=labels)
     return sink
 
 
 def bridge_report(entrypoint: str, report,
                   sink: Optional[Metrics] = None) -> Metrics:
-    """Bridge a run_* report that carries ``metrics_trace`` (a
-    telemetry=True study); loud when the study ran telemetry=off."""
+    """Bridge a run_* (or run_sweep) report that carries
+    ``metrics_trace`` (a telemetry=True study); loud when the study ran
+    telemetry=off.  Sweep reports bridge per-universe (universe index
+    as a Label)."""
     trace = getattr(report, "metrics_trace", None)
     if trace is None:
         raise ValueError(

@@ -78,14 +78,21 @@ def row_locate(slot_subj: jax.Array, recv: jax.Array, subj: jax.Array):
 
 def _segmented_sum(flags: jax.Array, x: jax.Array) -> jax.Array:
     """Inclusive segmented sum: each position holds the sum over its
-    segment prefix (segments start where ``flags`` is True)."""
+    segment prefix (segments start where ``flags`` is True; positions
+    before the first flag sum from index 0).
 
-    def combine(a, b):
-        fa, xa = a
-        fb, xb = b
-        return fa | fb, jnp.where(fb, xb, xa + xb)
-
-    return jax.lax.associative_scan(combine, (flags, x))[1]
+    One cumsum + one cummax + a gather instead of the log-depth
+    associative scan: single-pass primitives whose value bounds stay
+    linear in the stream length — the scan formulation's combine
+    doubles rangelint's abstract sum bound per tree level (a spurious
+    J7 int32 escape at the 1M-node stream), and the fused form drops
+    the O(log A) combine levels from the hot delivery path too."""
+    m = x.shape[0]
+    idx = jnp.arange(m, dtype=jnp.int32)
+    cs = jnp.cumsum(x, dtype=x.dtype)
+    start = jax.lax.cummax(jnp.where(flags, idx, -1))
+    base = jnp.where(start >= 1, cs[jnp.maximum(start - 1, 0)], 0)
+    return cs - base
 
 
 def _segmented_max3(flags: jax.Array, x: jax.Array, y: jax.Array,

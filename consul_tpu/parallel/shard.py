@@ -364,10 +364,8 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
         crashed = t >= fail_tick
         leaving = present & (t >= leave_tick) & ~crashed
         departed = present & ~crashed & (
-            t >= jnp.where(
-                leave_tick == NEVER, NEVER,
-                leave_tick + cfg.leave_grace_ticks,
-            )
+            t >= jnp.minimum(leave_tick, NEVER - cfg.leave_grace_ticks)
+            + cfg.leave_grace_ticks
         )
         participates = present & ~crashed & ~departed
         part_l = _rows(participates, start, blk)
@@ -762,6 +760,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         make_key,
     )
     from consul_tpu.models.membership_sparse import (
+        COUNTER_CAP,
         DEFAULT_KEY,
         SparseMembershipState,
         _claim_slot,
@@ -815,10 +814,8 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         crashed = t >= fail_tick
         leaving = present & (t >= leave_tick) & ~crashed
         departed = present & ~crashed & (
-            t >= jnp.where(
-                leave_tick == NEVER, NEVER,
-                leave_tick + base.leave_grace_ticks,
-            )
+            t >= jnp.minimum(leave_tick, NEVER - base.leave_grace_ticks)
+            + base.leave_grace_ticks
         )
         participates = present & ~crashed & ~departed
         part_l = _rows(participates, start, blk)
@@ -887,10 +884,12 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         )
         alloc_g = jnp.ones(recv_g.shape, bool)
 
-        spend = jnp.where(msg_valid, fanout, 0)
+        spend = jnp.where(msg_valid, fanout, 0).astype(tx.dtype)
+        # unique_indices: distinct top_k slots per row (see the
+        # unsharded twin's note — the J7-certified TX_DTYPE bound).
         tx = jnp.maximum(
             tx.at[jnp.repeat(rows_l, m_drain), sslot.ravel()]
-            .add(-spend.ravel()),
+            .add(-spend.ravel(), unique_indices=True),
             0,
         )
 
@@ -982,10 +981,12 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             )
         )
         slot_subj, key_m, suspect_since, confirms, tx = slots_t
-        overflow = overflow + ov_repl + jax.lax.psum(
-            overflow_l + dropped, NODE_AXIS
+        overflow = jnp.minimum(overflow, COUNTER_CAP) + ov_repl + (
+            jax.lax.psum(overflow_l + dropped, NODE_AXIS)
         )
-        forgotten = forgotten + jax.lax.psum(forgotten_l, NODE_AXIS)
+        forgotten = jnp.minimum(forgotten, COUNTER_CAP) + jax.lax.psum(
+            forgotten_l, NODE_AXIS
+        )
         self_slot = row_locate(slot_subj, rows_l, rows_g)
 
         # -- 4. refutation + merge -------------------------------------
@@ -1022,7 +1023,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             & (sus_rx >= key_inc(old_key))
         )
         new_confirms = jnp.minimum(
-            confirms + confirming.astype(jnp.int32),
+            confirms + confirming.astype(confirms.dtype),
             base.confirmations_k,
         )
         gained_conf = confirming & (new_confirms > confirms)
@@ -1072,8 +1073,10 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                 probe_subject, blk, k_slots,
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
-            forgotten = forgotten + jax.lax.psum(forgot, NODE_AXIS)
-            overflow = overflow + jax.lax.psum(
+            forgotten = jnp.minimum(forgotten, COUNTER_CAP) + (
+                jax.lax.psum(forgot, NODE_AXIS)
+            )
+            overflow = jnp.minimum(overflow, COUNTER_CAP) + jax.lax.psum(
                 jnp.sum((need & ~can).astype(jnp.int32)), NODE_AXIS
             )
             mslot = jnp.where(can, choice, mslot)

@@ -422,16 +422,17 @@ def run_membership(
             overflow=int(np.asarray(ov)),
             **_trace_fields("membership", trace),
         )
-    # telemetry=off keeps the exact pre-telemetry call shape (jit
-    # caches kw/positional binding styles separately — adding an
-    # explicit telemetry=False kw would mint a second identical
-    # program).
+    # Positional statics throughout (tracelint R9): jit caches kw and
+    # positional binding styles separately, so a keyword-bound partial
+    # here would mint a second program per entrypoint alongside the
+    # positional call sites (registry traces, tests, benches).
+    track_t = tuple(track)
     if telemetry:
-        scan = functools.partial(
-            membership_scan, track=tuple(track), telemetry=True
-        )
+        def scan(st, k, c, s):
+            return membership_scan(st, k, c, s, track_t, True)
     else:
-        scan = functools.partial(membership_scan, track=tuple(track))
+        def scan(st, k, c, s):
+            return membership_scan(st, k, c, s, track_t)
     _, outs, wall = _timed(
         make_state, scan, key, cfg, steps, warmup
     )
@@ -554,13 +555,12 @@ def run_membership_sparse(
                 st, k, c, s, mesh, track_t, exchange, telemetry
             )
     elif telemetry:
-        scan = functools.partial(
-            sparse_membership_scan, track=tuple(track), telemetry=True
-        )
+        def scan(st, k, c, s, _t=tuple(track)):
+            return sparse_membership_scan(st, k, c, s, _t, True)
     else:
-        # telemetry=off keeps the exact pre-telemetry call shape (see
-        # run_membership).
-        scan = functools.partial(sparse_membership_scan, track=tuple(track))
+        # Positional statics (tracelint R9; see run_membership).
+        def scan(st, k, c, s, _t=tuple(track)):
+            return sparse_membership_scan(st, k, c, s, _t)
     final, outs, wall = _timed(
         lambda: sparse_membership_init(cfg), scan, key, cfg, steps, warmup
     )
@@ -961,6 +961,14 @@ class SimProgram:
     budgeted: bool = True
     x64: bool = False
     note: str = ""
+    # rangelint metadata (consul_tpu/analysis/rangelint.py): ``bounds``
+    # returns a pytree CONGRUENT with build()'s args whose leaves are
+    # rangelint ``Bound`` instances — the initial-value interval of
+    # every input plane, derived from the config (node ids, ticks,
+    # budgets).  ``scale`` rebuilds the same entrypoint at population
+    # n' (the 10M-node narrowing-ledger hook).
+    bounds: Optional[Callable[[], Any]] = None
+    scale: Optional[Callable[[int], "SimProgram"]] = None
 
     def trace(self) -> Any:
         fn, args = self.build()
@@ -974,6 +982,240 @@ class SimProgram:
 
 def _abstract_key() -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# rangelint bound metadata: initial-value intervals per input plane,
+# derived from the config.  The abstract interpreter widens these to a
+# scan-carry fixpoint, so bounds describe the INIT (what the program is
+# handed), not the steady state (what rangelint proves).
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_bounds(cfg: BroadcastConfig):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.models.broadcast import BroadcastState
+
+        return (BroadcastState(
+            knows=Bound(0, 1),
+            tx_left=Bound(0, cfg.tx_limit),
+            tick=Bound(0, 0),
+        ), Bound.any())
+
+    return make
+
+
+def _membership_bounds(cfg: MembershipConfig):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.models.membership import NEVER, MembershipState
+
+        nv = int(NEVER)
+        return (MembershipState(
+            key=Bound(-1, 0),
+            suspect_since=Bound(nv, nv),
+            confirms=Bound(0, 0),
+            tx=Bound(0, 0),
+            own_inc=Bound(0, 0),
+            awareness=Bound(0, 0),
+            probe_pending_at=Bound(nv, nv),
+            probe_subject=Bound(0, 0),
+            tick=Bound(0, 0),
+        ), Bound.any())
+
+    return make
+
+
+def _sparse_bounds(cfg):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.models.membership import NEVER
+        from consul_tpu.models.membership_sparse import (
+            SparseMembershipState,
+        )
+
+        nv = int(NEVER)
+        n = cfg.base.n
+        return (SparseMembershipState(
+            slot_subj=Bound(-1, n - 1),
+            key=Bound(0, 0),
+            suspect_since=Bound(nv, nv),
+            confirms=Bound(0, 0),
+            tx=Bound(0, 0),
+            own_inc=Bound(0, 0),
+            awareness=Bound(0, 0),
+            probe_pending_at=Bound(nv, nv),
+            probe_subject=Bound(0, 0),
+            overflow=Bound(0, 0),
+            forgotten=Bound(0, 0),
+            tick=Bound(0, 0),
+        ), Bound.any())
+
+    return make
+
+
+def _swim_bounds(cfg: SwimConfig):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.models.swim import NEVER, SwimState
+
+        nv = int(NEVER)
+        z = Bound(0, 0)
+        return (SwimState(
+            view=z, inc_seen=z,
+            suspect_since=Bound(nv, nv),
+            confirmations=z, tx_suspect=z, sus_era=z, tx_dead=z,
+            dead_era=z, tx_refute=z, ref_era=z,
+            probe_pending_at=Bound(nv, nv),
+            awareness=z, subject_inc=z, tick=z,
+        ), Bound.any())
+
+    return make
+
+
+def _geo_bounds(cfg):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.geo.model import GeoState
+
+        return (GeoState(
+            knows=Bound(0, 1),
+            tx_lan=Bound(0, cfg.tx_limit_lan),
+            ring=Bound(0, 0),
+            queue=Bound(0, 0),
+            known_hist=Bound(0, 1),
+            ewma=Bound.any(),
+            wasted=Bound(0, 0),
+            tick=Bound(0, 0),
+        ), Bound.any())
+
+    return make
+
+
+def _streamcast_bounds(cfg):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.streamcast.model import StreamcastState
+
+        z = Bound(0, 0)
+        return (StreamcastState(
+            chunks=Bound(0, 1),
+            tx_left=z,
+            slot_event=Bound(-1, -1),
+            slot_birth=z,
+            offered=z, delivered=z, quiesced=z,
+            window_overflow=z, coalesced=z, tick=z,
+        ), Bound.any())
+
+    return make
+
+
+def _lifeguard_bounds(cfg):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.models.swim import NEVER, SwimState
+
+        nv = int(NEVER)
+        z = Bound(0, 0)
+        return (SwimState(
+            view=z, inc_seen=z,
+            suspect_since=Bound(nv, nv),
+            confirmations=z, tx_suspect=z, sus_era=z, tx_dead=z,
+            dead_era=z, tx_refute=z, ref_era=z,
+            probe_pending_at=Bound(nv, nv),
+            awareness=z, subject_inc=z, tick=z,
+        ), Bound.any())
+
+    return make
+
+
+def _multidc_bounds(cfg):
+    def make():
+        from consul_tpu.analysis.rangelint import Bound
+        from consul_tpu.models.multidc import MultiDCState
+
+        return (MultiDCState(
+            knows=Bound(0, 1),
+            tx_lan=Bound(0, cfg.tx_limit_lan),
+            tx_wan=Bound(0, cfg.tx_limit_wan),
+            tick=Bound(0, 0),
+        ), Bound.any())
+
+    return make
+
+
+def sparse_program_at(n: int, steps: int = 3,
+                      track: tuple = (42,)) -> SimProgram:
+    """The sparse membership entrypoint at population ``n`` — the
+    registry's ``scale`` hook, so rangelint's narrowing ledger reads
+    the certificate table against 10M nodes, not just the declared
+    configs.  Same K/loss/profile/fault shape as the big registry
+    entry; tracing stays abstract (eval_shape + make_jaxpr)."""
+    from consul_tpu.models.membership_sparse import (
+        SparseMembershipConfig,
+        sparse_membership_init,
+    )
+    from consul_tpu.protocol import LAN
+
+    cfg = SparseMembershipConfig(
+        base=MembershipConfig(n=n, loss=0.01, profile=LAN,
+                              fail_at=((42, 5),)),
+        k_slots=64,
+    )
+
+    def build():
+        state = jax.eval_shape(lambda: sparse_membership_init(cfg))
+        return (
+            lambda s, k: sparse_membership_scan(s, k, cfg, steps, track),
+            (state, _abstract_key()),
+        )
+
+    return SimProgram(
+        name=f"sparse@n={n}", entrypoint="sparse_membership_scan",
+        build=build, n=n, bounds=_sparse_bounds(cfg),
+    )
+
+
+def swim_program_at(n: int, steps: int = 450) -> SimProgram:
+    """The swim entrypoint at population ``n`` (scale hook twin of
+    :func:`sparse_program_at`)."""
+    from consul_tpu.protocol import WAN
+
+    cfg = SwimConfig(n=n, subject=42, loss=0.30, profile=WAN,
+                     delivery="aggregate")
+
+    def build():
+        state = jax.eval_shape(lambda: swim_init(cfg))
+        return (
+            lambda s, k: swim_scan(s, k, cfg, steps),
+            (state, _abstract_key()),
+        )
+
+    return SimProgram(
+        name=f"swim@n={n}", entrypoint="swim_scan", build=build, n=n,
+        bounds=_swim_bounds(cfg),
+    )
+
+
+def broadcast_program_at(n: int, steps: int = 60) -> SimProgram:
+    """The broadcast entrypoint at population ``n`` (scale hook)."""
+    from consul_tpu.protocol import LAN
+
+    cfg = BroadcastConfig(n=n, fanout=4, profile=LAN,
+                          delivery="aggregate")
+
+    def build():
+        state = jax.eval_shape(lambda: broadcast_init(cfg))
+        return (
+            lambda s, k: broadcast_scan(s, k, cfg, steps),
+            (state, _abstract_key()),
+        )
+
+    return SimProgram(
+        name=f"broadcast@n={n}", entrypoint="broadcast_scan",
+        build=build, n=n, bounds=_broadcast_bounds(cfg),
+    )
 
 
 def jaxlint_registry(include=("small", "big"),
@@ -1030,19 +1272,22 @@ def jaxlint_registry(include=("small", "big"),
                 lambda: broadcast_init(bcfg),
                 lambda s, k, ex=ex: sharded_broadcast_scan(
                     s, k, bcfg, bsteps, mesh, ex),
-                bcfg.n, devices=d, per_chip=True)
+                bcfg.n, devices=d, per_chip=True,
+                bounds=_broadcast_bounds(bcfg))
             add(f"sharded_membership@{tag}/D{d}{sfx}",
                 "sharded_membership_scan",
                 lambda: membership_init(mcfg),
                 lambda s, k, ex=ex: sharded_membership_scan(
                     s, k, mcfg, msteps, mesh, mtrack, ex),
-                mcfg.n, devices=d, per_chip=True)
+                mcfg.n, devices=d, per_chip=True,
+                bounds=_membership_bounds(mcfg))
             add(f"sharded_sparse@{tag}/D{d}{sfx}",
                 "sharded_sparse_membership_scan",
                 lambda: sparse_membership_init(scfg),
                 lambda s, k, ex=ex: sharded_sparse_membership_scan(
                     s, k, scfg, ssteps, mesh, strack, ex),
-                scfg.base.n, devices=d, per_chip=True)
+                scfg.base.n, devices=d, per_chip=True,
+                bounds=_sparse_bounds(scfg))
 
     from consul_tpu.streamcast.model import (
         StreamcastConfig,
@@ -1061,7 +1306,8 @@ def jaxlint_registry(include=("small", "big"),
                 lambda: streamcast_init(stcfg),
                 lambda s, k, ex=ex: sharded_streamcast_scan(
                     s, k, stcfg, ststeps, mesh, ex),
-                stcfg.n, devices=d, per_chip=True)
+                stcfg.n, devices=d, per_chip=True,
+                bounds=_streamcast_bounds(stcfg))
 
     from consul_tpu.geo.model import GeoConfig, geo_init
 
@@ -1077,7 +1323,8 @@ def jaxlint_registry(include=("small", "big"),
                 lambda: geo_init(gcfg),
                 lambda s, k, ex=ex: sharded_geo_scan(
                     s, k, gcfg, gsteps, mesh, ex),
-                gcfg.n, devices=d, per_chip=True)
+                gcfg.n, devices=d, per_chip=True,
+                bounds=_geo_bounds(gcfg))
 
     if "small" in include:
         mcfg = MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),))
@@ -1091,26 +1338,32 @@ def jaxlint_registry(include=("small", "big"),
                                  names=3, loss=0.05, delivery="edges")
         add("broadcast@small", "broadcast_scan",
             lambda: broadcast_init(bcfg),
-            lambda s, k: broadcast_scan(s, k, bcfg, 8), bcfg.n)
+            lambda s, k: broadcast_scan(s, k, bcfg, 8), bcfg.n,
+            bounds=_broadcast_bounds(bcfg))
         add("membership@small", "membership_scan",
             lambda: membership_init(mcfg),
-            lambda s, k: membership_scan(s, k, mcfg, 8, (3,)), mcfg.n)
+            lambda s, k: membership_scan(s, k, mcfg, 8, (3,)), mcfg.n,
+            bounds=_membership_bounds(mcfg))
         add("sparse@small", "sparse_membership_scan",
             lambda: sparse_membership_init(scfg),
             lambda s, k: sparse_membership_scan(s, k, scfg, 8, (3,)),
-            mcfg.n)
+            mcfg.n, bounds=_sparse_bounds(scfg))
         add("swim@small", "swim_scan",
             lambda: swim_init(swcfg),
-            lambda s, k: swim_scan(s, k, swcfg, 8), swcfg.n)
+            lambda s, k: swim_scan(s, k, swcfg, 8), swcfg.n,
+            bounds=_swim_bounds(swcfg))
         add("lifeguard@small", "lifeguard_scan",
             lambda: lifeguard_init(lgcfg),
-            lambda s, k: lifeguard_scan(s, k, lgcfg, 8), lgcfg.n)
+            lambda s, k: lifeguard_scan(s, k, lgcfg, 8), lgcfg.n,
+            bounds=_lifeguard_bounds(lgcfg))
         add("multidc@small", "multidc_scan",
             lambda: multidc_init(mdcfg),
-            lambda s, k: multidc_scan(s, k, mdcfg, 8), mdcfg.n)
+            lambda s, k: multidc_scan(s, k, mdcfg, 8), mdcfg.n,
+            bounds=_multidc_bounds(mdcfg))
         add("streamcast@small", "streamcast_scan",
             lambda: streamcast_init(stcfg),
-            lambda s, k: streamcast_scan(s, k, stcfg, 8), stcfg.n)
+            lambda s, k: streamcast_scan(s, k, stcfg, 8), stcfg.n,
+            bounds=_streamcast_bounds(stcfg))
         gecfg = GeoConfig(n=64, segments=8, bridges_per_segment=2,
                           events=4, wan_window=4, wan_msg_bytes=100,
                           wan_capacity_bytes=800.0,
@@ -1118,7 +1371,8 @@ def jaxlint_registry(include=("small", "big"),
                           loss_wan=0.05)
         add("geo@small", "geo_scan",
             lambda: geo_init(gecfg),
-            lambda s, k: geo_scan(s, k, gecfg, 8), gecfg.n)
+            lambda s, k: geo_scan(s, k, gecfg, 8), gecfg.n,
+            bounds=_geo_bounds(gecfg))
         for d in sharded_devices:
             add_sharded_geo("small", d, gecfg, 8,
                             exchanges=("alltoall", "ring"))
@@ -1139,28 +1393,33 @@ def jaxlint_registry(include=("small", "big"),
         # the emission is transport-independent).
         add("broadcast@small/telemetry", "broadcast_scan",
             lambda: broadcast_init(bcfg),
-            lambda s, k: broadcast_scan(s, k, bcfg, 8, True), bcfg.n)
+            lambda s, k: broadcast_scan(s, k, bcfg, 8, True), bcfg.n,
+            bounds=_broadcast_bounds(bcfg))
         add("membership@small/telemetry", "membership_scan",
             lambda: membership_init(mcfg),
             lambda s, k: membership_scan(s, k, mcfg, 8, (3,), True),
-            mcfg.n)
+            mcfg.n, bounds=_membership_bounds(mcfg))
         add("sparse@small/telemetry", "sparse_membership_scan",
             lambda: sparse_membership_init(scfg),
             lambda s, k: sparse_membership_scan(
                 s, k, scfg, 8, (3,), True),
-            mcfg.n)
+            mcfg.n, bounds=_sparse_bounds(scfg))
         add("swim@small/telemetry", "swim_scan",
             lambda: swim_init(swcfg),
-            lambda s, k: swim_scan(s, k, swcfg, 8, True), swcfg.n)
+            lambda s, k: swim_scan(s, k, swcfg, 8, True), swcfg.n,
+            bounds=_swim_bounds(swcfg))
         add("lifeguard@small/telemetry", "lifeguard_scan",
             lambda: lifeguard_init(lgcfg),
-            lambda s, k: lifeguard_scan(s, k, lgcfg, 8, True), lgcfg.n)
+            lambda s, k: lifeguard_scan(s, k, lgcfg, 8, True), lgcfg.n,
+            bounds=_lifeguard_bounds(lgcfg))
         add("streamcast@small/telemetry", "streamcast_scan",
             lambda: streamcast_init(stcfg),
-            lambda s, k: streamcast_scan(s, k, stcfg, 8, True), stcfg.n)
+            lambda s, k: streamcast_scan(s, k, stcfg, 8, True), stcfg.n,
+            bounds=_streamcast_bounds(stcfg))
         add("geo@small/telemetry", "geo_scan",
             lambda: geo_init(gecfg),
-            lambda s, k: geo_scan(s, k, gecfg, 8, True), gecfg.n)
+            lambda s, k: geo_scan(s, k, gecfg, 8, True), gecfg.n,
+            bounds=_geo_bounds(gecfg))
         for d in sharded_devices:
             if d > len(jax.devices()):
                 continue
@@ -1170,31 +1429,36 @@ def jaxlint_registry(include=("small", "big"),
                 lambda: broadcast_init(bcfg),
                 lambda s, k, m=mesh_t: sharded_broadcast_scan(
                     s, k, bcfg, 8, m, "alltoall", True),
-                bcfg.n, devices=d, per_chip=True)
+                bcfg.n, devices=d, per_chip=True,
+                bounds=_broadcast_bounds(bcfg))
             add(f"sharded_membership@small/D{d}/telemetry",
                 "sharded_membership_scan",
                 lambda: membership_init(mcfg),
                 lambda s, k, m=mesh_t: sharded_membership_scan(
                     s, k, mcfg, 8, m, (3,), "alltoall", True),
-                mcfg.n, devices=d, per_chip=True)
+                mcfg.n, devices=d, per_chip=True,
+                bounds=_membership_bounds(mcfg))
             add(f"sharded_sparse@small/D{d}/telemetry",
                 "sharded_sparse_membership_scan",
                 lambda: sparse_membership_init(scfg),
                 lambda s, k, m=mesh_t: sharded_sparse_membership_scan(
                     s, k, scfg, 8, m, (3,), "alltoall", True),
-                scfg.base.n, devices=d, per_chip=True)
+                scfg.base.n, devices=d, per_chip=True,
+                bounds=_sparse_bounds(scfg))
             add(f"sharded_streamcast@small/D{d}/telemetry",
                 "sharded_streamcast_scan",
                 lambda: streamcast_init(stcfg),
                 lambda s, k, m=mesh_t: sharded_streamcast_scan(
                     s, k, stcfg, 8, m, "alltoall", True),
-                stcfg.n, devices=d, per_chip=True)
+                stcfg.n, devices=d, per_chip=True,
+                bounds=_streamcast_bounds(stcfg))
             add(f"sharded_geo@small/D{d}/telemetry",
                 "sharded_geo_scan",
                 lambda: geo_init(gecfg),
                 lambda s, k, m=mesh_t: sharded_geo_scan(
                     s, k, gecfg, 8, m, "alltoall", True),
-                gecfg.n, devices=d, per_chip=True)
+                gecfg.n, devices=d, per_chip=True,
+                bounds=_geo_bounds(gecfg))
     if "big" in include:
         # The north-star shapes bench.py measures: 1M nodes for the
         # per-node-plane models (dense membership capped at its 16k
@@ -1217,23 +1481,29 @@ def jaxlint_registry(include=("small", "big"),
                                   profile=WAN)
         add("broadcast@1m", "broadcast_scan",
             lambda: broadcast_init(bcfg1m),
-            lambda s, k: broadcast_scan(s, k, bcfg1m, 60), bcfg1m.n)
+            lambda s, k: broadcast_scan(s, k, bcfg1m, 60), bcfg1m.n,
+            bounds=_broadcast_bounds(bcfg1m),
+            scale=broadcast_program_at)
         add("membership@16k", "membership_scan",
             lambda: membership_init(mcfg1m),
             lambda s, k: membership_scan(s, k, mcfg1m, 30, (42,)),
             mcfg1m.n,
+            bounds=_membership_bounds(mcfg1m),
             note="dense [n, n] ceiling: n >= 1e5 belongs to the sparse "
                  "model")
         add("sparse@1m", "sparse_membership_scan",
             lambda: sparse_membership_init(scfg1m),
             lambda s, k: sparse_membership_scan(s, k, scfg1m, 3, (42,)),
-            scfg1m.base.n)
+            scfg1m.base.n, bounds=_sparse_bounds(scfg1m),
+            scale=sparse_program_at)
         add("swim@1m", "swim_scan",
             lambda: swim_init(swcfg1m),
-            lambda s, k: swim_scan(s, k, swcfg1m, 450), swcfg1m.n)
+            lambda s, k: swim_scan(s, k, swcfg1m, 450), swcfg1m.n,
+            bounds=_swim_bounds(swcfg1m), scale=swim_program_at)
         add("lifeguard@1m", "lifeguard_scan",
             lambda: lifeguard_init(lgcfg1m),
-            lambda s, k: lifeguard_scan(s, k, lgcfg1m, 160), lgcfg1m.n)
+            lambda s, k: lifeguard_scan(s, k, lgcfg1m, 160), lgcfg1m.n,
+            bounds=_lifeguard_bounds(lgcfg1m))
         # The sustained-load workload at the north-star scale: 1M nodes,
         # 4-chunk events pipelined through an 8-slot window, Poisson
         # offered load — bench.py's streaming section shapes.
@@ -1245,7 +1515,7 @@ def jaxlint_registry(include=("small", "big"),
         add("streamcast@1m", "streamcast_scan",
             lambda: streamcast_init(stcfg1m),
             lambda s, k: streamcast_scan(s, k, stcfg1m, 150),
-            stcfg1m.n)
+            stcfg1m.n, bounds=_streamcast_bounds(stcfg1m))
         # The geo/WAN plane at the north-star scale: 1M nodes over 8
         # DCs, 16 concurrent events, bandwidth-capped Vivaldi-latency
         # links — bench.py's "geo" section shapes.
@@ -1257,7 +1527,8 @@ def jaxlint_registry(include=("small", "big"),
                             loss_wan=0.05)
         add("geo@1m", "geo_scan",
             lambda: geo_init(gecfg1m),
-            lambda s, k: geo_scan(s, k, gecfg1m, 60), gecfg1m.n)
+            lambda s, k: geo_scan(s, k, gecfg1m, 60), gecfg1m.n,
+            bounds=_geo_bounds(gecfg1m))
         d = max(
             (d for d in sharded_devices if d <= len(jax.devices())),
             default=0,

@@ -46,7 +46,7 @@ class _Sample:
         div = float(self.count * (self.count - 1))
         return math.sqrt(num / div) if num > 0 else 0.0
 
-    def snapshot(self, name: str) -> dict:
+    def snapshot(self, name: str, labels: Optional[dict] = None) -> dict:
         """The reference InmemSink DisplayMetrics SampledValue shape
         (inmem_endpoint.go): aggregate stats + the Labels map."""
         mean = self.total / self.count if self.count else 0.0
@@ -58,30 +58,51 @@ class _Sample:
             "Max": round(self.max, 6) if self.count else 0.0,
             "Mean": round(mean, 6),
             "Stddev": round(self.stddev(), 6),
-            "Labels": {},
+            "Labels": dict(labels or {}),
         }
 
 
+def _key(name: str, labels: Optional[dict]) -> tuple:
+    """Registry key: metric name + frozen label set (go-metrics keys
+    its inmem intervals the same way — name x label values)."""
+    if not labels:
+        return (name, ())
+    return (name, tuple(sorted((str(k), str(v))
+                               for k, v in labels.items())))
+
+
 class Metrics:
-    """go-metrics InmemSink: aggregated counters/gauges/timers."""
+    """go-metrics InmemSink: aggregated counters/gauges/timers.
+
+    ``labels`` (a str->str map, e.g. ``{"universe": "3"}`` from the
+    per-universe sweep bridge) key separate series under the same
+    metric name and come back in the snapshot's ``Labels`` maps —
+    the reference DisplayMetrics shape."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: dict[str, _Sample] = {}
-        self._gauges: dict[str, float] = {}
-        self._samples: dict[str, _Sample] = {}
+        self._counters: dict[tuple, _Sample] = {}
+        self._gauges: dict[tuple, float] = {}
+        self._samples: dict[tuple, _Sample] = {}
 
-    def incr_counter(self, name: str, value: float = 1.0) -> None:
+    def incr_counter(self, name: str, value: float = 1.0,
+                     labels: Optional[dict] = None) -> None:
         with self._lock:
-            self._counters.setdefault(name, _Sample()).add(value)
+            self._counters.setdefault(
+                _key(name, labels), _Sample()
+            ).add(value)
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[dict] = None) -> None:
         with self._lock:
-            self._gauges[name] = value
+            self._gauges[_key(name, labels)] = value
 
-    def add_sample(self, name: str, value: float) -> None:
+    def add_sample(self, name: str, value: float,
+                   labels: Optional[dict] = None) -> None:
         with self._lock:
-            self._samples.setdefault(name, _Sample()).add(value)
+            self._samples.setdefault(
+                _key(name, labels), _Sample()
+            ).add(value)
 
     def measure_since(self, name: str, start: float) -> None:
         """metrics.MeasureSince: elapsed milliseconds since ``start``
@@ -99,25 +120,29 @@ class Metrics:
                 # DisplayMetrics shape (inmem_endpoint.go) — emitted
                 # (empty) so consumers see the exact JSON schema.
                 "Gauges": [
-                    {"Name": k, "Value": v, "Labels": {}}
+                    {"Name": k[0], "Value": v, "Labels": dict(k[1])}
                     for k, v in sorted(self._gauges.items())
                 ],
                 "Counters": [
-                    s.snapshot(k) for k, s in sorted(self._counters.items())
+                    s.snapshot(k[0], dict(k[1]))
+                    for k, s in sorted(self._counters.items())
                 ],
                 "Samples": [
-                    s.snapshot(k) for k, s in sorted(self._samples.items())
+                    s.snapshot(k[0], dict(k[1]))
+                    for k, s in sorted(self._samples.items())
                 ],
             }
 
-    def get_counter(self, name: str) -> int:
+    def get_counter(self, name: str,
+                    labels: Optional[dict] = None) -> int:
         with self._lock:
-            s = self._counters.get(name)
+            s = self._counters.get(_key(name, labels))
             return s.count if s else 0
 
-    def get_gauge(self, name: str) -> Optional[float]:
+    def get_gauge(self, name: str,
+                  labels: Optional[dict] = None) -> Optional[float]:
         with self._lock:
-            return self._gauges.get(name)
+            return self._gauges.get(_key(name, labels))
 
     def reset(self) -> None:
         with self._lock:

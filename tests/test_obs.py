@@ -356,6 +356,39 @@ class TestBridge:
         m2.add_sample("one", 3.0)
         assert m2.snapshot()["Samples"][0]["Stddev"] == 0.0
 
+    def test_sweep_trace_bridges_per_universe_with_labels(self):
+        # The PR-10 leftover closed: a whole-sweep [U, steps, M] trace
+        # bridges in ONE call, universe index as a metric Label, each
+        # universe its own series under the reference names.
+        u2 = Universe(entrypoint="broadcast", cfg=BCFG, steps=STEPS,
+                      seeds=(0, 1))
+        rep = run_sweep(u2, warmup=False, telemetry=True)
+        sink = bridge_report("broadcast", rep, Metrics())
+        snap = sink.snapshot()
+        trace = rep.metrics_trace
+        for u in (0, 1):
+            labels = {"universe": str(u)}
+            for j, spec in enumerate(METRIC_SPECS["broadcast"]):
+                col = trace[u, :, j]
+                if spec.kind == "counter":
+                    assert sink.get_counter(
+                        spec.name, labels=labels
+                    ) == STEPS
+                else:
+                    assert sink.get_gauge(
+                        spec.name, labels=labels
+                    ) == float(col[-1])
+        # The snapshot carries the Labels maps (DisplayMetrics shape).
+        labelled = [g for g in snap["Gauges"]
+                    if g["Labels"].get("universe") in ("0", "1")]
+        assert labelled
+        # Per-universe series are DISTINCT when the universes diverge.
+        g0 = {g["Name"]: g["Value"] for g in snap["Gauges"]
+              if g["Labels"].get("universe") == "0"}
+        g1 = {g["Name"]: g["Value"] for g in snap["Gauges"]
+              if g["Labels"].get("universe") == "1"}
+        assert set(g0) == set(g1)
+
     def test_bad_trace_and_missing_trace_rejected_loudly(self):
         with pytest.raises(ValueError, match="expected a"):
             bridge_trace("swim", np.zeros((4, 3), np.float32),

@@ -254,6 +254,39 @@ import jax
 def f(x):
     return x.at[0].set(1.0)
 """),
+    # R9: the kw/positional jit-cache gotcha — a static flag of a
+    # module-level jitted twin passed by keyword (directly or through
+    # functools.partial) mints a second compiled program alongside the
+    # positional call sites.
+    ("R9", """
+import functools
+import jax
+
+def _impl(state, key, cfg, steps: int, telemetry: bool = False):
+    return state
+
+my_scan = jax.jit(_impl, static_argnames=("cfg", "steps", "telemetry"))
+
+def run(state, key, cfg):
+    out = my_scan(state, key, cfg, steps=8)
+    part = functools.partial(my_scan, telemetry=True)
+    return out, part
+""", """
+import jax
+
+def _impl(state, key, cfg, steps: int, telemetry: bool = False):
+    return state
+
+my_scan = jax.jit(_impl, static_argnames=("cfg", "steps", "telemetry"))
+
+def run(state, key, cfg):
+    out = my_scan(state, key, cfg, 8)
+
+    def part(st, k, c):  # positional statics: one program per shape
+        return my_scan(st, k, c, 8, True)
+
+    return out, part
+"""),
 ]
 
 
