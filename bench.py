@@ -490,32 +490,114 @@ def main() -> None:
     # ~200 GB; the slot representation fits one chip.  overflow == 0
     # certifies the run dropped nothing (exactness ladder in the module
     # docstring).
+    def _sparse_steady_state(mcfg, dead: int, tick: int = 200):
+        """The converged post-detection state of the fail-at study:
+        every live observer holds {self, dead@0-DEAD}, gossip has
+        quiesced (tx == 0), no timers pending.  Starting here measures
+        the amortized kernel's STEADY-STATE tick — the regime the
+        sorted-row invariant is amortized for — without paying the
+        multi-minute convergence wave first."""
+        import jax.numpy as jnp
+
+        from consul_tpu.models.membership import (
+            NEVER,
+            RANK_DEAD,
+            make_key,
+        )
+        from consul_tpu.models.membership_sparse import (
+            AGE_NONE,
+            AWARE_DTYPE,
+            CONF_DTYPE,
+            SINCE_DTYPE,
+            TX_DTYPE,
+            SparseMembershipState,
+        )
+
+        n, K = mcfg.base.n, mcfg.k_slots
+        ids = jnp.arange(n, dtype=jnp.int32)
+        lo = jnp.minimum(ids, dead)
+        hi = jnp.maximum(ids, dead)
+        slot_subj = jnp.full((n, K), -1, jnp.int32)
+        slot_subj = slot_subj.at[:, 0].set(lo)
+        slot_subj = slot_subj.at[:, 1].set(
+            jnp.where(ids == dead, -1, hi)
+        )
+        dead_key = jnp.int32(make_key(0, RANK_DEAD))
+        key = jnp.zeros((n, K), jnp.int32)
+        key = key.at[:, 1].set(
+            jnp.where((hi == dead) & (ids != dead), dead_key, 0)
+        )
+        key = key.at[:, 0].set(
+            jnp.where((lo == dead) & (ids != dead), dead_key, 0)
+        )
+        return SparseMembershipState(
+            slot_subj=slot_subj,
+            key=key,
+            suspect_since=jnp.full((n, K), AGE_NONE, SINCE_DTYPE),
+            confirms=jnp.zeros((n, K), CONF_DTYPE),
+            tx=jnp.zeros((n, K), TX_DTYPE),
+            own_inc=jnp.zeros((n,), jnp.int32),
+            awareness=jnp.zeros((n,), AWARE_DTYPE),
+            probe_pending_at=jnp.full((n,), NEVER, jnp.int32),
+            probe_subject=jnp.zeros((n,), jnp.int32),
+            overflow=jnp.int32(0),
+            forgotten=jnp.int32(0),
+            tick=jnp.int32(tick),
+        )
+
     def _sparse_100k():
         try:
+            import jax as _jax
+
             from consul_tpu.models import SparseMembershipConfig
             from consul_tpu.models.membership import MembershipConfig
             from consul_tpu.sim import run_membership_sparse
+            from consul_tpu.sim.engine import sparse_membership_scan
 
             mcfg = SparseMembershipConfig(
                 base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,
                                       fail_at=((42, 5),)),
                 k_slots=64,
             )
-            mreport, moverflow = run_membership_sparse(
-                mcfg, steps=30, track=(42,), warmup=False
-            )
             out = {
                 "membership_sparse_n": 100_000,
                 "membership_sparse_k": 64,
-                "membership_sparse_rounds_per_sec": round(
-                    mreport.rounds_per_sec, 2),
-                "membership_sparse_overflow": int(moverflow),
             }
+            # HEADLINE: steady-state rounds/s from the converged
+            # post-detection state (amortized invariant: no slot
+            # allocations, so every tick rides the sort-free fast
+            # branch).  One warmup scan compiles + drains any residual
+            # transient; the second identical program is timed.
+            steps = 8
+            st = _sparse_steady_state(mcfg, dead=42)
+            st, _ = sparse_membership_scan(
+                st, _jax.random.PRNGKey(1), mcfg, steps, (42,)
+            )
+            _jax.block_until_ready(st)
+            t0 = time.perf_counter()
+            st, souts = sparse_membership_scan(
+                st, _jax.random.PRNGKey(2), mcfg, steps, (42,)
+            )
+            _jax.block_until_ready(souts)
+            steady_s = (time.perf_counter() - t0) / steps
+            out["membership_sparse_rounds_per_sec"] = round(
+                1.0 / steady_s, 3)
+            out["membership_sparse_steady_overflow"] = int(st.overflow)
+            # Continuity datapoint: the legacy cold 30-tick run from
+            # scratch (detection wave included — allocation ticks pay
+            # the lex-sort, so this is the kernel's WORST regime).
+            mreport, moverflow = run_membership_sparse(
+                mcfg, steps=30, track=(42,), warmup=False
+            )
+            out["membership_sparse_cold_rounds_per_sec"] = round(
+                mreport.rounds_per_sec, 2)
+            out["membership_sparse_overflow"] = int(moverflow)
             try:
-                # Merge-kernel vs emit/probe split of one round (the
-                # sort-merge kernel timed standalone at identical
+                # Merge-kernel vs emit/probe split of one ALLOCATION
+                # round (synthetic half-unseated stream forces the
+                # slow branch; the kernel timed standalone at round
                 # shapes).  Own guard: a diagnostic failure must not
-                # discard the headline sparse metric measured above.
+                # discard the headline metrics measured above.
                 out.update(
                     _sparse_phase_times(mcfg, mreport.rounds_per_sec)
                 )
@@ -572,6 +654,62 @@ def main() -> None:
         return out
 
     membership.update(section("membership_sparse_1m", _sparse_1m, {}))
+
+    # The 10M-nodes-per-chip capacity claim, read ABSTRACTLY (zero
+    # device memory: eval_shape traces + the J6 live-buffer estimator
+    # + the rangelint interval ledger) — the v5e 16 GB gate PR 12's
+    # narrowing/packing targets — plus the measured flops delta of the
+    # amortized sort-merge kernel at 1M via the obs profile harness.
+    def _sparse_capacity():
+        out = {}
+        try:
+            import jax as _jax
+
+            from consul_tpu.analysis.jaxlint import estimate_peak
+            from consul_tpu.analysis.rangelint import narrowing_ledger
+            from consul_tpu.sim.engine import sparse_program_at
+
+            for nn, tag in ((1_000_000, "1m"), (10_000_000, "10m")):
+                spec = sparse_program_at(nn)
+                fn, args = spec.build()
+                pk = estimate_peak(_jax.make_jaxpr(fn)(*args))
+                out[f"sparse_{tag}_j6_peak_gib"] = round(
+                    pk.total_bytes / 2**30, 3)
+            out["sparse_10m_j6_budget_gib"] = 16
+            from consul_tpu.sim.engine import jaxlint_registry as _reg
+
+            led = narrowing_ledger(
+                _reg(include=("big",))["sparse@1m"], 10_000_000
+            )
+            out["sparse_10m_rangelint_findings"] = len(led.findings)
+            out["sparse_10m_certified_dtypes"] = {
+                c.plane.replace("[0].", ""): c.dtype
+                for c in led.certificates
+                if c.plane in ("[0].tx", "[0].confirms",
+                               "[0].awareness", "[0].suspect_since")
+            }
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            out["sparse_capacity_error"] = str(e)[:200]
+        try:
+            from consul_tpu.obs.profile import profile_program
+            from consul_tpu.sim.engine import jaxlint_registry
+
+            prog = jaxlint_registry(include=("big",))["sparse@1m"]
+            pf = profile_program(prog)
+            # Baseline = the PR 10/11 obs-ledger reading of the same
+            # program (full lex-sort + two argsort re-sorts per tick).
+            # A PINNED historical constant, not re-measured here — the
+            # key name says so; only flops_per_program is live.
+            out["sparse_1m_flops_pr11_baseline_pinned"] = 56.4e9
+            out["sparse_1m_flops_per_program"] = pf.flops
+            out["sparse_1m_bytes_accessed"] = pf.bytes_accessed
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            out["sparse_flops_error"] = str(e)[:200]
+        return out
+
+    membership.update(
+        section("sparse_capacity_10m", _sparse_capacity, {})
+    )
 
     # Lifeguard accuracy A/B at the headline scale: degraded1m (2%
     # degraded members, WAN ack tail) at a reduced tick count so bench
@@ -828,6 +966,11 @@ def main() -> None:
                 hard = t_start + budget_s
                 deadline = min(deadline or hard, hard)
             programs = jaxlint_registry(include=("big",))
+            # sparse@10m is an ABSTRACT-ONLY capacity gate (its own
+            # "sparse_capacity_10m" section reads it through J6 +
+            # rangelint): compiling or executing it here would burn
+            # the obs budget on a program that must never run in CI.
+            programs.pop("sparse@10m", None)
             order = sorted(
                 programs,
                 key=lambda k: (

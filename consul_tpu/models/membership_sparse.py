@@ -17,14 +17,21 @@ Exactness ladder (each level counted, nothing silent):
         remembered incarnation, which the next push/pull or gossip
         about the subject re-teaches.  Active state — suspicions,
         queued retransmits, confirmations — is never evicted.
-  overflow > 0    something countable was dropped — two causes with
-        DISTINCT remedies: (a) urgent news found no claimable slot
-        (the sender's remaining retransmit budget is the retry; a
-        study whose overflow grows this way needs a bigger K), or
-        (b) more push/pull initiators fired in one tick than the
-        compacted exchange's static budget (``pp_initiator_budget``,
-        8x the Poissonized mean — a function of n and push_pull_ticks,
-        NOT of K; the Poissonized schedule retries next interval).
+  overflow > 0    something countable was dropped OR deferred — three
+        causes with DISTINCT remedies: (a) urgent news found no
+        claimable slot (the sender's remaining retransmit budget is
+        the retry; a study whose overflow grows this way needs a
+        bigger K); (b) more push/pull initiators fired in one tick
+        than the compacted exchange's static budget
+        (``pp_initiator_budget``, 8x the Poissonized mean; the
+        Poissonized schedule retries next interval); (c) more gossip
+        SENDERS held live messages than the compacted emission budget
+        (``gossip_sender_budget``, n/4) — a pure DEFERRAL: unselected
+        senders spend no retransmit budget and retry every tick until
+        selected, so heavy waves stretch over more ticks but lose
+        nothing.  (b) and (c) never fire at n <= 2048-ish configs
+        (budgets clamp to full width), where overflow == 0 keeps the
+        strict bit-exactness reading.
 With K == n and the identity slot layout the per-tick computation
 consumes the SAME random draws in the SAME shapes as
 ``membership_round``, so tests/test_membership_sparse.py pins
@@ -37,8 +44,11 @@ hashmap IS sparse; this is its SPMD analogue):
                 eviction = overwriting slot_subj.  Every row stays
                 SORTED ascending by subject id (empties last) — the
                 sorted-row invariant ``ops/sortmerge.py`` locates
-                against; claims land out of place and each round
-                re-sorts the touched planes to restore it.
+                against.  The invariant AMORTIZES across ticks: a
+                steady-state tick (no slot allocated anywhere) never
+                sorts anything, and allocation ticks restore it with
+                bounded direct-position merges/insertions instead of
+                full-row argsorts (merge_into_rows/insert_rows_one).
   deliveries    all inbound news (gossip scatters + push/pull row
                 merges, the latter compacted to a static initiator
                 budget so the stream tracks real traffic, not n·K
@@ -81,34 +91,84 @@ from consul_tpu.models.membership import (
 )
 from consul_tpu.ops import (
     bernoulli_mask,
-    merge_deliveries,
+    merge_into_rows,
     row_locate,
     sample_peers,
     sample_probe_targets,
-    sort_slot_rows,
 )
+from consul_tpu.ops.sortmerge import _row_blocks
 
 DEFAULT_KEY = 0  # make_key(0, RANK_ALIVE): the steady-state cell
 
 # Certified narrowings (rangelint J7, consul_tpu/analysis/rangelint.py):
-# the interval analysis proves the carried value ranges of two slot
-# planes from config bounds, so they ship narrow and the [n, K] state
-# drops 5 bytes/cell (int32 -> int8 + int16):
+# the interval analysis proves the carried value ranges of these planes
+# from config bounds, so they ship narrow and the [n, K] state drops
+# 7 bytes/cell against the original all-int32 layout:
 #   confirms  in [0, confirmations_k] (suspicion_mult - 2, single
 #             digits for every profile) — int8 with orders of headroom;
 #   tx        in [0, tx_limit] = retransmit_mult * ceil(log10(n + 1))
 #             (< 100 even at n = 10M), transient dips to -fanout during
-#             the budget spend before the maximum(., 0) clamp — int16
-#             rather than the certificate-minimal int8 purely for
-#             headroom on exotic retransmit_mult configs (guarded in
-#             SparseMembershipConfig.__post_init__).
+#             the budget spend before the maximum(., 0) clamp — int8,
+#             the certificate-minimal dtype (__post_init__ rejects
+#             exotic retransmit_mult configs past the bound, loudly);
+#   awareness in [0, awareness_max_multiplier - 1] (< 10 for every
+#             profile) — int8; widened to int32 before the one place
+#             it multiplies into tick arithmetic (probe deadlines);
+#   suspect_since — the SENTINEL-PACKED plane: the absolute-tick
+#             encoding needs int32 purely to carry the NEVER sentinel
+#             (rangelint's certificate table: "sentinel redesign, not
+#             narrowing").  Stored here as the suspicion AGE instead:
+#             -1 = no timer, else ticks since the suspicion started,
+#             saturating at AGE_CAP — int16.  The age is what every
+#             consumer actually wants (expiry compares elapsed time),
+#             and :func:`densify` reconstructs the absolute tick
+#             exactly as ``tick - age`` while the timer is younger
+#             than AGE_CAP (suspicion timeouts are orders of magnitude
+#             below it; __post_init__ guards static configs).
 # All in-round arithmetic on these planes stays dtype-preserving so the
 # scan carry round-trips; cross-plane math (merge precedence, timeout
 # scaling) never mixes them into wider lanes.
 CONF_DTYPE = jnp.int8
-TX_DTYPE = jnp.int16
+TX_DTYPE = jnp.int8
+AWARE_DTYPE = jnp.int8
+SINCE_DTYPE = jnp.int16
+
+# suspect_since sentinel/saturation (see the packing note above).  A
+# timer only saturates on a NON-participating observer (crashed rows
+# never expire their suspicions); participating timers expire at their
+# suspicion timeout, guarded far below AGE_CAP.
+AGE_NONE = -1
+AGE_CAP = 32000
 
 _CHUNK = 1 << 18  # chunk for _scan_chunks: bounds per-chunk temps
+
+# Max arrivals one delivery-kernel call may see before the round
+# switches to the chunked driver (_deliver_chunked).  2^25 keeps every
+# config through n = 1M on the single-call path (bit-identical
+# trajectories, exact group-level accounting); past the trigger the
+# driver sizes chunks at _CHUNK_TARGET arrivals, bounding the 10M-node
+# program's stream temps at ~0.2 GB/chunk instead of ~7 GB — the J6
+# capacity gate rides this.
+_CHUNK_A = 1 << 25
+_CHUNK_TARGET = 1 << 23
+
+# Allocation-substream budget handed to the merge kernel: claims per
+# tick are bounded by the news actually spreading (a cluster-wide wave
+# allocates ~one subject per row, deduplicated), so 64k claim slots
+# cover every realistic tick; misses drop LOUDLY into ``overflow`` and
+# the sender's retransmit budget retries them next tick.  Streams
+# smaller than the budget run exact (the kernel clamps B to A).
+_ALLOC_BUDGET = 1 << 16
+
+
+def _chunk_count(total: int, n_rows: int) -> int:
+    """Chunks needed to keep per-chunk arrivals near _CHUNK_TARGET,
+    preferring a divisor of ``n_rows`` (no padded source copies)."""
+    c_min = max(1, -(-total // _CHUNK_TARGET))
+    for c in range(c_min, min(4 * c_min + 1, n_rows)):
+        if n_rows % c == 0:
+            return c
+    return c_min
 
 # Loud-accounting counters saturate here instead of wrapping: a counter
 # that wraps past int32 reads as small-or-zero — the one silent failure
@@ -151,16 +211,34 @@ class SparseMembershipConfig:
                 f"confirmations_k {self.base.confirmations_k} exceeds the "
                 f"certified {CONF_DTYPE.__name__} confirms plane"
             )
+        amax = self.base.profile.awareness_max_multiplier
+        if amax > jnp.iinfo(AWARE_DTYPE).max:
+            raise ValueError(
+                f"awareness_max_multiplier {amax} exceeds the certified "
+                f"{AWARE_DTYPE.__name__} awareness plane"
+            )
+        # The age-packed suspect_since plane saturates at AGE_CAP: a
+        # PARTICIPATING timer must expire well before that.  Traced
+        # suspicion_scale knobs (universe sweeps) bypass this static
+        # check — the sweep presets stay orders of magnitude under it.
+        hi = self.base.suspicion_bounds_ticks[1]
+        if isinstance(hi, (int, float)) and hi >= AGE_CAP:
+            raise ValueError(
+                f"suspicion timeout bound {hi:.0f} ticks exceeds the "
+                f"age-packed suspect_since saturation AGE_CAP={AGE_CAP}"
+            )
 
 
 class SparseMembershipState(NamedTuple):
     slot_subj: jax.Array        # int32[n, K] — subject ids, -1 empty
     key: jax.Array              # int32[n, K]
-    suspect_since: jax.Array    # int32[n, K]
+    suspect_since: jax.Array    # SINCE_DTYPE[n, K] — suspicion AGE in
+    #   ticks (-1 none, saturates at AGE_CAP): the sentinel-packed
+    #   encoding of the absolute-tick plane (narrowing note above)
     confirms: jax.Array         # CONF_DTYPE[n, K] (certified narrowing)
     tx: jax.Array               # TX_DTYPE[n, K] (certified narrowing)
     own_inc: jax.Array          # int32[n]
-    awareness: jax.Array        # int32[n]
+    awareness: jax.Array        # AWARE_DTYPE[n] (certified narrowing)
     probe_pending_at: jax.Array # int32[n]
     probe_subject: jax.Array    # int32[n]
     overflow: jax.Array         # int32 — news dropped to slot pressure
@@ -180,19 +258,34 @@ def pp_initiator_budget(n: int, push_pull_ticks: int) -> int:
     return min(n, max(64, (8 * n) // max(1, push_pull_ticks)))
 
 
+def gossip_sender_budget(n: int) -> int:
+    """Static sender-slot budget of the compacted gossip emission at
+    K < n: in steady state almost no node holds a message with
+    retransmit budget left (gossip quiesces), so the [n, F, M] lane
+    expansion is ~all masked — senders with something to say compact
+    into n/4 slots (floor 2048, so small studies keep full width)
+    before the expansion.  Budget misses keep their tx (nothing is
+    spent for an unselected sender), are counted into ``overflow``,
+    and retry next tick — the same loud discipline as
+    :func:`pp_initiator_budget`."""
+    return min(n, max(2048, n // 4))
+
+
 def arrival_count(cfg: SparseMembershipConfig) -> int:
     """Flat arrival-stream length of one tick (static under jit):
-    gossip fan-out plus the push/pull exchange — compacted at K < n,
-    full-width in the K == n parity mode."""
+    compacted gossip fan-out plus the compacted push/pull exchange at
+    K < n, full-width in the K == n parity mode."""
     base = cfg.base
     n = base.n
     K = min(cfg.k_slots, n)
     M = min(base.piggyback, K)
-    A = n * base.fanout * M
-    if base.push_pull_enabled:
-        if K < n:
+    if K < n:
+        A = gossip_sender_budget(n) * base.fanout * M
+        if base.push_pull_enabled:
             A += 2 * pp_initiator_budget(n, base.push_pull_ticks) * K
-        else:
+    else:
+        A = n * base.fanout * M
+        if base.push_pull_enabled:
             A += 2 * n * K
     return A
 
@@ -214,11 +307,11 @@ def sparse_membership_init(cfg: SparseMembershipConfig) -> SparseMembershipState
     return SparseMembershipState(
         slot_subj=slot_subj,
         key=jnp.zeros((n, K), jnp.int32),
-        suspect_since=jnp.full((n, K), NEVER, jnp.int32),
+        suspect_since=jnp.full((n, K), AGE_NONE, SINCE_DTYPE),
         confirms=jnp.zeros((n, K), CONF_DTYPE),
         tx=jnp.zeros((n, K), TX_DTYPE),
         own_inc=jnp.zeros((n,), jnp.int32),
-        awareness=jnp.zeros((n,), jnp.int32),
+        awareness=jnp.zeros((n,), AWARE_DTYPE),
         probe_pending_at=jnp.full((n,), NEVER, jnp.int32),
         probe_subject=jnp.zeros((n,), jnp.int32),
         overflow=jnp.int32(0),
@@ -282,38 +375,93 @@ def settled_of(slots: tuple, row_ids: jax.Array = None) -> jax.Array:
         (slot_subj >= 0)
         & (slot_subj != row_ids[:, None])     # the self slot is pinned
         & (key_rank(key_m) == RANK_ALIVE)
-        & (tx == 0) & (since == NEVER) & (conf == 0)
+        & (tx == 0) & (since < 0) & (conf == 0)
     )
 
 
-def _claim_slot(slots: tuple, settled: jax.Array, want: jax.Array,
-                new_subj: jax.Array, n: int, K: int):
-    """Claim one evictable slot per row for ``new_subj``: empty slots
-    first, then SETTLED cells (alive rank, no pending retransmit or
-    suspicion — recoverable information, the protocol re-learns it from
-    the next push/pull).  Claimed slots reset to default contents.
+# Default contents of an empty (or freshly claimed) slot, aligned with
+# the (key, suspect_since, confirms, tx) companion planes.
+_PLANE_DEFAULTS = (DEFAULT_KEY, AGE_NONE, 0, 0)
 
-    Returns (slots', claimed_mask, chosen_idx, forgotten_count)."""
+
+def _rows_of(a: jax.Array, start, rows: int) -> jax.Array:
+    """Rows [start, start+rows) of a 1-D/2-D plane; ``start=None``
+    (the whole-table call) returns ``a`` itself — a dynamic_slice
+    there would read as a full-plane copy under J6."""
+    if start is None:
+        return a
+    if a.ndim == 1:
+        return jax.lax.dynamic_slice(a, (start,), (rows,))
+    return jax.lax.dynamic_slice(a, (start, 0), (rows, a.shape[1]))
+
+
+def _settled_blocks(row_ids: jax.Array = None):
+    """Block-sliceable eviction mask for the merge kernel:
+    (slot_subj, planes, start, rows) -> settled_of over that row block,
+    with GLOBAL row ids so the self-slot pin survives slicing.  The
+    planes arrive as the kernel's explicit operands — closing over
+    them here would double-count them under J6 (see merge_into_rows).
+    """
+    def mask(slot_subj, planes, start, rows: int):
+        blk = tuple(_rows_of(p, start, rows)
+                    for p in (slot_subj, *planes))
+        base = 0 if start is None else start
+        ids = (base + jnp.arange(rows, dtype=jnp.int32)
+               if row_ids is None else _rows_of(row_ids, start, rows))
+        return settled_of(blk, ids)
+    return mask
+
+
+def _remembers_blocks():
+    """Block-sliceable remembered-cell mask (eviction here loses a
+    remembered incarnation); same parameterized contract as
+    :func:`_settled_blocks`."""
+    def mask(slot_subj, planes, start, rows: int):
+        return ((_rows_of(slot_subj, start, rows) >= 0)
+                & (_rows_of(planes[0], start, rows) != DEFAULT_KEY))
+    return mask
+
+
+def _claim_one(slots: tuple, want: jax.Array, new_subj: jax.Array,
+               row_ids: jax.Array = None):
+    """One bounded-insertion claim per row for ``new_subj`` where
+    ``want`` (the probe-maturity path): empty slots first, then
+    SETTLED cells, rows kept sorted by ops/sortmerge.insert_rows_one —
+    and the WHOLE body rides inside ``lax.cond(any(want), ...)`` so
+    steady-state ticks (no maturing probe without a slot) skip it
+    entirely.
+
+    Returns (slots', can, pos, forgotten_delta, overflow_delta);
+    ``pos`` is the inserted subject's final column (-1 where no
+    claim)."""
+    from consul_tpu.ops import insert_rows_one
+
     slot_subj, key_m, since, conf, tx = slots
-    rows = jnp.arange(n, dtype=jnp.int32)
-    evict_score = jnp.where(slot_subj < 0, 2, 0)
-    evict_score = jnp.maximum(evict_score, jnp.where(settled, 1, 0))
-    choice = jnp.argmax(
-        evict_score * K - jnp.arange(K, dtype=jnp.int32)[None, :],
-        axis=1,
-    ).astype(jnp.int32)
-    can = want & (evict_score[rows, choice] > 0)
-    forgot = jnp.sum(
-        (can & (slot_subj[rows, choice] >= 0)
-         & (key_m[rows, choice] != DEFAULT_KEY)).astype(jnp.int32)
+
+    def claim(slot_subj, key_m, since, conf, tx):
+        s = (slot_subj, key_m, since, conf, tx)
+        new_ss, planes, can, pos, forgot = insert_rows_one(
+            slot_subj, (key_m, since, conf, tx), _PLANE_DEFAULTS,
+            want, new_subj,
+            evictable=settled_of(s, row_ids),
+            remembers=(slot_subj >= 0) & (key_m != DEFAULT_KEY),
+        )
+        ov = jnp.sum((want & ~can).astype(jnp.int32))
+        return (new_ss, *planes), can, pos, forgot, ov
+
+    def skip(slot_subj, key_m, since, conf, tx):
+        n = slot_subj.shape[0]
+        return ((slot_subj, key_m, since, conf, tx),
+                jnp.zeros((n,), bool), jnp.full((n,), -1, jnp.int32),
+                jnp.int32(0), jnp.int32(0))
+
+    # Planes ride as EXPLICIT operands, referenced only through the
+    # branch parameters — a closure captured by both branches would be
+    # lifted twice into the cond's operand list (merge_into_rows'
+    # phantom-liveness note).
+    return jax.lax.cond(
+        jnp.any(want), claim, skip, slot_subj, key_m, since, conf, tx
     )
-    col = jnp.where(can, choice, K)
-    slot_subj = slot_subj.at[rows, col].set(new_subj, mode="drop")
-    key_m = key_m.at[rows, col].set(DEFAULT_KEY, mode="drop")
-    since = since.at[rows, col].set(NEVER, mode="drop")
-    conf = conf.at[rows, col].set(0, mode="drop")
-    tx = tx.at[rows, col].set(0, mode="drop")
-    return (slot_subj, key_m, since, conf, tx), can, choice, forgot
 
 
 def _merge_arrivals(
@@ -323,9 +471,13 @@ def _merge_arrivals(
     overflow: jax.Array, forgotten: jax.Array,
     row_ids: jax.Array = None,
 ):
-    """The delivery pipeline on the sort-merge kernel: one lex-sort of
-    the stream locates, allocates, and scatter-maxes in a single pass
-    (ops/sortmerge.py).  Eviction policy: only SETTLED cells may be
+    """The delivery pipeline on the AMORTIZED sort-merge kernel
+    (ops/sortmerge.merge_into_rows): every arrival is located once
+    against the sorted rows; a tick with no allocation anywhere — the
+    steady state — delivers by raw scatter-max and never sorts, while
+    an allocation tick pays the lex-sort + dedup and re-establishes
+    the sorted-row invariant through the bounded direct-position merge
+    (no full-row argsort).  Eviction policy: only SETTLED cells may be
     claimed, and evicting one whose key differs from the default loses
     a remembered incarnation (``forgotten``); allocation-worthy news
     that finds no slot counts into ``overflow``.
@@ -341,26 +493,139 @@ def _merge_arrivals(
     re-derived (the round re-locates the self slot)."""
     slot_subj, key_m, since, conf, tx = slots
     allocate = K < n
-    new_subj, claimed, key_rx, sus_rx, dropped, forgot = merge_deliveries(
-        slot_subj, recv, subj, val, sus, ok, alloc,
-        evictable=settled_of(slots, row_ids),
-        remembers=(slot_subj >= 0) & (key_m != DEFAULT_KEY),
+    # Masks ride as LAZY block-sliceable callables: the kernel's fast
+    # branch never touches them, so the [n, K] bools only materialize
+    # (and die) on allocation ticks — and the 10M-scale path evaluates
+    # them per row block (J6 prices cond operands for both branches).
+    new_subj, planes, key_rx, sus_rx, dropped, forgot = merge_into_rows(
+        slot_subj, (key_m, since, conf, tx), _PLANE_DEFAULTS,
+        recv, subj, val, sus, ok, alloc,
+        evictable=_settled_blocks(row_ids),
+        remembers=_remembers_blocks(),
         default_val=DEFAULT_KEY, allocate=allocate,
+        alloc_budget=_ALLOC_BUDGET,
     )
-    if allocate:
-        # Claimed slots reset to default contents, then every touched
-        # plane re-sorts together to restore the sorted-row invariant
-        # (claims land at whatever column the claim order yielded).
-        key_m = jnp.where(claimed, DEFAULT_KEY, key_m)
-        since = jnp.where(claimed, NEVER, since)
-        conf = jnp.where(claimed, 0, conf)
-        tx = jnp.where(claimed, 0, tx)
-        new_subj, key_m, since, conf, tx, key_rx, sus_rx = sort_slot_rows(
-            new_subj, key_m, since, conf, tx, key_rx, sus_rx
-        )
+    key_m, since, conf, tx = planes
     return ((new_subj, key_m, since, conf, tx), key_rx, sus_rx,
             jnp.minimum(overflow, COUNTER_CAP) + dropped,
             jnp.minimum(forgotten, COUNTER_CAP) + forgot)
+
+
+def _deliver_chunked(slots, targets, packet_ok, msg_subj, msg_key,
+                     msg_valid, pp, n: int, K: int,
+                     overflow: jax.Array, forgotten: jax.Array):
+    """Delivery for streams too large to materialize whole (n ≳ 2M):
+    the gossip and push/pull legs are generated chunk-by-chunk inside
+    ``lax.scan`` bodies from their [n, F]/[n, M]/[I] sources — the full
+    flat stream never exists — and every chunk lands through
+    :func:`ops.sortmerge.merge_into_rows` with the rx planes carried as
+    accumulators (the kernel permutes them alongside claims).
+
+    Chunk-granular semantics, all deliberate and documented: chunks
+    merge sequentially, so later chunks see earlier chunks' claims
+    (fresher, never staler); push/pull rows are gathered from the
+    partially-merged table; dropped/forgotten count per chunk (claim
+    interleavings can differ from the single-call kernel, which stays
+    bit-pinned at every config this driver is not selected for).
+
+    Returns (slots', key_rx, sus_rx, overflow', forgotten')."""
+    F = targets.shape[1]
+    M = msg_subj.shape[1]
+    rx = (jnp.full((n, K), -1, jnp.int32),
+          jnp.full((n, K), -1, jnp.int32))
+    dropped = jnp.int32(0)
+    forgot = jnp.int32(0)
+
+    def _merge_chunk(carry, recv, subj, val, ok, alloc, sus):
+        slots, rx, dropped, forgot = carry
+        slot_subj, key_m, since, conf, tx = slots
+        new_subj, planes, rxk, rxs, d, f = merge_into_rows(
+            slot_subj, (key_m, since, conf, tx), _PLANE_DEFAULTS,
+            recv, subj, val, sus, ok, alloc,
+            evictable=_settled_blocks(),
+            remembers=_remembers_blocks(),
+            default_val=DEFAULT_KEY, allocate=True, rx=rx,
+            alloc_budget=_ALLOC_BUDGET,
+        )
+        # Saturating accumulation (COUNTER_CAP): the across-chunk sum
+        # must stay J7-exact at the 10M stream bound.
+        return ((new_subj, *planes), (rxk, rxs),
+                jnp.minimum(dropped, COUNTER_CAP) + d,
+                jnp.minimum(forgot, COUNTER_CAP) + f)
+
+    # Gossip leg: chunk over sender blocks of B rows.
+    C_g = _chunk_count(n * F * M, n)
+    B = -(-n // C_g)
+    pad = C_g * B - n
+    tgt_p = jnp.pad(targets, ((0, pad), (0, 0)))
+    pok_p = jnp.pad(packet_ok, ((0, pad), (0, 0)))
+    ms_p = jnp.pad(msg_subj, ((0, pad), (0, 0)), constant_values=-1)
+    mk_p = jnp.pad(msg_key, ((0, pad), (0, 0)))
+    mv_p = jnp.pad(msg_valid, ((0, pad), (0, 0)))
+
+    def gossip_body(carry, c):
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, c * B, B)  # noqa: E731
+        tgt, pok, ms, mk, mv = sl(tgt_p), sl(pok_p), sl(ms_p), \
+            sl(mk_p), sl(mv_p)
+        shape3 = (B, F, M)
+        recv = jnp.broadcast_to(tgt[:, :, None], shape3).ravel()
+        subj = jnp.broadcast_to(ms[:, None, :], shape3).ravel()
+        val = jnp.broadcast_to(mk[:, None, :], shape3).ravel()
+        ok = (pok[:, :, None] & mv[:, None, :]).ravel()
+        sus = lambda v: jnp.where(  # noqa: E731 — lazy, parameterized
+            key_rank(v) == RANK_SUSPECT, key_inc(v), -1
+        )
+        return _merge_chunk(
+            carry, recv, subj, val, ok, jnp.ones(ok.shape, bool), sus
+        ), None
+
+    carry = ((slots, rx, dropped, forgot))
+    carry, _ = jax.lax.scan(
+        gossip_body, carry, jnp.arange(C_g, dtype=jnp.int32)
+    )
+
+    if pp is not None:
+        who, pwho, sel = pp
+        I = who.shape[0]
+        C_p = _chunk_count(I * K, I)
+        Bi = -(-I // C_p)
+        padi = C_p * Bi - I
+        who_p = jnp.pad(who, (0, padi))
+        pwho_p = jnp.pad(pwho, (0, padi))
+        sel_p = jnp.pad(sel, (0, padi))
+
+        def pp_body(carry, c):
+            slots_c = carry[0]
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(  # noqa: E731
+                a, c * Bi, Bi)
+            who_c, pwho_c, sel_c = sl(who_p), sl(pwho_p), sl(sel_p)
+            # Pull: the partner's rows flow to the initiator; push:
+            # the initiator's rows flow to the partner.  Rows gather
+            # from the PARTIALLY MERGED table (chunk semantics above).
+            for src, dst in ((pwho_c, who_c), (who_c, pwho_c)):
+                subj_c = slots_c[0][src].ravel()
+                val_c = slots_c[1][src].ravel()
+                recv_c = jnp.repeat(dst, K)
+                ok_c = jnp.repeat(sel_c, K) & (subj_c >= 0)
+                # Settled alive@inc pp rows merge but never allocate
+                # (the evict→relearn amplification gate, as unchunked).
+                alloc_c = key_rank(val_c) >= RANK_SUSPECT
+                carry = _merge_chunk(
+                    carry, recv_c, subj_c, val_c, ok_c, alloc_c, None
+                )
+                slots_c = carry[0]
+            return carry, None
+
+        carry, _ = jax.lax.scan(
+            pp_body, carry, jnp.arange(C_p, dtype=jnp.int32)
+        )
+
+    slots, rx, dropped, forgot = carry
+    return (slots, rx[0], rx[1],
+            jnp.minimum(overflow, COUNTER_CAP)
+            + jnp.minimum(dropped, COUNTER_CAP),
+            jnp.minimum(forgotten, COUNTER_CAP)
+            + jnp.minimum(forgot, COUNTER_CAP))
 
 
 def _view_of(slot_subj, slot_key, who: jax.Array, subj: jax.Array):
@@ -450,13 +715,42 @@ def sparse_membership_round(
         & participates[targets]
     )
 
-    recv_g = jnp.broadcast_to(targets[:, :, None], (n, F, M)).ravel()
-    subj_g = jnp.broadcast_to(msg_subj[:, None, :], (n, F, M)).ravel()
-    val_g = jnp.broadcast_to(msg_key[:, None, :], (n, F, M)).ravel()
-    ok_g = (packet_ok[:, :, None] & msg_valid[:, None, :]).ravel()
-    sus_g = jnp.where(
-        key_rank(val_g) == RANK_SUSPECT, key_inc(val_g), -1
-    )
+    if K < n:
+        # Compacted gossip emission (gossip_sender_budget): senders
+        # with a live message compact into S_b slots before the
+        # [., F, M] lane expansion — steady-state ticks carry ~no
+        # senders, so the stream tracks real traffic.  Unselected
+        # senders spend NO tx (their messages retry next tick) and
+        # count into overflow, never silent.
+        S_b = gossip_sender_budget(n)
+        has_msg = jnp.any(msg_valid, axis=1)
+        cpos = jnp.cumsum(has_msg.astype(jnp.int32)) - 1
+        ctgt = jnp.where(
+            has_msg & (cpos < S_b), jnp.clip(cpos, 0, S_b - 1), S_b
+        )
+        snd = (
+            jnp.full((S_b + 1,), n, jnp.int32)
+            .at[ctgt].set(rows)[:S_b]
+        )
+        sel_s = snd < n
+        overflow = jnp.minimum(overflow, COUNTER_CAP) + (
+            jnp.sum(has_msg.astype(jnp.int32))
+            - jnp.sum(sel_s.astype(jnp.int32))
+        )
+        sndc = jnp.minimum(snd, n - 1)
+        # No scatter: unused budget slots all clamp to row n-1, and a
+        # duplicate-index .set() racing True (real selection) against
+        # False (unused slot) is unspecified under XLA.
+        sel_mask = has_msg & (cpos < S_b)
+        msg_valid = msg_valid & sel_mask[:, None]
+        g_targets = targets[sndc]
+        g_packet_ok = packet_ok[sndc] & sel_s[:, None]
+        g_msg_subj = msg_subj[sndc]
+        g_msg_key = msg_key[sndc]
+        g_msg_valid = msg_valid[sndc]
+    else:
+        g_targets, g_packet_ok = targets, packet_ok
+        g_msg_subj, g_msg_key, g_msg_valid = msg_subj, msg_key, msg_valid
 
     spend = jnp.where(msg_valid, F, 0).astype(tx.dtype)
     # unique_indices: top_k returns distinct slots per row, so every
@@ -471,8 +765,8 @@ def sparse_membership_round(
     )
 
     # -- 2. push/pull ---------------------------------------------------
-    alloc_g = jnp.ones(recv_g.shape, bool)
-    arrs = [(recv_g, subj_g, val_g, sus_g, ok_g, alloc_g)]
+    pp_sel = None
+    pp_full = None
     if base.push_pull_enabled:
         dead_cnt = jnp.sum(
             occupied & (key_rank(key_m) > RANK_SUSPECT), axis=1
@@ -501,6 +795,36 @@ def sparse_membership_round(
                 jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got)
             )
             pwho = partner[who]
+            pp_sel = (who, pwho, sel)
+        else:
+            pp_full = (partner, pp_ok)
+
+    # -- delivery -------------------------------------------------------
+    slots_in = (slot_subj, key_m, suspect_since, confirms, tx)
+    if K < n and arrival_count(cfg) > _CHUNK_A:
+        # The stream is too large to materialize whole (n ≳ 2M):
+        # generate and merge it chunk-by-chunk (_deliver_chunked).
+        slots_t, key_rx, sus_rx, overflow, forgotten = _deliver_chunked(
+            slots_in, g_targets, g_packet_ok, g_msg_subj, g_msg_key,
+            g_msg_valid, pp_sel, n, K, overflow, state.forgotten,
+        )
+    else:
+        Sg = g_targets.shape[0]
+        recv_g = jnp.broadcast_to(
+            g_targets[:, :, None], (Sg, F, M)).ravel()
+        subj_g = jnp.broadcast_to(
+            g_msg_subj[:, None, :], (Sg, F, M)).ravel()
+        val_g = jnp.broadcast_to(
+            g_msg_key[:, None, :], (Sg, F, M)).ravel()
+        ok_g = (g_packet_ok[:, :, None]
+                & g_msg_valid[:, None, :]).ravel()
+        sus_g = jnp.where(
+            key_rank(val_g) == RANK_SUSPECT, key_inc(val_g), -1
+        )
+        alloc_g = jnp.ones(recv_g.shape, bool)
+        arrs = [(recv_g, subj_g, val_g, sus_g, ok_g, alloc_g)]
+        if pp_sel is not None:
+            who, pwho, sel = pp_sel
             # Pull: partner's occupied slots flow to the initiator...
             recv_pull = jnp.repeat(who, K)
             subj_pull = slot_subj[pwho].ravel()
@@ -511,9 +835,10 @@ def sparse_membership_round(
             subj_push = slot_subj[who].ravel()
             val_push = key_m[who].ravel()
             ok_push = jnp.repeat(sel, K) & (subj_push >= 0)
-        else:
+        elif pp_full is not None:
             # Full-width exchange — the K == n parity mode keeps the
             # dense model's shapes exactly.
+            partner, pp_ok = pp_full
             recv_pull = jnp.repeat(rows, K)
             subj_pull = slot_subj[partner].ravel()
             val_pull = key_m[partner].ravel()
@@ -522,77 +847,130 @@ def sparse_membership_round(
             subj_push = slot_subj.ravel()
             val_push = key_m.ravel()
             ok_push = jnp.repeat(pp_ok, K) & (subj_push >= 0)
-        minus1 = jnp.full(recv_pull.shape, -1, jnp.int32)
-        # Push/pull rows holding settled alive@inc values merge into
-        # EXISTING slots but never allocate: reintroducing a remembered
-        # incarnation into a row that evicted it would re-arm a full
-        # retransmit budget and amplify forever (the evict→relearn
-        # loop).  Suspect/dead/left pp news stays allocation-worthy —
-        # that's the anti-entropy backstop for detection.
-        alloc_pull = key_rank(val_pull) >= RANK_SUSPECT
-        alloc_push = key_rank(val_push) >= RANK_SUSPECT
-        arrs.append((recv_pull, subj_pull, val_pull, minus1, ok_pull,
-                     alloc_pull))
-        arrs.append((recv_push, subj_push, val_push, minus1, ok_push,
-                     alloc_push))
+        if pp_sel is not None or pp_full is not None:
+            minus1 = jnp.full(recv_pull.shape, -1, jnp.int32)
+            # Push/pull rows holding settled alive@inc values merge
+            # into EXISTING slots but never allocate: reintroducing a
+            # remembered incarnation into a row that evicted it would
+            # re-arm a full retransmit budget and amplify forever (the
+            # evict→relearn loop).  Suspect/dead/left pp news stays
+            # allocation-worthy — that's the anti-entropy backstop for
+            # detection.
+            alloc_pull = key_rank(val_pull) >= RANK_SUSPECT
+            alloc_push = key_rank(val_push) >= RANK_SUSPECT
+            arrs.append((recv_pull, subj_pull, val_pull, minus1,
+                         ok_pull, alloc_pull))
+            arrs.append((recv_push, subj_push, val_push, minus1,
+                         ok_push, alloc_push))
 
-    recv = jnp.concatenate([a[0] for a in arrs])
-    subj = jnp.concatenate([a[1] for a in arrs])
-    val = jnp.concatenate([a[2] for a in arrs])
-    sus = jnp.concatenate([a[3] for a in arrs])
-    ok = jnp.concatenate([a[4] for a in arrs])
-    alloc = jnp.concatenate([a[5] for a in arrs])
+        recv = jnp.concatenate([a[0] for a in arrs])
+        subj = jnp.concatenate([a[1] for a in arrs])
+        val = jnp.concatenate([a[2] for a in arrs])
+        sus = jnp.concatenate([a[3] for a in arrs])
+        ok = jnp.concatenate([a[4] for a in arrs])
+        alloc = jnp.concatenate([a[5] for a in arrs])
 
-    slots_t, key_rx, sus_rx, overflow, forgotten = _merge_arrivals(
-        (slot_subj, key_m, suspect_since, confirms, tx),
-        recv, subj, val, sus, ok, alloc, n, K,
-        overflow, state.forgotten,
-    )
+        slots_t, key_rx, sus_rx, overflow, forgotten = _merge_arrivals(
+            slots_in, recv, subj, val, sus, ok, alloc, n, K,
+            overflow, state.forgotten,
+        )
     slot_subj, key_m, suspect_since, confirms, tx = slots_t
     # The merge re-sorts rows when it allocates: positional handles are
     # stale past this point, so re-locate the self slot.
     self_slot = _locate_rows(slot_subj, rows, rows)
 
-    # -- 3. refutation --------------------------------------------------
-    self_rx = key_rx[rows, self_slot]
-    accused = jnp.where(
-        key_rank(self_rx) >= RANK_SUSPECT, key_inc(self_rx), -1
-    )
-    refuting = participates & ~leaving & (accused >= own_inc)
-    own_inc = jnp.where(refuting, accused + 1, own_inc)
-    awareness = jnp.clip(
-        awareness + refuting.astype(jnp.int32),
-        0, base.profile.awareness_max_multiplier - 1,
-    )
-    key_rx = key_rx.at[rows, self_slot].set(-1)
-    self_key = jnp.where(
-        leaving, make_key(own_inc, RANK_LEFT), make_key(own_inc, RANK_ALIVE)
-    )
-    key_after_refute = key_m.at[rows, self_slot].max(self_key)
-    tx = tx.at[rows, self_slot].set(
-        jnp.where(refuting, base.tx_limit, tx[rows, self_slot])
-    )
+    # -- 3+4. refutation + merge ----------------------------------------
+    # Row-local throughout, so the huge-table path applies it block-by-
+    # block with the planes as an in-place scan carry (the rx planes +
+    # old/new key coexisting whole is otherwise the tick's J6 peak).
+    def _merge_step(key_c, since_c, conf_c, tx_c, inc_c, aw_c,
+                    krx, srx, sslot, part, leave, rows_l):
+        self_rx = krx[rows_l, sslot]
+        accused = jnp.where(
+            key_rank(self_rx) >= RANK_SUSPECT, key_inc(self_rx), -1
+        )
+        refuting = part & ~leave & (accused >= inc_c)
+        inc_c = jnp.where(refuting, accused + 1, inc_c)
+        aw_c = jnp.clip(
+            aw_c + refuting.astype(aw_c.dtype),
+            0, base.profile.awareness_max_multiplier - 1,
+        )
+        krx = krx.at[rows_l, sslot].set(-1)
+        self_key = jnp.where(
+            leave, make_key(inc_c, RANK_LEFT), make_key(inc_c, RANK_ALIVE)
+        )
+        old_key = key_c.at[rows_l, sslot].max(self_key)
+        tx_c = tx_c.at[rows_l, sslot].set(
+            jnp.where(refuting, base.tx_limit, tx_c[rows_l, sslot])
+        )
+        # `changed` == (max(old, rx) > old) == (rx > old); the
+        # confirmation leg runs FIRST so srx dies before the new key
+        # exists.
+        changed = krx > old_key
+        confirming = (
+            ~changed
+            & (key_rank(old_key) == RANK_SUSPECT)
+            & (srx >= key_inc(old_key))
+        )
+        new_confirms = jnp.minimum(
+            conf_c + confirming.astype(conf_c.dtype),
+            base.confirmations_k,
+        )
+        gained_conf = confirming & (new_confirms > conf_c)
+        conf_c = jnp.where(changed, 0, new_confirms)
+        new_key = jnp.maximum(old_key, krx)
+        fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
+        # Age encoding: a fresh suspicion starts at age 0 ("since t");
+        # any other view change clears the timer to the -1 sentinel.
+        since_c = jnp.where(
+            fresh_suspect, 0, jnp.where(changed, AGE_NONE, since_c)
+        ).astype(SINCE_DTYPE)
+        tx_c = jnp.where(changed | gained_conf, base.tx_limit, tx_c)
+        return new_key, since_c, conf_c, tx_c, inc_c, aw_c
 
-    # -- 4. merge -------------------------------------------------------
-    old_key = key_after_refute
-    new_key = jnp.maximum(old_key, key_rx)
-    changed = new_key > old_key
-    fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
-    suspect_since = jnp.where(
-        fresh_suspect, t, jnp.where(changed, NEVER, suspect_since)
-    )
-    confirming = (
-        ~changed
-        & (key_rank(old_key) == RANK_SUSPECT)
-        & (sus_rx >= key_inc(old_key))
-    )
-    new_confirms = jnp.minimum(
-        confirms + confirming.astype(confirms.dtype), base.confirmations_k
-    )
-    gained_conf = confirming & (new_confirms > confirms)
-    confirms = jnp.where(changed, 0, new_confirms)
-    tx = jnp.where(changed | gained_conf, base.tx_limit, tx)
-    key_m = new_key
+    blocks = _row_blocks(n)
+    if blocks is None:
+        (key_m, suspect_since, confirms, tx, own_inc, awareness) = \
+            _merge_step(
+                key_m, suspect_since, confirms, tx, own_inc, awareness,
+                key_rx, sus_rx, self_slot, participates, leaving, rows,
+            )
+    else:
+        R, Bq = blocks
+        rows_bq = jnp.arange(Bq, dtype=jnp.int32)
+
+        def m34_body(carry, rb):
+            start = rb * Bq
+
+            def sl2(a):
+                return jax.lax.dynamic_slice(a, (start, 0), (Bq, K))
+
+            def sl1(a):
+                return jax.lax.dynamic_slice(a, (start,), (Bq,))
+
+            key_c, since_c, conf_c, tx_c, inc_c, aw_c = carry
+            out = _merge_step(
+                sl2(key_c), sl2(since_c), sl2(conf_c), sl2(tx_c),
+                sl1(inc_c), sl1(aw_c), sl2(key_rx), sl2(sus_rx),
+                sl1(self_slot), sl1(participates), sl1(leaving),
+                rows_bq,
+            )
+            z = jnp.int32(0)
+            return (
+                jax.lax.dynamic_update_slice(key_c, out[0], (start, z)),
+                jax.lax.dynamic_update_slice(since_c, out[1], (start, z)),
+                jax.lax.dynamic_update_slice(conf_c, out[2], (start, z)),
+                jax.lax.dynamic_update_slice(tx_c, out[3], (start, z)),
+                jax.lax.dynamic_update_slice(inc_c, out[4], (start,)),
+                jax.lax.dynamic_update_slice(aw_c, out[5], (start,)),
+            ), None
+
+        (key_m, suspect_since, confirms, tx, own_inc, awareness), _ = \
+            jax.lax.scan(
+                m34_body,
+                (key_m, suspect_since, confirms, tx, own_inc, awareness),
+                jnp.arange(R, dtype=jnp.int32),
+            )
 
     # -- 5. probes ------------------------------------------------------
     if base.probe_enabled:
@@ -614,11 +992,13 @@ def sparse_membership_round(
         can_pend = failed & (state.probe_pending_at == NEVER)
         matures_at = (
             t + base.probe_interval_ticks
-            + awareness * base.probe_timeout_ticks
+            # Widen the narrowed awareness before it scales tick
+            # arithmetic (int8 * probe_timeout_ticks would wrap).
+            + awareness.astype(jnp.int32) * base.probe_timeout_ticks
         )
         awareness = jnp.clip(
-            awareness + failed.astype(jnp.int32)
-            - (probing & ~failed).astype(jnp.int32),
+            awareness + failed.astype(awareness.dtype)
+            - (probing & ~failed).astype(awareness.dtype),
             0, base.profile.awareness_max_multiplier - 1,
         )
         probe_pending_at = jnp.where(
@@ -630,19 +1010,21 @@ def sparse_membership_round(
         # Locate (or allocate) the matured subject's slot.
         mslot = _locate_rows(slot_subj, rows, probe_subject)
         if K < n:
-            # One allocation per maturing probe with no slot, claimed
-            # the same way arrivals claim.
+            # One bounded-insertion claim per maturing probe with no
+            # slot — behind lax.cond, so steady-state ticks skip the
+            # whole claim/insert machinery (amortized invariant).
             need = mature & (mslot < 0)
             slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
-            slots_p, can, choice, forgot = _claim_slot(
-                slots_p, settled_of(slots_p), need, probe_subject, n, K,
+            slots_p, can, pos, forgot, ov = _claim_one(
+                slots_p, need, probe_subject,
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
             forgotten = jnp.minimum(forgotten, COUNTER_CAP) + forgot
-            overflow = jnp.minimum(overflow, COUNTER_CAP) + jnp.sum(
-                (need & ~can).astype(jnp.int32)
-            )
-            mslot = jnp.where(can, choice, mslot)
+            overflow = jnp.minimum(overflow, COUNTER_CAP) + ov
+            # Only the claiming rows shifted columns, and exactly
+            # their maturity lands at the insertion position; every
+            # other row's pre-claim locate stays valid.
+            mslot = jnp.where(can, pos, mslot)
         mview = jnp.where(
             mslot >= 0, key_m[rows, jnp.maximum(mslot, 0)], DEFAULT_KEY
         )
@@ -655,7 +1037,7 @@ def sparse_membership_round(
             jnp.where(apply_sus, sus_key, 0), mode="drop"
         )
         suspect_since = suspect_since.at[rows, scol].set(
-            jnp.where(apply_sus, t, 0), mode="drop"
+            jnp.zeros((n,), SINCE_DTYPE), mode="drop"
         )
         confirms = confirms.at[rows, scol].set(0, mode="drop")
         tx = tx.at[rows, scol].set(base.tx_limit, mode="drop")
@@ -665,24 +1047,41 @@ def sparse_membership_round(
         probe_subject = state.probe_subject
 
     # -- 6. suspicion expiry --------------------------------------------
-    timeout = _lifeguard_timeout_ticks(base, confirms)
-    elapsed = (t - suspect_since).astype(jnp.float32)
+    # The age plane IS the elapsed time, and the Lifeguard timeout is a
+    # function of ``confirms`` alone — confirmations_k + 1 distinct
+    # values — so the per-cell float chain collapses to one tiny
+    # threshold table (integer elapsed >= real timeout iff elapsed >=
+    # ceil(timeout); thresholds past AGE_CAP can never fire and clamp
+    # to AGE_CAP + 1, which no saturated age reaches).
+    thr_table = jnp.minimum(
+        jnp.ceil(_lifeguard_timeout_ticks(
+            base, jnp.arange(base.confirmations_k + 1, dtype=jnp.int32)
+        )).astype(jnp.int32),
+        AGE_CAP + 1,
+    ).astype(SINCE_DTYPE)
+    threshold = jnp.take(thr_table, confirms.astype(jnp.uint8), axis=0)
     expire = (
         (key_rank(key_m) == RANK_SUSPECT)
-        & (suspect_since != NEVER)
-        & (elapsed >= timeout)
+        & (suspect_since >= 0)
+        & (suspect_since >= threshold)
         & participates[:, None]
     )
     key_m = jnp.where(expire, make_key(key_inc(key_m), RANK_DEAD), key_m)
-    suspect_since = jnp.where(expire, NEVER, suspect_since)
+    suspect_since = jnp.where(
+        expire, jnp.asarray(AGE_NONE, SINCE_DTYPE), suspect_since
+    )
     tx = jnp.where(expire, base.tx_limit, tx)
 
-    if base.probe_enabled and K < n:
-        # Probe-path claims (step 5) land out of place; re-sort the
-        # slot planes so the next round's binary searches stay sound.
-        (slot_subj, key_m, suspect_since, confirms, tx) = sort_slot_rows(
-            slot_subj, key_m, suspect_since, confirms, tx
-        )
+    # Live suspicion timers age by one tick (saturating at AGE_CAP —
+    # only reachable on non-participating rows, see the packing note);
+    # the next round reads the plane as elapsed time directly.  No
+    # trailing re-sort: merge and probe claims already re-established
+    # the sorted-row invariant through bounded insertion.
+    suspect_since = jnp.where(
+        suspect_since >= 0,
+        jnp.minimum(suspect_since + 1, AGE_CAP).astype(SINCE_DTYPE),
+        suspect_since,
+    )
 
     return SparseMembershipState(
         slot_subj=slot_subj,
@@ -706,7 +1105,10 @@ def densify(state: SparseMembershipState, n: int):
     Layout-agnostic by construction — it scatters by subject id, so it
     reads identically before and after a row permutation.  That makes
     the K == n parity pin independent of WHERE the sorted-row invariant
-    placed each cell."""
+    placed each cell.  The narrowed planes widen back to the dense
+    int32 layout here, and the age-packed suspect_since plane
+    reconstructs the absolute start tick as ``tick - age`` (exact
+    while a timer is younger than AGE_CAP — see the packing note)."""
     K = state.key.shape[1]
     key = jnp.full((n, n), DEFAULT_KEY, jnp.int32)
     since = jnp.full((n, n), NEVER, jnp.int32)
@@ -716,10 +1118,11 @@ def densify(state: SparseMembershipState, n: int):
     cols = state.slot_subj.ravel()
     okc = jnp.where(cols >= 0, cols, n)
     flat = jnp.where(cols >= 0, rows * n + okc, n * n)
+    age = state.suspect_since.astype(jnp.int32)
+    since_abs = jnp.where(age >= 0, state.tick - age, NEVER)
     key = key.ravel().at[flat].set(state.key.ravel(), mode="drop").reshape(n, n)
     since = since.ravel().at[flat].set(
-        state.suspect_since.ravel(), mode="drop").reshape(n, n)
-    # The narrowed planes widen back to the dense int32 layout here.
+        since_abs.ravel(), mode="drop").reshape(n, n)
     conf = conf.ravel().at[flat].set(
         state.confirms.astype(jnp.int32).ravel(), mode="drop").reshape(n, n)
     tx = tx.ravel().at[flat].set(

@@ -132,6 +132,17 @@ def profile_registry(programs: dict, execute: bool = False,
     profiles = []
     exec_spent = 0.0
     for prog in programs.values():
+        if getattr(prog, "abstract_only", False):
+            # e.g. sparse@10m: tracing is free, but XLA-compiling (let
+            # alone executing) the 10M-node program is not — skip it
+            # before lower(), loudly.
+            profiles.append(ProgramProfile(
+                name=prog.name, entrypoint=prog.entrypoint, n=prog.n,
+                trace_s=0.0, compile_s=0.0,
+                execute_skipped="abstract-only registry entry "
+                                "(never compiled/executed)",
+            ))
+            continue
         if deadline is not None and time.monotonic() >= deadline:
             profiles.append(ProgramProfile(
                 name=prog.name, entrypoint=prog.entrypoint, n=prog.n,

@@ -19,16 +19,22 @@ from consul_tpu.ops.scatter import (
     deliver_max,
 )
 from consul_tpu.ops.sortmerge import (
+    insert_rows_one,
     merge_deliveries,
+    merge_into_rows,
     row_locate,
+    row_locate_lo,
     sort_slot_rows,
 )
 from consul_tpu.ops.ring_exchange import ring_exchange
 
 __all__ = [
     "ring_exchange",
+    "insert_rows_one",
     "merge_deliveries",
+    "merge_into_rows",
     "row_locate",
+    "row_locate_lo",
     "sort_slot_rows",
     "sample_peers",
     "sample_alive_peers",

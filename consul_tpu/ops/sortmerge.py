@@ -31,6 +31,39 @@ back for the caller's exactness ladder.  ``merge_deliveries`` consumes
 no RNG and, over a full table (every subject seated, nothing to
 allocate), reduces to exactly the per-arrival scatter-max it replaces
 — the property the sparse==dense bit-equality pin rides on.
+
+**Amortized path** (:func:`merge_into_rows`, PR 12): steady-state
+gossip is almost entirely about subjects every receiver has already
+seated, and the sort above only exists to serve ALLOCATION (dedup +
+rank so distinct unseated subjects claim distinct slots).  The
+incremental kernel therefore splits the tick on one runtime predicate
+— "does any arrival need a slot?" — inside ``lax.cond``:
+
+  fast branch   (steady state, no allocation anywhere): deliveries are
+                a raw idempotent scatter-max at the located slots.  No
+                lex-sort, no dedup, no re-sort; the sorted-row
+                invariant carries over from the previous tick
+                untouched.  This is the amortization: the invariant is
+                paid for when rows change, not every tick.
+  slow branch   (a claim is needed somewhere): the full lex-sort +
+                cumsum/cummax dedup + rank-matched allocation runs,
+                but the final full-row argsort is replaced by a
+                *bounded merge*: survivors and rank-ordered incoming
+                subjects already form two sorted sequences per row, so
+                each cell's final column is computed directly from
+                prefix counts (the vectorized two-pointer merge) and
+                every plane lands with ONE scatter instead of
+                argsort + per-plane gathers.
+
+Both branches return bit-identical results whenever the predicate is
+false (no claims → the seg-maxed representative scatter IS the raw
+scatter-max), and the slow branch reproduces ``merge_deliveries`` +
+reset + :func:`sort_slot_rows` exactly (same claim order: empties
+column-ascending — the row tail under the invariant — then evictable
+cells column-ascending), so the incremental path is pinned bit-equal
+to the full-sort path on identical inputs (tests/test_sortmerge.py).
+Under ``vmap`` (universe sweeps) the cond lowers to both-branches
+select — correct, just without the steady-state skip.
 """
 
 from __future__ import annotations
@@ -39,6 +72,26 @@ import jax
 import jax.numpy as jnp
 
 _SUBJ_MAX = jnp.iinfo(jnp.int32).max  # empty-slot sort sentinel
+
+# Row-block ceiling for the huge-table claim construction in
+# merge_into_rows: tables with more rows than this rebuild block-by-
+# block inside a lax.scan (in-place carry) instead of one whole-table
+# scatter pass, so two full copies of the [n, K] planes never coexist.
+_BLOCK_ROWS = 1 << 21
+
+
+def _row_blocks(n: int):
+    """(R, block_rows) splitting ``n`` rows into R equal blocks of at
+    most ``_BLOCK_ROWS`` each, or None when the table is small enough
+    (or has no suitable divisor — correctness never depends on
+    blocking, only the peak-memory profile does)."""
+    if n <= _BLOCK_ROWS:
+        return None
+    r_min = -(-n // _BLOCK_ROWS)
+    for r in range(r_min, min(n, 4096) + 1):
+        if n % r == 0:
+            return r, n // r
+    return None
 
 
 def sort_slot_rows(slot_subj: jax.Array, *planes: jax.Array):
@@ -56,10 +109,14 @@ def sort_slot_rows(slot_subj: jax.Array, *planes: jax.Array):
     )
 
 
-def row_locate(slot_subj: jax.Array, recv: jax.Array, subj: jax.Array):
-    """Slot index of ``subj`` in receiver ``recv``'s sorted row, -1 when
-    absent.  Any broadcast-matching shapes; O(log K) flat gathers per
-    query (the rows must hold the sorted-row invariant)."""
+def row_locate_lo(slot_subj: jax.Array, recv: jax.Array,
+                  subj: jax.Array):
+    """(slot, lo) of ``subj`` in receiver ``recv``'s sorted row: the
+    slot index (-1 when absent) plus the binary search's insertion
+    point ``lo`` = number of real subjects in the row strictly below
+    ``subj`` — the merge rank :func:`merge_into_rows` positions new
+    claims with.  Any broadcast-matching shapes; O(log K) flat gathers
+    per query (the rows must hold the sorted-row invariant)."""
     n, K = slot_subj.shape
     flat = jnp.where(slot_subj < 0, _SUBJ_MAX, slot_subj).ravel()
     base = jnp.clip(recv.astype(jnp.int32), 0, n - 1) * K
@@ -69,11 +126,21 @@ def row_locate(slot_subj: jax.Array, recv: jax.Array, subj: jax.Array):
     for _ in range(max(1, (K - 1).bit_length() + 1)):
         mid = (lo + hi) >> 1
         v = flat[base + jnp.minimum(mid, K - 1)]
-        go_right = v < q
+        # mid < hi guards the fixed-trip loop once lo == hi: without
+        # it a converged search on a FULL row keeps advancing lo past
+        # K, which ``found`` masks but the merge rank must not.
+        go_right = (v < q) & (mid < hi)
         lo = jnp.where(go_right, mid + 1, lo)
         hi = jnp.where(go_right, hi, mid)
     found = (lo < K) & (flat[base + jnp.minimum(lo, K - 1)] == q)
-    return jnp.where(found, lo, -1)
+    return jnp.where(found, lo, -1), lo
+
+
+def row_locate(slot_subj: jax.Array, recv: jax.Array, subj: jax.Array):
+    """Slot index of ``subj`` in receiver ``recv``'s sorted row, -1 when
+    absent.  Any broadcast-matching shapes; O(log K) flat gathers per
+    query (the rows must hold the sorted-row invariant)."""
+    return row_locate_lo(slot_subj, recv, subj)[0]
 
 
 def _segmented_sum(flags: jax.Array, x: jax.Array) -> jax.Array:
@@ -242,3 +309,509 @@ def merge_deliveries(
         .at[flat].max(su_max, mode="drop").reshape(n, K)
     )
     return new_slot_subj, claimed, key_rx, sus_rx, dropped, forgot
+
+
+def _rx_scatter(flat: jax.Array, v: jax.Array, su: jax.Array,
+                n: int, K: int, rx: tuple = None):
+    k0 = (jnp.full((n * K,), -1, jnp.int32) if rx is None
+          else rx[0].ravel())
+    s0 = (jnp.full((n * K,), -1, jnp.int32) if rx is None
+          else rx[1].ravel())
+    key_rx = k0.at[flat].max(v, mode="drop").reshape(n, K)
+    sus_rx = s0.at[flat].max(su, mode="drop").reshape(n, K)
+    return key_rx, sus_rx
+
+
+def merge_into_rows(
+    slot_subj: jax.Array, planes: tuple, defaults: tuple,
+    recv: jax.Array, subj: jax.Array, val: jax.Array, sus,
+    ok: jax.Array, alloc: jax.Array,
+    *,
+    evictable, remembers,
+    default_val: int, allocate: bool,
+    rx: tuple = None,
+    alloc_budget: int = None,
+):
+    """The amortized sort-merge tick (module docstring, "Amortized
+    path"): locate every arrival once and scatter-max every SEATED
+    delivery unconditionally (the whole steady-state tick), then
+    ``lax.cond`` on whether any arrival needs a slot.  Allocation
+    ticks compact the needy arrivals into a B-entry substream
+    (``alloc_budget``; None = exact), lex-sort and dedup only that,
+    and re-establish the sorted-row invariant through the bounded
+    direct-position merge instead of a full argsort — so even a
+    cluster-wide gossip wave pays a 64k-entry sort, not a stream-sized
+    one.
+
+    Arguments are :func:`merge_deliveries`'s plus the companion value
+    ``planes`` (co-permuted with ``slot_subj``) and their ``defaults``
+    (the contents an empty or freshly-claimed cell holds).  Three
+    arguments exist in a memory-lean form for the 10M-scale chunked
+    caller (J6 prices cond operands for BOTH branches, and a closure
+    captured by two branches is lifted TWICE, so everything large is
+    threaded through one explicit operand list and the lazy callables
+    are parameterized instead of closing over the planes):
+
+      evictable / remembers   arrays, or CALLABLES evaluated only
+                              inside the slow branch, taking
+                              ``(slot_subj, planes, start, rows)`` and
+                              returning the mask for that row block
+                              (the huge-table path evaluates them per
+                              block);
+      sus                     array, or a callable taking ``(val)``,
+                              or None (no suspicion payload: all -1);
+      rx                      optional (key_rx, sus_rx) accumulators to
+                              extend instead of fresh -1 planes.  They
+                              ride the claim permutation as companion
+                              planes (an evicted cell's accumulated
+                              news resets with it), which is what lets
+                              a chunked caller carry ONE rx pair
+                              across chunks.
+
+    Returns ``(slot_subj', planes', key_rx, sus_rx, dropped, forgot)``
+    with rows SORTED — the caller does not re-sort — and the rx planes
+    already at final columns.  Bit-equal on identical inputs to
+    ``merge_deliveries`` + claimed-plane reset + :func:`sort_slot_rows`
+    (tests/test_sortmerge.py pins both paths against each other and
+    against the brute-force reference)."""
+    n, K = slot_subj.shape
+    A = recv.shape[0]
+    np_ = len(planes)
+    rc0 = jnp.clip(recv.astype(jnp.int32), 0, n - 1)
+    slot0, lo0 = row_locate_lo(slot_subj, recv, subj)
+    el0 = ok & alloc & (val.astype(jnp.int32) > default_val)
+    # The allocation substream compacts every UNSEATED delivered
+    # arrival (not just the allocation-worthy ones — non-worthy
+    # duplicates still contribute to a claimed group's value max),
+    # but the slow branch only fires when a claim might actually
+    # happen.
+    unseated = ok & (slot0 < 0)
+    need_any = jnp.any(el0 & unseated)
+    # Allocation substream budget: claims per tick are physically few
+    # (bounded by the news actually spreading), so the allocation
+    # machinery runs over a COMPACTED gather of just the needy
+    # arrivals — B entries — never the whole stream.  None = exact
+    # (B = A, the ops-level default the bit-equality pin rides on);
+    # past the budget arrivals drop LOUDLY into ``dropped`` and the
+    # sender's retransmit budget retries them next tick.
+    B = A if alloc_budget is None else max(1, min(A, alloc_budget))
+
+    if sus is None:
+        susv = jnp.full((A,), -1, jnp.int32)
+    else:
+        susv = (sus(val) if callable(sus) else sus).astype(jnp.int32)
+
+    def _mask(m, ss, pl, start=None, rows_=None):
+        """Evaluate an eviction-policy mask for rows
+        [start, start+rows) against the EXPLICIT plane operands;
+        ``start=None`` means the whole table."""
+        if callable(m):
+            return m(ss, pl, start, n if rows_ is None else rows_)
+        if start is None:
+            return m
+        return jax.lax.dynamic_slice(
+            m, (start, 0), (rows_, m.shape[1])
+        )
+
+    # SEATED deliveries land every tick as one idempotent raw
+    # scatter-max at the located slots — the steady-state tick IS this
+    # scatter and nothing else.  (Group max == raw max over members.)
+    flat0 = jnp.where(ok & (slot0 >= 0), rc0 * K + slot0, n * K)
+    key_rx0, sus_rx0 = _rx_scatter(
+        flat0, val.astype(jnp.int32), susv, n, K, rx
+    )
+
+    def _unpack(ops):
+        ss = ops[0]
+        pl = tuple(ops[1:1 + np_])
+        rxk0, rxs0 = ops[1 + np_:3 + np_]
+        (recv_, subj_, val_, susv_, lo0_, el0_, flat0_, uns_) = \
+            ops[3 + np_:]
+        return (ss, pl, rxk0, rxs0, recv_, subj_, val_, susv_, lo0_,
+                el0_, flat0_, uns_)
+
+    def fast(*ops):
+        ss, pl, rxk0, rxs0 = _unpack(ops)[:4]
+        return ss, pl, rxk0, rxs0, jnp.int32(0), jnp.int32(0)
+
+    def slow(*ops):
+        (slot_subj, planes, rxk0, rxs0, recv_, subj_, val_, susv_,
+         lo0_, el0_, flat0_, uns_) = _unpack(ops)
+        # Compact the unseated arrivals into the B-entry substream
+        # (ascending stream order; one cumsum + one scatter — NOT
+        # jnp.nonzero, whose size= lowering pays a stream-length
+        # sort); allocation-worthy arrivals past the budget drop
+        # LOUDLY into ``dropped``.
+        cpos = jnp.cumsum(uns_.astype(jnp.int32)) - 1
+        ctgt = jnp.where(uns_ & (cpos < B), jnp.clip(cpos, 0, B - 1), B)
+        idx_n = (
+            jnp.full((B + 1,), A, jnp.int32)
+            .at[ctgt].set(jnp.arange(A, dtype=jnp.int32))[:B]
+        )
+        taken = idx_n < A
+        gi = jnp.minimum(idx_n, A - 1)
+        missed = (jnp.sum((el0_ & uns_).astype(jnp.int32))
+                  - jnp.sum((taken & el0_[gi]).astype(jnp.int32)))
+        r = jnp.where(taken, recv_.astype(jnp.int32)[gi], n)
+        s = jnp.where(taken, subj_.astype(jnp.int32)[gi], n)
+        idx = jnp.arange(B, dtype=jnp.int32)
+        r, s, perm = jax.lax.sort((r, s, idx), num_keys=2)
+        valid = r < n
+        gs = jnp.minimum(idx_n[perm], A - 1)
+        v = jnp.where(valid, val_.astype(jnp.int32)[gs], -1)
+        su = jnp.where(valid, susv_[gs], -1)
+        el = jnp.where(valid, el0_[gs], False)
+        lo = jnp.where(valid, lo0_[gs], 0)
+        prev_r = jnp.roll(r, 1)
+        prev_s = jnp.roll(s, 1)
+        first = (idx == 0) | (r != prev_r) | (s != prev_s)
+        v_max, su_max, el_any = _segmented_max3(
+            first, v, su, el.astype(jnp.int32)
+        )
+        rep = (jnp.roll(first, -1) | (idx == B - 1)) & valid
+        needs = rep & (el_any > 0)
+        rc = jnp.clip(r, 0, n - 1)
+
+        if not allocate:
+            dropped = missed + jnp.sum(needs.astype(jnp.int32))
+            return (slot_subj, planes, rxk0, rxs0, dropped,
+                    jnp.int32(0))
+
+        rows = jnp.arange(n, dtype=jnp.int32)
+        cols = jnp.arange(K, dtype=jnp.int32)[None, :]
+        rstart = (idx == 0) | (r != prev_r)
+        rank = _segmented_sum(rstart, needs.astype(jnp.int32)) \
+            - needs.astype(jnp.int32)
+
+        # Claim order without an argsort: under the sorted-row
+        # invariant the empties ARE the row tail, so claim j is column
+        # R0 + j for j < E, else the (j - E)-th evictable column.
+        # Column-count temps ride int8/int16 — they hold values <= K
+        # and are [n, K]-shaped, which matters at the 10M-node scale.
+        cdt = jnp.int8 if K <= 126 else jnp.int16
+        blocks = _row_blocks(n)
+        if blocks is None:
+            empty = slot_subj < 0
+            E = jnp.sum(empty, axis=1).astype(jnp.int32)
+            settled = _mask(evictable, slot_subj, planes) & ~empty
+            scnt = (jnp.cumsum(settled, axis=1, dtype=cdt)
+                    - settled.astype(cdt))
+            # settled_cols[i, j] = column of the i-th row's j-th
+            # settled slot; non-settled cells dump into the sliced-off
+            # column K.
+            sc_t = (rows[:, None] * (K + 1)
+                    + jnp.where(settled, scnt.astype(jnp.int32), K)
+                    ).ravel()
+            settled_cols = (
+                jnp.full((n * (K + 1),), K, cdt)
+                .at[sc_t].set(
+                    jnp.broadcast_to(cols.astype(cdt), (n, K)).ravel(),
+                    mode="drop")
+                .reshape(n, K + 1)[:, :K]
+            )
+            n_claim = E + jnp.sum(settled, axis=1).astype(jnp.int32)
+        else:
+            # Huge table: build the claim-order census block-by-block
+            # so the eviction mask's intermediates (key decodes etc.)
+            # never materialize at whole-table scale.
+            R, Bq = blocks
+            rows_b = jnp.arange(Bq, dtype=jnp.int32)[:, None]
+
+            def census_body(carry, rb):
+                sc_all, E_all, ns_all = carry
+                start = rb * Bq
+                ss_b = jax.lax.dynamic_slice(
+                    slot_subj, (start, 0), (Bq, K)
+                )
+                set_b = _mask(evictable, slot_subj, planes,
+                              start, Bq) & (ss_b >= 0)
+                E_b = jnp.sum(ss_b < 0, axis=1).astype(jnp.int32)
+                ns_b = jnp.sum(set_b, axis=1).astype(jnp.int32)
+                scnt_b = (jnp.cumsum(set_b, axis=1, dtype=cdt)
+                          - set_b.astype(cdt))
+                flat_b = jnp.where(
+                    set_b,
+                    rows_b * (K + 1) + scnt_b.astype(jnp.int32),
+                    Bq * (K + 1),
+                ).ravel()
+                sc_b = (
+                    jnp.full((Bq * (K + 1),), K, cdt)
+                    .at[flat_b].set(
+                        jnp.broadcast_to(
+                            cols.astype(cdt), (Bq, K)).ravel(),
+                        mode="drop")
+                    .reshape(Bq, K + 1)[:, :K]
+                )
+                return (
+                    jax.lax.dynamic_update_slice(
+                        sc_all, sc_b, (start, jnp.int32(0))),
+                    jax.lax.dynamic_update_slice(E_all, E_b, (start,)),
+                    jax.lax.dynamic_update_slice(ns_all, ns_b, (start,)),
+                ), None
+
+            (settled_cols, E, n_settled), _ = jax.lax.scan(
+                census_body,
+                (jnp.full((n, K), K, cdt),
+                 jnp.zeros((n,), jnp.int32),
+                 jnp.zeros((n,), jnp.int32)),
+                jnp.arange(R, dtype=jnp.int32),
+            )
+            n_claim = E + n_settled
+        can = needs & (rank < n_claim[rc])
+        chosen = jnp.where(
+            rank < E[rc],
+            (K - E)[rc] + jnp.minimum(rank, K - 1),
+            settled_cols[rc, jnp.clip(rank - E[rc], 0, K - 1)]
+            .astype(jnp.int32),
+        )
+        tgt = jnp.where(can, rc * K + jnp.clip(chosen, 0, K - 1), n * K)
+        claimed = (
+            jnp.zeros((n * K,), bool).at[tgt].set(True, mode="drop")
+            .reshape(n, K)
+        )
+        forgot = jnp.sum(
+            (can & _mask(remembers, slot_subj, planes)
+             .ravel()[jnp.minimum(tgt, n * K - 1)])
+            .astype(jnp.int32)
+        )
+        # A SEATED group whose cell was just claimed loses its news
+        # with the cell (the rx companion resets below); it counts
+        # into dropped exactly when some member could have allocated —
+        # read off a per-cell scatter of the el bit at the seated
+        # delivery positions.
+        # In-bounds clamp + value mask (not a droppable sentinel):
+        # masked writes are False = a max no-op, and rangelint J9 sees
+        # no unaccounted droppable units.
+        el_rx = (
+            jnp.zeros((n * K,), bool)
+            .at[jnp.clip(flat0_, 0, n * K - 1)]
+            .max(el0_ & (flat0_ < n * K),
+                 mode="promise_in_bounds").reshape(n, K)
+        )
+        dropped = (
+            missed
+            + jnp.sum((needs & ~can).astype(jnp.int32))
+            + jnp.sum((claimed & (slot_subj >= 0) & el_rx)
+                      .astype(jnp.int32))
+        )
+
+        # Bounded direct-position merge: survivors and the rank-ordered
+        # claims are two sorted sequences per row, so each cell's final
+        # column is its own column plus (#claims inserted at or before
+        # it) minus (#evictions strictly before it) — prefix counts,
+        # no argsort.
+        ev_real = claimed & (slot_subj >= 0)
+        evc = jnp.concatenate(
+            [jnp.zeros((n, 1), cdt),
+             jnp.cumsum(ev_real, axis=1, dtype=cdt)], axis=1,
+        )  # evc[i, c] = evicted columns strictly below c
+        lo_t = jnp.where(
+            can, rc * (K + 1) + jnp.clip(lo, 0, K), n * (K + 1)
+        )
+        # ncum[i, c] = #claims with insertion point <= c.  Built as a
+        # scatter-MAX of clamped rank+1 followed by a row cummax
+        # rather than a scatter-add of ones: per row the claim ranks
+        # are consecutive (0..C-1) and lo is nondecreasing in subject,
+        # so max(rank)+1 over lo <= c IS the count — and the clamp
+        # makes the int8 bound PROVABLE to rangelint J7 (a scatter-add
+        # bounds abstractly at the stream length).
+        newmax = (
+            jnp.zeros((n * (K + 1),), cdt)
+            .at[lo_t].max(
+                (jnp.clip(rank, 0, K - 1) + 1).astype(cdt), mode="drop")
+            .reshape(n, K + 1)
+        )
+        ncum = jax.lax.cummax(newmax, axis=1)
+
+        pos_new = lo - evc[rc, jnp.clip(lo, 0, K)].astype(jnp.int32) \
+            + rank
+        new_t = jnp.where(
+            can, rc * K + jnp.clip(pos_new, 0, K - 1), n * K
+        )
+
+        if blocks is None:
+            # Apply the permutation as ONE inverse-map scatter + per-
+            # plane gathers: CPU scatters cost several times a gather
+            # at [n, K] scale, so building src once and take_along'ing
+            # each plane beats scattering each plane (and it is the
+            # same math the blocked path applies per block).
+            surv = ~empty & ~claimed
+            pos_s = (cols + ncum[:, :K].astype(jnp.int32)
+                     - evc[:, :K].astype(jnp.int32))
+            out_t = jnp.where(
+                surv, rows[:, None] * K + pos_s, n * K
+            ).ravel()
+            src = (
+                jnp.full((n * K,), -1, cdt)
+                .at[out_t].set(
+                    jnp.broadcast_to(cols.astype(cdt), (n, K)).ravel(),
+                    mode="drop")
+                .reshape(n, K)
+            )
+            take = jnp.clip(src.astype(jnp.int32), 0, K - 1)
+
+            def permute(plane, d):
+                return jnp.where(
+                    src >= 0,
+                    jnp.take_along_axis(plane, take, axis=1),
+                    jnp.asarray(d, plane.dtype),
+                )
+
+            new_subj_f = permute(slot_subj, -1).ravel() \
+                .at[new_t].set(s, mode="drop")
+            out_planes = tuple(
+                permute(planes[i], defaults[i])
+                for i in range(len(defaults))
+            )
+            # The rx planes (seated deliveries + any carried
+            # accumulators) ride the claim permutation like any other
+            # companion — an evicted cell's news resets with it — then
+            # the claims' own deliveries max in at their new columns.
+            rxs_pair = tuple(permute(p0, -1) for p0 in (rxk0, rxs0))
+            key_rx, sus_rx = _rx_scatter(
+                new_t, v_max, su_max, n, K, rxs_pair
+            )
+            return (
+                new_subj_f.reshape(n, K),
+                out_planes,
+                key_rx, sus_rx, dropped, forgot,
+            )
+
+        # Huge-table construction: the permutation is ROW-LOCAL, so
+        # the planes rebuild block-by-block inside a lax.scan whose
+        # carry updates in place (J6 credits loop-carry in-placing) —
+        # the full table never coexists with a second copy of itself.
+        # Same math as the scatter construction above, applied per
+        # block via an inverted source map + take_along_axis.
+        R, Bq = blocks
+        rows_b = jnp.arange(Bq, dtype=jnp.int32)[:, None]
+
+        def blk_body(carry, rb):
+            ss, vps, rxk, rxs = carry
+            start = rb * Bq
+
+            def slb(a):
+                return jax.lax.dynamic_slice(
+                    a, (start, 0), (Bq, a.shape[1])
+                )
+
+            ss_b = slb(ss)
+            cl_b = slb(claimed)
+            evc_b = slb(evc)[:, :K].astype(jnp.int32)
+            ncum_b = slb(ncum)[:, :K].astype(jnp.int32)
+            surv_b = (ss_b >= 0) & ~cl_b
+            pos_b = cols + ncum_b - evc_b
+            flat_b = jnp.where(
+                surv_b, rows_b * K + pos_b, Bq * K
+            ).ravel()
+            src_b = (
+                jnp.full((Bq * K,), -1, cdt)
+                .at[flat_b].set(
+                    jnp.broadcast_to(cols.astype(cdt), (Bq, K)).ravel(),
+                    mode="drop")
+                .reshape(Bq, K)
+            )
+            take = jnp.clip(src_b.astype(jnp.int32), 0, K - 1)
+
+            def permute(plane, block, d):
+                nb = jnp.where(
+                    src_b >= 0,
+                    jnp.take_along_axis(block, take, axis=1),
+                    jnp.asarray(d, block.dtype),
+                )
+                return jax.lax.dynamic_update_slice(
+                    plane, nb, (start, jnp.int32(0))
+                )
+
+            ss = permute(ss, ss_b, -1)
+            vps = tuple(
+                permute(vps[i], slb(vps[i]), defaults[i])
+                for i in range(len(defaults))
+            )
+            rxk = permute(rxk, slb(rxk), -1)
+            rxs = permute(rxs, slb(rxs), -1)
+            return (ss, vps, rxk, rxs), None
+
+        (ss2, vps2, rxk2, rxs2), _ = jax.lax.scan(
+            blk_body, (slot_subj, planes, rxk0, rxs0),
+            jnp.arange(R, dtype=jnp.int32),
+        )
+        new_subj_f = ss2.ravel().at[new_t].set(s, mode="drop")
+        key_rx = (rxk2.ravel().at[new_t].max(v_max, mode="drop")
+                  .reshape(n, K))
+        sus_rx = (rxs2.ravel().at[new_t].max(su_max, mode="drop")
+                  .reshape(n, K))
+        return (
+            new_subj_f.reshape(n, K), vps2, key_rx, sus_rx,
+            dropped, forgot,
+        )
+
+    # One explicit operand list shared by both branches, captured by
+    # NEITHER as a closure: lax.cond lifts each branch's closed-over
+    # tracers separately (no cross-branch dedup), so a plane captured
+    # by both branches would be counted twice — ~12 GB of phantom J6
+    # liveness at the 10M scale.
+    ops = (
+        (slot_subj, *planes)
+        + (key_rx0, sus_rx0)
+        + (recv, subj, val, susv, lo0, el0, flat0, unseated)
+    )
+    out = jax.lax.cond(need_any, slow, fast, *ops)
+    # Guard against a branch-arity slip: planes count is static.
+    assert len(out[1]) == np_
+    return out
+
+
+def insert_rows_one(
+    slot_subj: jax.Array, planes: tuple, defaults: tuple,
+    want: jax.Array, new_subj: jax.Array,
+    *,
+    evictable: jax.Array, remembers: jax.Array,
+):
+    """Claim at most ONE slot per row for ``new_subj`` where ``want``,
+    keeping every row sorted via bounded insertion (delete the claimed
+    column, shift, insert at the subject's merge rank) — no argsort.
+    Claim preference matches the merge kernel: first empty column (the
+    row tail), else the first evictable column.  The claimed cell
+    resets to ``defaults``.
+
+    ``new_subj`` must be absent from its row wherever ``want`` is True
+    (the caller located it first).  Returns ``(slot_subj', planes',
+    can, pos, forgot)``: ``pos`` is the inserted subject's final
+    column (-1 where no claim happened).  Rows without a claim pass
+    through untouched.  Call sites gate the whole body behind
+    ``lax.cond(jnp.any(want), ...)`` so steady-state ticks skip it."""
+    n, K = slot_subj.shape
+    # Index math rides the narrow column dtype — every [n, K] int32
+    # temp here is 2.5 GiB at the 10M-node scale.
+    cdt = jnp.int8 if K <= 126 else jnp.int16
+    rows = jnp.arange(n, dtype=jnp.int32)
+    cols = jnp.arange(K, dtype=cdt)[None, :]
+    empty = slot_subj < 0
+    E = jnp.sum(empty, axis=1).astype(jnp.int32)
+    R0 = K - E
+    settled = evictable & ~empty
+    fsc = jnp.argmax(settled, axis=1).astype(jnp.int32)
+    can = want & ((E > 0) | jnp.any(settled, axis=1))
+    vcol = jnp.where(E > 0, R0, fsc)
+    forgot = jnp.sum(
+        (can & remembers[rows, jnp.clip(vcol, 0, K - 1)])
+        .astype(jnp.int32)
+    )
+    _, loq = row_locate_lo(slot_subj, rows, new_subj)
+    p = loq - jnp.where(vcol < loq, 1, 0)
+    q = jnp.broadcast_to(cols, (n, K))
+    pe = jnp.clip(p, 0, K).astype(cdt)[:, None]
+    ve = jnp.clip(vcol, 0, K).astype(cdt)[:, None]
+    t_ = q - (q > pe).astype(cdt)
+    src = t_ + (t_ >= ve).astype(cdt)
+    is_new = can[:, None] & (q == pe)
+    take = jnp.where(can[:, None], jnp.clip(src, 0, K - 1), q)
+    out_subj = jnp.take_along_axis(slot_subj, take, axis=1)
+    out_subj = jnp.where(is_new, new_subj[:, None], out_subj)
+    out_planes = tuple(
+        jnp.where(is_new, jnp.asarray(d, pl.dtype),
+                  jnp.take_along_axis(pl, take, axis=1))
+        for pl, d in zip(planes, defaults)
+    )
+    return out_subj, out_planes, can, jnp.where(can, p, -1), forgot

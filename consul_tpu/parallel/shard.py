@@ -760,10 +760,13 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         make_key,
     )
     from consul_tpu.models.membership_sparse import (
+        AGE_CAP,
+        AGE_NONE,
         COUNTER_CAP,
         DEFAULT_KEY,
+        SINCE_DTYPE,
         SparseMembershipState,
-        _claim_slot,
+        _claim_one,
         _merge_arrivals,
         _view_of,
         pp_initiator_budget,
@@ -775,7 +778,6 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         row_locate,
         sample_peers,
         sample_probe_targets,
-        sort_slot_rows,
     )
 
     base = cfg.base
@@ -997,7 +999,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         refuting = part_l & ~leaving_l & (accused >= own_inc)
         own_inc = jnp.where(refuting, accused + 1, own_inc)
         awareness = jnp.clip(
-            awareness + refuting.astype(jnp.int32),
+            awareness + refuting.astype(awareness.dtype),
             0, base.profile.awareness_max_multiplier - 1,
         )
         key_rx = key_rx.at[rows_l, self_slot].set(-1)
@@ -1011,12 +1013,9 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         )
 
         old_key = key_after_refute
-        new_key = jnp.maximum(old_key, key_rx)
-        changed = new_key > old_key
-        fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
-        suspect_since = jnp.where(
-            fresh_suspect, t, jnp.where(changed, NEVER, suspect_since)
-        )
+        # Confirmation leg first so sus_rx dies before new_key exists
+        # (the unsharded twin's J6 note); changed == (rx > old).
+        changed = key_rx > old_key
         confirming = (
             ~changed
             & (key_rank(old_key) == RANK_SUSPECT)
@@ -1028,6 +1027,13 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         )
         gained_conf = confirming & (new_confirms > confirms)
         confirms = jnp.where(changed, 0, new_confirms)
+        new_key = jnp.maximum(old_key, key_rx)
+        fresh_suspect = changed & (key_rank(new_key) == RANK_SUSPECT)
+        # Age-packed timer (models/membership_sparse.py narrowing
+        # note): fresh suspicion = age 0, view change clears to -1.
+        suspect_since = jnp.where(
+            fresh_suspect, 0, jnp.where(changed, AGE_NONE, suspect_since)
+        ).astype(SINCE_DTYPE)
         tx = jnp.where(changed | gained_conf, base.tx_limit, tx)
         key_m = new_key
 
@@ -1052,11 +1058,12 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             can_pend = failed & (st.probe_pending_at == NEVER)
             matures_at = (
                 t + base.probe_interval_ticks
-                + awareness * base.probe_timeout_ticks
+                # Widen the narrowed awareness before tick arithmetic.
+                + awareness.astype(jnp.int32) * base.probe_timeout_ticks
             )
             awareness = jnp.clip(
-                awareness + failed.astype(jnp.int32)
-                - (probing & ~failed).astype(jnp.int32),
+                awareness + failed.astype(awareness.dtype)
+                - (probing & ~failed).astype(awareness.dtype),
                 0, base.profile.awareness_max_multiplier - 1,
             )
             probe_pending_at = jnp.where(
@@ -1066,20 +1073,21 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
 
             mature = (probe_pending_at <= t) & part_l
             mslot = row_locate(slot_subj, rows_l, probe_subject)
+            # Bounded-insertion claim behind lax.cond — steady-state
+            # ticks skip it (amortized invariant, as unsharded).
             need = mature & (mslot < 0)
             slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
-            slots_p, can, choice, forgot = _claim_slot(
-                slots_p, settled_of(slots_p, rows_g), need,
-                probe_subject, blk, k_slots,
+            slots_p, can, pos, forgot, ov = _claim_one(
+                slots_p, need, probe_subject, row_ids=rows_g,
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
             forgotten = jnp.minimum(forgotten, COUNTER_CAP) + (
                 jax.lax.psum(forgot, NODE_AXIS)
             )
             overflow = jnp.minimum(overflow, COUNTER_CAP) + jax.lax.psum(
-                jnp.sum((need & ~can).astype(jnp.int32)), NODE_AXIS
+                ov, NODE_AXIS
             )
-            mslot = jnp.where(can, choice, mslot)
+            mslot = jnp.where(can, pos, mslot)
             mview = jnp.where(
                 mslot >= 0,
                 key_m[rows_l, jnp.maximum(mslot, 0)], DEFAULT_KEY,
@@ -1093,7 +1101,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                 jnp.where(apply_sus, sus_key, 0), mode="drop"
             )
             suspect_since = suspect_since.at[rows_l, scol].set(
-                jnp.where(apply_sus, t, 0), mode="drop"
+                jnp.zeros((blk,), SINCE_DTYPE), mode="drop"
             )
             confirms = confirms.at[rows_l, scol].set(0, mode="drop")
             tx = tx.at[rows_l, scol].set(base.tx_limit, mode="drop")
@@ -1103,26 +1111,40 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             probe_subject = st.probe_subject
 
         # -- 6. suspicion expiry ---------------------------------------
-        timeout = _lifeguard_timeout_ticks(base, confirms)
-        elapsed = (t - suspect_since).astype(jnp.float32)
+        # Per-class int16 threshold table (the unsharded twin's note:
+        # exact, and no [blk, K] float temps).
+        thr_table = jnp.minimum(
+            jnp.ceil(_lifeguard_timeout_ticks(
+                base,
+                jnp.arange(base.confirmations_k + 1, dtype=jnp.int32),
+            )).astype(jnp.int32),
+            AGE_CAP + 1,
+        ).astype(SINCE_DTYPE)
+        threshold = jnp.take(
+            thr_table, confirms.astype(jnp.uint8), axis=0
+        )
         expire = (
             (key_rank(key_m) == RANK_SUSPECT)
-            & (suspect_since != NEVER)
-            & (elapsed >= timeout)
+            & (suspect_since >= 0)
+            & (suspect_since >= threshold)
             & part_l[:, None]
         )
         key_m = jnp.where(
             expire, make_key(key_inc(key_m), RANK_DEAD), key_m
         )
-        suspect_since = jnp.where(expire, NEVER, suspect_since)
+        suspect_since = jnp.where(
+            expire, jnp.asarray(AGE_NONE, SINCE_DTYPE), suspect_since
+        )
         tx = jnp.where(expire, base.tx_limit, tx)
 
-        if base.probe_enabled:
-            (slot_subj, key_m, suspect_since, confirms, tx) = (
-                sort_slot_rows(
-                    slot_subj, key_m, suspect_since, confirms, tx
-                )
-            )
+        # Live timers age one tick (saturating); no trailing re-sort —
+        # merge and probe claims kept the rows sorted (amortized
+        # invariant, models/membership_sparse.py).
+        suspect_since = jnp.where(
+            suspect_since >= 0,
+            jnp.minimum(suspect_since + 1, AGE_CAP).astype(SINCE_DTYPE),
+            suspect_since,
+        )
 
         nxt = SparseMembershipState(
             slot_subj=slot_subj,

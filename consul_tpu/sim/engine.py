@@ -961,6 +961,10 @@ class SimProgram:
     budgeted: bool = True
     x64: bool = False
     note: str = ""
+    # Abstract-only entries exist for eval_shape/make_jaxpr gates
+    # (J6 capacity, rangelint ledgers) at populations that must never
+    # be compiled or executed; profile_registry skips them LOUDLY.
+    abstract_only: bool = False
     # rangelint metadata (consul_tpu/analysis/rangelint.py): ``bounds``
     # returns a pytree CONGRUENT with build()'s args whose leaves are
     # rangelint ``Bound`` instances — the initial-value interval of
@@ -1032,6 +1036,7 @@ def _sparse_bounds(cfg):
         from consul_tpu.analysis.rangelint import Bound
         from consul_tpu.models.membership import NEVER
         from consul_tpu.models.membership_sparse import (
+            AGE_NONE,
             SparseMembershipState,
         )
 
@@ -1040,7 +1045,9 @@ def _sparse_bounds(cfg):
         return (SparseMembershipState(
             slot_subj=Bound(-1, n - 1),
             key=Bound(0, 0),
-            suspect_since=Bound(nv, nv),
+            # Age-packed timer plane: -1 sentinel, saturates at
+            # AGE_CAP (the int16 certificate rides this bound).
+            suspect_since=Bound(AGE_NONE, AGE_NONE),
             confirms=Bound(0, 0),
             tx=Bound(0, 0),
             own_inc=Bound(0, 0),
@@ -1496,6 +1503,24 @@ def jaxlint_registry(include=("small", "big"),
             lambda s, k: sparse_membership_scan(s, k, scfg1m, 3, (42,)),
             scfg1m.base.n, bounds=_sparse_bounds(scfg1m),
             scale=sparse_program_at)
+        # The 10M-node target itself, abstract-only (eval_shape +
+        # make_jaxpr — zero device memory): keeps the J6 ≤ 16 GB/chip
+        # claim and the rangelint 10M certificate table PINNED by the
+        # registry gates instead of re-derived ad hoc.  This is the
+        # capacity the PR 12 narrowing + sentinel packing buys.
+        scfg10m = SparseMembershipConfig(
+            base=MembershipConfig(n=10_000_000, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),)),
+            k_slots=64,
+        )
+        add("sparse@10m", "sparse_membership_scan",
+            lambda: sparse_membership_init(scfg10m),
+            lambda s, k: sparse_membership_scan(
+                s, k, scfg10m, 3, (42,)),
+            scfg10m.base.n, bounds=_sparse_bounds(scfg10m),
+            scale=sparse_program_at, abstract_only=True,
+            note="abstract-only 10M capacity gate (never executed in "
+                 "CI; J6 + rangelint read the traced program)")
         add("swim@1m", "swim_scan",
             lambda: swim_init(swcfg1m),
             lambda s, k: swim_scan(s, k, swcfg1m, 450), swcfg1m.n,

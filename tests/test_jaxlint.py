@@ -594,10 +594,10 @@ class TestDonationPins:
         tr = big_traces["sparse@1m"]
         after = estimate_peak(tr).total_bytes
         before = estimate_peak(tr, ignore_donation=True).total_bytes
-        # Five [n, K] slot planes dominate the sparse state — 15
-        # bytes/cell after the rangelint-certified narrowing (3 int32
-        # planes + int8 confirms + int16 tx).
-        assert before - after >= int(0.99 * 1_000_000 * 64 * 15)
+        # Five [n, K] slot planes dominate the sparse state — 12
+        # bytes/cell after the PR 12 narrowing/packing (2 int32 planes
+        # + int16 age-packed suspect_since + int8 confirms + int8 tx).
+        assert before - after >= int(0.99 * 1_000_000 * 64 * 12)
 
     def test_sharded_twins_donation_visible_per_chip(self, big_traces):
         for name in big_traces:

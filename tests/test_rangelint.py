@@ -419,8 +419,9 @@ class TestRegistryGate:
         by_plane = {c.plane: c for c in certs["sparse@small"]}
         assert by_plane["[0].confirms"].minimal == "int8"
         assert by_plane["[0].tx"].minimal == "int8"
-        # suspect_since carries the NEVER sentinel: int32 is minimal.
-        assert by_plane["[0].suspect_since"].minimal == "int32"
+        # The age packing replaced the NEVER sentinel: the plane now
+        # proves within its declared int16 (tiny at the small trace).
+        assert by_plane["[0].suspect_since"].dtype == "int16"
 
     def test_bounds_metadata_congruent_for_every_spec(self):
         # Each bounds() pytree must flatten congruently with build()'s
@@ -457,9 +458,13 @@ class TestGoldenSparse1M:
     GOLDEN = {
         "[0].slot_subj": ("int32", -1, 1_000_000, "int32"),
         "[0].confirms": ("int8", 0, 2, "int8"),
-        "[0].tx": ("int16", 0, 32, "int8"),
-        "[0].awareness": ("int32", 0, 7, "int8"),
-        "[0].suspect_since": ("int32", 0, 2147483647, "int32"),
+        "[0].tx": ("int8", 0, 32, "int8"),
+        "[0].awareness": ("int8", 0, 7, "int8"),
+        # Age-packed sentinel plane (PR 12): -1 none, else ticks since
+        # the suspicion started, saturating at AGE_CAP.  The 3-step
+        # registry trace proves a tiny range (hence minimal int8); the
+        # DECLARED int16 carries the real-horizon bound.
+        "[0].suspect_since": ("int16", -1, 32000, "int8"),
         "[0].probe_subject": ("int32", 0, 999_999, "int32"),
         "[0].tick": ("int32", 0, 4, "int8"),
     }
@@ -475,19 +480,24 @@ class TestGoldenSparse1M:
 
     def test_applied_narrowing_matches_certificates(self,
                                                     sparse_1m_report):
-        # The PR applies confirms -> int8 (certificate-minimal) and
-        # tx -> int16 (one step above the proven int8, headroom-only:
-        # __post_init__ guards the bound).
+        # PR 12 applies every remaining certified narrowing: confirms,
+        # tx and awareness at the certificate-minimal int8
+        # (__post_init__ guards the bounds), and the age-packed
+        # suspect_since at int16.
         from consul_tpu.models.membership_sparse import (
+            AWARE_DTYPE,
             CONF_DTYPE,
+            SINCE_DTYPE,
             TX_DTYPE,
         )
 
-        assert CONF_DTYPE == jnp.int8 and TX_DTYPE == jnp.int16
+        assert CONF_DTYPE == jnp.int8 and TX_DTYPE == jnp.int8
+        assert AWARE_DTYPE == jnp.int8 and SINCE_DTYPE == jnp.int16
         by_plane = {c.plane: c for c in sparse_1m_report.certificates}
         assert np.iinfo(by_plane["[0].confirms"].minimal).max >= \
             by_plane["[0].confirms"].hi
-        assert np.iinfo("int16").max >= by_plane["[0].tx"].hi
+        assert np.iinfo("int8").max >= by_plane["[0].tx"].hi
+        assert np.iinfo("int8").max >= by_plane["[0].awareness"].hi
 
     def test_ledger_at_10m_clean_and_priced(self, big_programs):
         led = narrowing_ledger(big_programs["sparse@1m"], 10_000_000)
@@ -495,33 +505,37 @@ class TestGoldenSparse1M:
             f.format() for f in led.findings
         )
         by_plane = {c.plane: c for c in led.certificates}
-        # tx proven int8 at 10M too: 10M x 64 cells x (2 - 1) bytes
-        # of FURTHER headroom beyond the applied int16.
+        # The APPLIED dtypes hold at 10M: tx/confirms/awareness int8,
+        # the age-packed suspect_since within int16.
         assert by_plane["[0].tx"].minimal == "int8"
         assert by_plane["[0].confirms"].minimal == "int8"
+        assert np.iinfo("int8").max >= by_plane["[0].awareness"].hi
+        assert by_plane["[0].suspect_since"].lo >= -1
+        assert np.iinfo("int16").max > by_plane["[0].suspect_since"].hi
         assert by_plane["[0].tx"].elements == 10_000_000 * 64
 
     def test_j6_peak_delta_of_applied_narrowing_at_1m(self):
-        """The acceptance pin: the CONF_DTYPE/TX_DTYPE narrowing is
-        worth one 5-bytes/cell state copy of J6 peak HBM at 1M —
-        measured against the same program re-traced with the planes
+        """The acceptance pin: the applied narrowing/packing (confirms
+        + tx int8, age-packed suspect_since int16) is worth at least
+        one 7-bytes/cell state copy of J6 peak HBM at 1M — measured
+        against the same program re-traced with the planes
         monkeypatched back to int32 (the round arithmetic is
         dtype-parametric, so the baseline trace IS the un-narrowed
-        program: 3.35 GB before vs 3.03 GB after when measured for
-        this PR)."""
+        program)."""
         import consul_tpu.models.membership_sparse as ms
 
         now = estimate_peak(sparse_program_at(1_000_000).trace())
-        old_c, old_t = ms.CONF_DTYPE, ms.TX_DTYPE
+        old = (ms.CONF_DTYPE, ms.TX_DTYPE, ms.SINCE_DTYPE)
         ms.CONF_DTYPE = jnp.int32
         ms.TX_DTYPE = jnp.int32
+        ms.SINCE_DTYPE = jnp.int32
         try:
             base = estimate_peak(sparse_program_at(1_000_000).trace())
         finally:
-            ms.CONF_DTYPE, ms.TX_DTYPE = old_c, old_t
+            ms.CONF_DTYPE, ms.TX_DTYPE, ms.SINCE_DTYPE = old
         delta = base.total_bytes - now.total_bytes
         cells = 1_000_000 * 64
-        assert delta >= int(0.99 * 5 * cells), (
+        assert delta >= int(0.99 * 7 * cells), (
             base.total_bytes, now.total_bytes
         )
 

@@ -255,7 +255,10 @@ class TestD1BitEquality:
         assert int(o2[-1]) == 0  # no overflow path exists at D == 1
 
     @pytest.mark.parametrize(
-        "cfg", [SPARSE_CFG, SPARSE_CFG_NOPP], ids=["pp", "nopp"]
+        "cfg",
+        [SPARSE_CFG,
+         pytest.param(SPARSE_CFG_NOPP, marks=pytest.mark.slow)],
+        ids=["pp", "nopp"],
     )
     def test_membership_sparse(self, cfg):
         from consul_tpu.sim.engine import sparse_membership_scan
@@ -383,7 +386,11 @@ class TestRingBackend:
         _assert_state_equal(f1, f2)
         assert int(o2[-1]) == 0  # overflow ladder unchanged
 
-    @pytest.mark.parametrize("d", [1, 2])
+    # D=1 rides the slow tier (tier-1 budget policy): the D=2 pin
+    # subsumes the single-hop plumbing and D=1 ring==alltoall stays
+    # pinned for the dense/broadcast models in tier-1.
+    @pytest.mark.parametrize(
+        "d", [pytest.param(1, marks=pytest.mark.slow), 2])
     def test_membership_sparse_matches_alltoall(self, d):
         key = jax.random.PRNGKey(4)
         f1, o1 = sharded_sparse_membership_scan(
