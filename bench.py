@@ -433,6 +433,160 @@ def _run_multichip() -> dict:
     return {"multichip": json.loads(out.stdout.strip().splitlines()[-1])}
 
 
+def _sweepshard_section() -> dict:
+    """The sweep x shard composition datapoints (ROADMAP item 4):
+
+      composed        J6-derived max-U table for the composed
+                      sparse@100k program (universes per 8-device mesh
+                      vs the single-chip cap) plus a REAL composed run
+                      (U x n/D per device) with its loud overflow
+                      column — in-process on a multi-device
+                      accelerator, via the forced-host-device
+                      subprocess on CPU containers.
+      optimizer       evaluations-to-knee: ``--optimize`` bisection on
+                      a fine streamload ladder vs the fixed grid's
+                      cost, with the knee error in grid cells.
+      vmap_cond_cost  the vmap-pays-both-cond-branches datapoint
+                      (select semantics): sweep-sparse rounds/s vs the
+                      unsharded single study x U, with the static
+                      ``amortize=False`` escape hatch measured
+                      alongside.
+    """
+    import subprocess
+    import sys as _sys
+
+    import jax as _jax
+    import numpy as _np
+
+    out: dict = {}
+
+    # -- composed max-U + real run ---------------------------------
+    try:
+        if _jax.device_count() > 1 and _jax.default_backend() != "cpu":
+            from consul_tpu.sweep.compose import (
+                _compose_max_u,
+                _compose_real_run,
+            )
+
+            d = _jax.device_count()
+            out["composed"] = {
+                "devices": d,
+                "max_u_table": _compose_max_u(d),
+                "real_run": _compose_real_run(d, 100_000, 64, 4, 4, 0),
+                "host_devices_forced": False,
+            }
+        else:
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+            child = subprocess.run(
+                [_sys.executable, "-m", "consul_tpu.sweep.compose",
+                 "--devices", "8", "--n", "16384", "--k", "32",
+                 "--universes", "4", "--steps", "4"],
+                capture_output=True, text=True, timeout=900,
+                check=True, env=env,
+            )
+            out["composed"] = json.loads(
+                child.stdout.strip().splitlines()[-1]
+            )
+    except Exception as e:  # noqa: BLE001 - keep the other datapoints
+        out["composed_error"] = str(e)[:300]
+
+    # -- optimizer: evaluations-to-knee vs the fixed grid ----------
+    try:
+        from consul_tpu.sim.engine import run_sweep
+        from consul_tpu.sweep.optimize import optimize_sweep
+        from consul_tpu.sweep.presets import stream_load_curve
+
+        n_opt = 1024 if _jax.default_backend() == "cpu" else 100_000
+        rates = tuple(round(0.02 + 0.03 * i, 4) for i in range(16))
+        grid_uni = stream_load_curve(n=n_opt, rates=rates, steps=120)
+        grid_rep = run_sweep(grid_uni, warmup=False)
+        ov = _np.asarray(grid_rep.metrics["window_overflow"])
+        passing = _np.flatnonzero(ov <= 0)
+        grid_knee = float(rates[passing[-1]]) if passing.size else None
+        res = optimize_sweep(grid_uni, "window_overflow", knee_at=0.0)
+        opt_knee = res.best.get("rate")
+        cell = res.cell["rate"]
+        out["optimizer"] = {
+            "n": n_opt,
+            "grid_points": len(rates),
+            "grid_knee_rate": grid_knee,
+            "optimize_knee_rate": opt_knee,
+            "knee_error_cells": (
+                None if grid_knee is None or opt_knee is None
+                else round(abs(opt_knee - grid_knee) / cell, 2)
+            ),
+            "evaluations": res.evaluations,
+            "grid_evaluations": res.grid_evaluations,
+            "evaluations_saved_vs_grid": (
+                res.grid_evaluations - res.evaluations
+            ),
+            "generations": res.generations,
+        }
+    except Exception as e:  # noqa: BLE001
+        out["optimizer_error"] = str(e)[:300]
+
+    # -- vmap cond cost: sweep-sparse vs U x unsharded -------------
+    try:
+        import dataclasses as _dc
+        import time as _time
+
+        from consul_tpu.models import SparseMembershipConfig
+        from consul_tpu.models.membership import MembershipConfig
+        from consul_tpu.sim.engine import run_membership_sparse, run_sweep
+        from consul_tpu.sweep.universe import Universe
+
+        U, n_s, k_s, steps_s = 4, 4096, 16, 20
+        scfg = SparseMembershipConfig(
+            base=MembershipConfig(n=n_s, loss=0.01, profile=LAN,
+                                  fail_at=((42, 5),)),
+            k_slots=k_s,
+        )
+        single, _ov = run_membership_sparse(
+            scfg, steps_s, seed=0, track=(42,), warmup=True
+        )
+        rows = {}
+        for amortize in (True, False):
+            cfg_a = _dc.replace(scfg, amortize=amortize)
+            uni = Universe(
+                entrypoint="sparse", cfg=cfg_a, steps=steps_s,
+                seeds=tuple(range(U)), track=(42,),
+                knobs=("base.loss",),
+                values=(tuple(0.01 + 0.002 * u for u in range(U)),),
+            )
+            t0 = _time.perf_counter()
+            rep = run_sweep(uni, warmup=True)
+            rows[f"amortize_{str(amortize).lower()}"] = {
+                "rounds_per_sec_aggregate": round(rep.rounds_per_sec, 2),
+                "wall_s": round(_time.perf_counter() - t0, 2),
+            }
+        single_rps = steps_s / single.wall_s if single.wall_s else None
+        out["vmap_cond_cost"] = {
+            "universes": U,
+            "n": n_s,
+            "k_slots": k_s,
+            "unsharded_single_rounds_per_sec": (
+                round(single_rps, 2) if single_rps else None
+            ),
+            "u_x_single_rounds_per_sec": (
+                round(U * single_rps, 2) if single_rps else None
+            ),
+            **rows,
+            # < 1.0 means the sweep pays MORE than U independent
+            # studies per round — the both-branches select tax the
+            # amortize=False hatch exists to dodge.
+            "sweep_efficiency_vs_u_singles": (
+                round(rows["amortize_true"]["rounds_per_sec_aggregate"]
+                      / (U * single_rps), 3)
+                if single_rps else None
+            ),
+        }
+    except Exception as e:  # noqa: BLE001
+        out["vmap_cond_cost_error"] = str(e)[:300]
+    return out
+
+
 def main() -> None:
     budget_s = float(os.environ.get("BENCH_SECTION_BUDGET_S", "0") or 0)
     t_start = time.monotonic()
@@ -820,6 +974,18 @@ def main() -> None:
 
     multichip = section("multichip", _multichip, {})
 
+    # Sweep x shard composition + closed-loop autotuning datapoints
+    # (consul_tpu/sweep: make_sweep(mesh=), optimize.py): composed
+    # max-U-per-chip, evaluations-to-knee, and the vmapped-cond cost
+    # with its amortize= escape hatch.
+    def _sweepshard():
+        try:
+            return _sweepshard_section()
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"sweepshard_error": str(e)[:300]}
+
+    sweepshard = section("sweepshard", _sweepshard, {})
+
     # The memory axis of the perf trajectory: estimated peak-HBM per
     # benchmarked program from jaxlint's J6 estimator (consul_tpu/
     # analysis/jaxlint.py) over the big-config entrypoint registry.
@@ -1071,6 +1237,7 @@ def main() -> None:
                     **geo,
                     **membership,
                     **multichip,
+                    "sweepshard": sweepshard,
                     **jaxlint_peaks,
                     **analysis,
                     **observability,

@@ -351,6 +351,37 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: preset-appropriate)")
     sp.add_argument("--frontier-y", default="", dest="frontier_y",
                     help="latency metric of the Pareto frontier")
+    sp.add_argument("--devices", type=int, default=None,
+                    help="compose the sweep with the nodes mesh: U "
+                         "universes x n/D nodes per device in ONE "
+                         "program (sweep x shard; sharded-twin "
+                         "entrypoints only)")
+    sp.add_argument("--exchange", default="alltoall",
+                    choices=("alltoall", "ring"),
+                    help="outbox transport of a composed sweep "
+                         "(requires --devices)")
+    sp.add_argument("--optimize", action="store_true",
+                    help="close the loop: successive-halving/"
+                         "bisection over the preset's knob ladders "
+                         "instead of evaluating its fixed grid "
+                         "(consul_tpu/sweep/optimize.py)")
+    sp.add_argument("--objective", default="",
+                    help="metric to optimize (--optimize; validated "
+                         "against the entrypoint's metric registry)")
+    sp.add_argument("--minimize", action="store_true",
+                    help="minimize the objective (default: maximize)")
+    sp.add_argument("--knee-at", type=float, default=None,
+                    dest="knee_at",
+                    help="knee mode: find the largest knob value "
+                         "whose objective stays <= this threshold "
+                         "(e.g. --objective window_overflow "
+                         "--knee-at 0)")
+    sp.add_argument("--points-per-gen", type=int, default=None,
+                    dest="points_per_gen",
+                    help="universes per optimizer generation (U stays "
+                         "constant so generations never retrace)")
+    sp.add_argument("--max-generations", type=int, default=12,
+                    dest="max_generations")
 
     # Like the reference, version tolerates (and ignores) the global
     # client flags so scripted `cli ... -http-addr X` loops can include
@@ -1329,13 +1360,92 @@ async def cmd_sweep(args) -> int:
                 file=sys.stderr,
             )
             return 1
+
+    # Sweep x shard composition: --devices builds the nodes mesh and
+    # every generation/sweep program vmaps over the SHARDED inner
+    # study.  Entrypoints without a sharded twin reject loudly BEFORE
+    # any program runs (same pre-run contract as the axis typos).
+    mesh = None
+    if args.exchange != "alltoall" and args.devices is None:
+        print("Error: --exchange requires --devices (the outbox "
+              "transport only exists on the composed plane)",
+              file=sys.stderr)
+        return 1
+    if args.devices is not None:
+        from consul_tpu.sweep.universe import SWEEP_ENTRYPOINTS
+
+        if SWEEP_ENTRYPOINTS[universe.entrypoint].sharded is None:
+            composable = sorted(
+                n for n, s in SWEEP_ENTRYPOINTS.items() if s.sharded
+            )
+            print(
+                f"Error: entrypoint {universe.entrypoint!r} has no "
+                f"sharded twin — --devices composes: "
+                f"{', '.join(composable)}",
+                file=sys.stderr,
+            )
+            return 1
+        from consul_tpu.parallel.mesh import mesh_for
+
+        try:
+            mesh = mesh_for(args.devices)
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+
+    if not args.optimize:
+        # Optimizer-only flags without --optimize would silently run
+        # the full fixed grid — the exact silent-flag failure the
+        # pre-run typo contract exists to prevent.
+        stray = [flag for flag, hit in (
+            ("--objective", bool(args.objective)),
+            ("--minimize", args.minimize),
+            ("--knee-at", args.knee_at is not None),
+            ("--points-per-gen", args.points_per_gen is not None),
+            ("--max-generations", args.max_generations != 12),
+        ) if hit]
+        if stray:
+            print(f"Error: {', '.join(stray)} require(s) --optimize",
+                  file=sys.stderr)
+            return 1
+
+    if args.optimize:
+        # Closed loop: the preset's ladders define the search space;
+        # the driver finds the optimum/knee in a few batched
+        # generations (consul_tpu/sweep/optimize.py).
+        if not args.objective:
+            print("Error: --optimize requires --objective "
+                  f"(metrics for {universe.entrypoint!r}: "
+                  f"{', '.join(sorted(known))})", file=sys.stderr)
+            return 1
+        from consul_tpu.sweep.optimize import optimize_sweep
+
+        try:
+            result = optimize_sweep(
+                universe, args.objective,
+                minimize=args.minimize, knee_at=args.knee_at,
+                points_per_gen=args.points_per_gen,
+                max_generations=args.max_generations,
+                mesh=mesh, exchange=args.exchange,
+            )
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        out = result.summary()
+        if mesh is not None:
+            out["devices"] = args.devices
+            out["exchange"] = args.exchange
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+
     from consul_tpu.sim.engine import run_sweep
 
     # No warmup run: the CLI's deliverable is the study summary, not a
     # steady-state timing number (bench.py pays the warm second call
     # where universes_per_sec is the metric) — don't silently double
     # the wall-clock of a multi-minute sweep.
-    report = run_sweep(universe, warmup=False)
+    report = run_sweep(universe, warmup=False, mesh=mesh,
+                       exchange=args.exchange)
     out = report.summary()
     import numpy as np
 

@@ -192,6 +192,16 @@ class SparseMembershipConfig:
     # allocates every claimable slot in one ranked pass, so allocation
     # is no longer width-limited.  Kept so existing study configs load.
     stage_width: int = 8
+    # STATIC escape hatch for the amortized-invariant dispatch
+    # (ops/sortmerge.merge_into_rows): True (default) cond-gates the
+    # allocation machinery per tick; False pins the slow branch
+    # unconditionally — bit-equal outputs, and the knob universe
+    # sweeps pin when the predicate is structurally constant (under
+    # vmap the cond lowers to both-branches select, so a cold study
+    # that allocates every tick pays the sort AND the dead fast
+    # branch; see the sweepshard bench section).  Trace-time structure:
+    # shape-denied for sweeping (consul_tpu/sweep/universe.py).
+    amortize: bool = True
 
     def __post_init__(self):
         if self.base.join_at:
@@ -423,13 +433,14 @@ def _remembers_blocks():
 
 
 def _claim_one(slots: tuple, want: jax.Array, new_subj: jax.Array,
-               row_ids: jax.Array = None):
+               row_ids: jax.Array = None, amortize: bool = True):
     """One bounded-insertion claim per row for ``new_subj`` where
     ``want`` (the probe-maturity path): empty slots first, then
     SETTLED cells, rows kept sorted by ops/sortmerge.insert_rows_one —
     and the WHOLE body rides inside ``lax.cond(any(want), ...)`` so
     steady-state ticks (no maturing probe without a slot) skip it
-    entirely.
+    entirely.  ``amortize=False`` (the config escape hatch) runs the
+    claim body unconditionally instead — bit-equal, no cond.
 
     Returns (slots', can, pos, forgotten_delta, overflow_delta);
     ``pos`` is the inserted subject's final column (-1 where no
@@ -459,6 +470,8 @@ def _claim_one(slots: tuple, want: jax.Array, new_subj: jax.Array,
     # branch parameters — a closure captured by both branches would be
     # lifted twice into the cond's operand list (merge_into_rows'
     # phantom-liveness note).
+    if not amortize:
+        return claim(slot_subj, key_m, since, conf, tx)
     return jax.lax.cond(
         jnp.any(want), claim, skip, slot_subj, key_m, since, conf, tx
     )
@@ -470,6 +483,7 @@ def _merge_arrivals(
     ok: jax.Array, alloc: jax.Array, n: int, K: int,
     overflow: jax.Array, forgotten: jax.Array,
     row_ids: jax.Array = None,
+    amortize: bool = True,
 ):
     """The delivery pipeline on the AMORTIZED sort-merge kernel
     (ops/sortmerge.merge_into_rows): every arrival is located once
@@ -503,7 +517,7 @@ def _merge_arrivals(
         evictable=_settled_blocks(row_ids),
         remembers=_remembers_blocks(),
         default_val=DEFAULT_KEY, allocate=allocate,
-        alloc_budget=_ALLOC_BUDGET,
+        alloc_budget=_ALLOC_BUDGET, amortize=amortize,
     )
     key_m, since, conf, tx = planes
     return ((new_subj, key_m, since, conf, tx), key_rx, sus_rx,
@@ -513,7 +527,8 @@ def _merge_arrivals(
 
 def _deliver_chunked(slots, targets, packet_ok, msg_subj, msg_key,
                      msg_valid, pp, n: int, K: int,
-                     overflow: jax.Array, forgotten: jax.Array):
+                     overflow: jax.Array, forgotten: jax.Array,
+                     amortize: bool = True):
     """Delivery for streams too large to materialize whole (n ≳ 2M):
     the gossip and push/pull legs are generated chunk-by-chunk inside
     ``lax.scan`` bodies from their [n, F]/[n, M]/[I] sources — the full
@@ -545,7 +560,7 @@ def _deliver_chunked(slots, targets, packet_ok, msg_subj, msg_key,
             evictable=_settled_blocks(),
             remembers=_remembers_blocks(),
             default_val=DEFAULT_KEY, allocate=True, rx=rx,
-            alloc_budget=_ALLOC_BUDGET,
+            alloc_budget=_ALLOC_BUDGET, amortize=amortize,
         )
         # Saturating accumulation (COUNTER_CAP): the across-chunk sum
         # must stay J7-exact at the 10M stream bound.
@@ -807,6 +822,7 @@ def sparse_membership_round(
         slots_t, key_rx, sus_rx, overflow, forgotten = _deliver_chunked(
             slots_in, g_targets, g_packet_ok, g_msg_subj, g_msg_key,
             g_msg_valid, pp_sel, n, K, overflow, state.forgotten,
+            amortize=cfg.amortize,
         )
     else:
         Sg = g_targets.shape[0]
@@ -872,7 +888,7 @@ def sparse_membership_round(
 
         slots_t, key_rx, sus_rx, overflow, forgotten = _merge_arrivals(
             slots_in, recv, subj, val, sus, ok, alloc, n, K,
-            overflow, state.forgotten,
+            overflow, state.forgotten, amortize=cfg.amortize,
         )
     slot_subj, key_m, suspect_since, confirms, tx = slots_t
     # The merge re-sorts rows when it allocates: positional handles are
@@ -1016,7 +1032,7 @@ def sparse_membership_round(
             need = mature & (mslot < 0)
             slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
             slots_p, can, pos, forgot, ov = _claim_one(
-                slots_p, need, probe_subject,
+                slots_p, need, probe_subject, amortize=cfg.amortize,
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
             forgotten = jnp.minimum(forgotten, COUNTER_CAP) + forgot

@@ -331,6 +331,7 @@ def merge_into_rows(
     default_val: int, allocate: bool,
     rx: tuple = None,
     alloc_budget: int = None,
+    amortize: bool = True,
 ):
     """The amortized sort-merge tick (module docstring, "Amortized
     path"): locate every arrival once and scatter-max every SEATED
@@ -367,6 +368,14 @@ def merge_into_rows(
                               news resets with it), which is what lets
                               a chunked caller carry ONE rx pair
                               across chunks.
+
+    ``amortize`` (STATIC) selects the dispatch: True (default) is the
+    ``lax.cond`` above; False pins the slow branch unconditionally —
+    bit-equal on every input (a claim-free slow pass is the identity
+    permutation), and the escape hatch for vmapped callers (universe
+    sweeps), where cond lowers to both-branches select: a sweep whose
+    predicate is structurally constant (a cold study allocating every
+    tick) pays the sort ANYWAY and can skip the dead fast branch.
 
     Returns ``(slot_subj', planes', key_rx, sus_rx, dropped, forgot)``
     with rows SORTED — the caller does not re-sort — and the rx planes
@@ -437,12 +446,23 @@ def merge_into_rows(
     def slow(*ops):
         (slot_subj, planes, rxk0, rxs0, recv_, subj_, val_, susv_,
          lo0_, el0_, flat0_, uns_) = _unpack(ops)
-        # Compact the unseated arrivals into the B-entry substream
-        # (ascending stream order; one cumsum + one scatter — NOT
-        # jnp.nonzero, whose size= lowering pays a stream-length
-        # sort); allocation-worthy arrivals past the budget drop
-        # LOUDLY into ``dropped``.
-        cpos = jnp.cumsum(uns_.astype(jnp.int32)) - 1
+        # Compact the unseated arrivals into the B-entry substream with
+        # PRIORITIZED admission: allocation-worthy arrivals (suspect/
+        # dead/never-seated news — the ``el`` bit) take positions
+        # [0, W) in stream order, never-allocating traffic (alive@inc
+        # rows whose only job is contributing to a claimed group's
+        # value max) queues behind them at [W, ...) — so a pp-heavy
+        # cold tick can no longer spend the budget on alive rows ahead
+        # of tail-of-stream suspect news.  Two cumsums + one scatter —
+        # NOT jnp.nonzero, whose size= lowering pays a stream-length
+        # sort — and allocation-worthy arrivals past the budget still
+        # drop LOUDLY into ``dropped``.
+        worthy = uns_ & el0_
+        wq = jnp.cumsum(worthy.astype(jnp.int32))
+        cpos = jnp.where(
+            worthy, wq - 1,
+            wq[-1] + jnp.cumsum((uns_ & ~el0_).astype(jnp.int32)) - 1,
+        )
         ctgt = jnp.where(uns_ & (cpos < B), jnp.clip(cpos, 0, B - 1), B)
         idx_n = (
             jnp.full((B + 1,), A, jnp.int32)
@@ -756,7 +776,8 @@ def merge_into_rows(
         + (key_rx0, sus_rx0)
         + (recv, subj, val, susv, lo0, el0, flat0, unseated)
     )
-    out = jax.lax.cond(need_any, slow, fast, *ops)
+    out = (jax.lax.cond(need_any, slow, fast, *ops) if amortize
+           else slow(*ops))
     # Guard against a branch-arity slip: planes count is static.
     assert len(out[1]) == np_
     return out

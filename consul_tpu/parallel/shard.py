@@ -57,8 +57,6 @@ new RNG discipline.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
@@ -170,13 +168,9 @@ def _rows(x: jax.Array, start: jax.Array, blk: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange",
-                              "telemetry")
-)
-def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
-                           mesh: Mesh, exchange: str = "alltoall",
-                           telemetry: bool = False):
+def _sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
+                            mesh: Mesh, exchange: str = "alltoall",
+                            telemetry: bool = False):
     """Sharded twin of ``sim.engine.broadcast_scan``: returns
     ``(final_state, (infected[steps], overflow))`` with every per-node
     plane block-sharded over the mesh and ``overflow`` the total outbox
@@ -289,20 +283,26 @@ def sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
     return final, (outs, ov)
 
 
+# The jitted public twins live at module bottom (all statics positional-
+# hashable); the unjitted ``_sharded_*_scan`` impls above/below exist so
+# the sweep plane (consul_tpu/sweep) can vmap them with TRACED knob
+# fields inside cfg — the same unjitted/jitted split as sim.engine's
+# scan entrypoints.
+sharded_broadcast_scan = jax.jit(
+    _sharded_broadcast_scan,
+    static_argnames=("cfg", "steps", "mesh", "exchange", "telemetry"),
+)
+
+
 # ---------------------------------------------------------------------------
 # Sharded dense membership (full N x N view matrix, row blocks).
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange",
-                              "telemetry"),
-    donate_argnums=(0,),
-)
-def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
-                            mesh: Mesh, track: tuple = (),
-                            exchange: str = "alltoall",
-                            telemetry: bool = False):
+def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
+                             mesh: Mesh, track: tuple = (),
+                             exchange: str = "alltoall",
+                             telemetry: bool = False):
     """Sharded twin of ``sim.engine.membership_scan``: each device owns
     ``n/D`` observer ROWS of every [n, n] plane.  Gossip scatters route
     through the outbox; the push/pull row exchange gathers the budgeted
@@ -723,21 +723,24 @@ def sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
     return final, (*outs, ov)
 
 
+sharded_membership_scan = jax.jit(
+    _sharded_membership_scan,
+    static_argnames=("cfg", "steps", "mesh", "track", "exchange",
+                     "telemetry"),
+    donate_argnums=(0,),
+)
+
+
 # ---------------------------------------------------------------------------
 # Sharded sparse membership (top-K slots, sort-merge delivery).
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "track", "exchange",
-                              "telemetry"),
-    donate_argnums=(0,),
-)
-def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
-                                   steps: int, mesh: Mesh,
-                                   track: tuple = (),
-                                   exchange: str = "alltoall",
-                                   telemetry: bool = False):
+def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
+                                    steps: int, mesh: Mesh,
+                                    track: tuple = (),
+                                    exchange: str = "alltoall",
+                                    telemetry: bool = False):
     """Sharded twin of ``sim.engine.sparse_membership_scan``: each
     device owns ``n/D`` observer rows of the [n, K] slot planes; the
     whole inbound stream — local gossip, compacted push/pull, and the
@@ -746,7 +749,15 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
     block).  Requires K < n (the K == n identity layout is the
     unsharded parity mode).  Returns ``(final_state, outs)`` shaped
     like the unsharded scan; ``state.overflow`` additionally counts
-    outbox budget misses."""
+    outbox budget misses.
+
+    Gossip emission compacts to the same static sender budget as the
+    unsharded plane (``gossip_sender_budget`` over the LOCAL block, so
+    D == 1 keeps the exact unsharded budget): steady-state ticks carry
+    ~no live senders, and the per-chip lane expansion — the dominant
+    per-round bytes once sweeps ride this scan — tracks real traffic
+    instead of ``blk * F * M`` ~all-masked slots.  Unselected senders
+    spend no tx, count into ``overflow``, and retry next tick."""
     from consul_tpu.models.membership import (
         NEVER,
         RANK_ALIVE,
@@ -769,6 +780,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         _claim_one,
         _merge_arrivals,
         _view_of,
+        gossip_sender_budget,
         pp_initiator_budget,
         settled_of,
     )
@@ -792,9 +804,21 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
     d_shards = int(mesh.devices.size)
     blk = block_size(n, mesh)
     i_slots = pp_initiator_budget(n, base.push_pull_ticks)
-    stream_len = blk * fanout * m_drain
+    # Compacted gossip lanes: the per-shard emission bound is the LOCAL
+    # sender budget (gossip_sender_budget over blk rows — at D == 1
+    # this IS the unsharded plane's budget), not the full block width.
+    s_budget = gossip_sender_budget(blk)
+    # Owned-leg budget of the push/pull exchange: a shard SOURCES only
+    # the legs whose row it owns (~i_slots/D per leg class under
+    # uniform placement), so the per-chip [., K] leg gathers compact
+    # to 2x that mean (floor 64) instead of the full i_slots — the
+    # term that dominated the composed sweep's per-universe footprint.
+    # At D == 1 this is exactly i_slots (bit-equality); misses count
+    # into overflow and the Poissonized schedule retries them.
+    pp_owned = min(i_slots, max(64, (2 * i_slots) // d_shards))
+    stream_len = s_budget * fanout * m_drain
     if base.push_pull_enabled:
-        stream_len += 2 * i_slots * k_slots
+        stream_len += 2 * pp_owned * k_slots
     budget = outbox_budget(stream_len, d_shards)
     track_idx = jnp.asarray(track, jnp.int32) if track else jnp.zeros(
         (0,), jnp.int32
@@ -876,11 +900,46 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             & participates[targets]
         )
 
-        shape3 = (blk, fanout, m_drain)
-        recv_g = jnp.broadcast_to(targets[:, :, None], shape3).ravel()
-        subj_g = jnp.broadcast_to(msg_subj[:, None, :], shape3).ravel()
-        val_g = jnp.broadcast_to(msg_key[:, None, :], shape3).ravel()
-        ok_g = (packet_ok[:, :, None] & msg_valid[:, None, :]).ravel()
+        # Compacted emission (gossip_sender_budget over the local
+        # block — the unsharded K < n discipline verbatim): local rows
+        # holding a live message compact into s_budget sender slots
+        # BEFORE the [., F, M] lane expansion; unselected senders keep
+        # their tx (pure deferral), count into overflow, and retry.
+        has_msg = jnp.any(msg_valid, axis=1)
+        cpos = jnp.cumsum(has_msg.astype(jnp.int32)) - 1
+        ctgt = jnp.where(
+            has_msg & (cpos < s_budget),
+            jnp.clip(cpos, 0, s_budget - 1), s_budget,
+        )
+        snd = (
+            jnp.full((s_budget + 1,), blk, jnp.int32)
+            .at[ctgt].set(rows_l)[:s_budget]
+        )
+        sel_s = snd < blk
+        ov_gossip = (
+            jnp.sum(has_msg.astype(jnp.int32))
+            - jnp.sum(sel_s.astype(jnp.int32))
+        )
+        sndc = jnp.minimum(snd, blk - 1)
+        # No scatter for the mask rebuild: unused budget slots clamp to
+        # row blk-1, and a duplicate-index .set() racing True against
+        # False is unspecified under XLA (the unsharded twin's note).
+        sel_mask = has_msg & (cpos < s_budget)
+        msg_valid = msg_valid & sel_mask[:, None]
+
+        shape3 = (s_budget, fanout, m_drain)
+        g_targets = targets[sndc]
+        g_packet_ok = packet_ok[sndc] & sel_s[:, None]
+        g_msg_subj = msg_subj[sndc]
+        g_msg_key = msg_key[sndc]
+        g_msg_valid = msg_valid[sndc]
+        recv_g = jnp.broadcast_to(g_targets[:, :, None], shape3).ravel()
+        subj_g = jnp.broadcast_to(
+            g_msg_subj[:, None, :], shape3).ravel()
+        val_g = jnp.broadcast_to(g_msg_key[:, None, :], shape3).ravel()
+        ok_g = (
+            g_packet_ok[:, :, None] & g_msg_valid[:, None, :]
+        ).ravel()
         sus_g = jnp.where(
             key_rank(val_g) == RANK_SUSPECT, key_inc(val_g), -1
         )
@@ -889,6 +948,8 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         spend = jnp.where(msg_valid, fanout, 0).astype(tx.dtype)
         # unique_indices: distinct top_k slots per row (see the
         # unsharded twin's note — the J7-certified TX_DTYPE bound).
+        # Unselected senders were masked out of msg_valid above, so
+        # deferred messages spend nothing.
         tx = jnp.maximum(
             tx.at[jnp.repeat(rows_l, m_drain), sslot.ravel()]
             .add(-spend.ravel(), unique_indices=True),
@@ -897,6 +958,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
 
         # -- 2. push/pull (compacted; sources emit, outbox routes) -----
         ov_repl = jnp.int32(0)
+        ov_legs = jnp.int32(0)
         streams = [(recv_g, subj_g, val_g, sus_g, ok_g, alloc_g)]
         if base.push_pull_enabled:
             dead_cnt_l = jnp.sum(
@@ -920,22 +982,47 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                 jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got_i)
             )
             pwho = partner[who]
+
             # Each shard emits the exchange legs whose SOURCE row it
-            # owns; the outbox routes them to the receiver's shard.
-            lp = pwho - start
-            own_p = (lp >= 0) & (lp < blk) & sel
-            src_p = jnp.clip(lp, 0, blk - 1)
+            # owns, COMPACTED into pp_owned slots (the budget note
+            # above): the j-th owned leg takes slot j via one cumsum +
+            # scatter — no stream-length sort — and legs past the
+            # budget drop LOUDLY into the overflow ledger.  At D == 1
+            # every leg is owned and pp_owned == i_slots, so the
+            # selected legs keep their positions (top_k's sel is an
+            # index prefix) and the stream is bit-identical to the
+            # unsharded exchange after masking.
+            def owned_legs(src_g, recv_g_ids):
+                loc = src_g - start
+                own = (loc >= 0) & (loc < blk) & sel
+                cposl = jnp.cumsum(own.astype(jnp.int32)) - 1
+                tgtl = jnp.where(
+                    own & (cposl < pp_owned),
+                    jnp.clip(cposl, 0, pp_owned - 1), pp_owned,
+                )
+                slot = (
+                    jnp.full((pp_owned + 1,), i_slots, jnp.int32)
+                    .at[tgtl].set(
+                        jnp.arange(i_slots, dtype=jnp.int32))[:pp_owned]
+                )
+                taken = slot < i_slots
+                j = jnp.minimum(slot, i_slots - 1)
+                src_l = jnp.clip(src_g[j] - start, 0, blk - 1)
+                d_legs = (jnp.sum(own.astype(jnp.int32))
+                          - jnp.sum(taken.astype(jnp.int32)))
+                return taken, src_l, recv_g_ids[j], d_legs
+
+            tk_p, src_p, recv_p, d_p = owned_legs(pwho, who)
             subj_pull = slot_subj[src_p].ravel()
             val_pull = key_m[src_p].ravel()
-            recv_pull = jnp.repeat(who, k_slots)
-            ok_pull = jnp.repeat(own_p, k_slots) & (subj_pull >= 0)
-            li = who - start
-            own_i = (li >= 0) & (li < blk) & sel
-            src_i = jnp.clip(li, 0, blk - 1)
+            recv_pull = jnp.repeat(recv_p, k_slots)
+            ok_pull = jnp.repeat(tk_p, k_slots) & (subj_pull >= 0)
+            tk_i, src_i, recv_i, d_i = owned_legs(who, pwho)
             subj_push = slot_subj[src_i].ravel()
             val_push = key_m[src_i].ravel()
-            recv_push = jnp.repeat(pwho, k_slots)
-            ok_push = jnp.repeat(own_i, k_slots) & (subj_push >= 0)
+            recv_push = jnp.repeat(recv_i, k_slots)
+            ok_push = jnp.repeat(tk_i, k_slots) & (subj_push >= 0)
+            ov_legs = d_p + d_i
             minus1 = jnp.full(recv_pull.shape, -1, jnp.int32)
             # Settled alive@inc pp rows merge but never allocate (the
             # evict->relearn amplification gate, as unsharded).
@@ -980,11 +1067,13 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                 (slot_subj, key_m, suspect_since, confirms, tx),
                 recv_l, subj_l, val_l, sus_l, ok_l, alloc_l, n, k_slots,
                 jnp.int32(0), jnp.int32(0), row_ids=rows_g,
+                amortize=cfg.amortize,
             )
         )
         slot_subj, key_m, suspect_since, confirms, tx = slots_t
         overflow = jnp.minimum(overflow, COUNTER_CAP) + ov_repl + (
-            jax.lax.psum(overflow_l + dropped, NODE_AXIS)
+            jax.lax.psum(ov_gossip + ov_legs + overflow_l + dropped,
+                         NODE_AXIS)
         )
         forgotten = jnp.minimum(forgotten, COUNTER_CAP) + jax.lax.psum(
             forgotten_l, NODE_AXIS
@@ -1079,6 +1168,7 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
             slots_p, can, pos, forgot, ov = _claim_one(
                 slots_p, need, probe_subject, row_ids=rows_g,
+                amortize=cfg.amortize,
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
             forgotten = jnp.minimum(forgotten, COUNTER_CAP) + (
@@ -1231,19 +1321,22 @@ def sharded_sparse_membership_scan(state, key: jax.Array, cfg,
     return run(state, key)
 
 
+sharded_sparse_membership_scan = jax.jit(
+    _sharded_sparse_membership_scan,
+    static_argnames=("cfg", "steps", "mesh", "track", "exchange",
+                     "telemetry"),
+    donate_argnums=(0,),
+)
+
+
 # ---------------------------------------------------------------------------
 # Sharded streamcast (pipelined chunked event stream, windowed).
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange",
-                              "telemetry"),
-    donate_argnums=(0,),
-)
-def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
-                            mesh: Mesh, exchange: str = "alltoall",
-                            telemetry: bool = False):
+def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
+                             mesh: Mesh, exchange: str = "alltoall",
+                             telemetry: bool = False):
     """Sharded twin of ``sim.engine.streamcast_scan``: each device owns
     ``n/D`` rows of the [n, W, E] chunk plane and the [n, W] budget
     plane; the in-flight window (slot_event/slot_birth and every
@@ -1494,19 +1587,21 @@ def sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
     return run(state, key)
 
 
+sharded_streamcast_scan = jax.jit(
+    _sharded_streamcast_scan,
+    static_argnames=("cfg", "steps", "mesh", "exchange", "telemetry"),
+    donate_argnums=(0,),
+)
+
+
 # ---------------------------------------------------------------------------
 # Sharded geo/WAN plane (multi-DC, latency-delayed bandwidth-capped links).
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "mesh", "exchange",
-                              "telemetry"),
-    donate_argnums=(0,),
-)
-def sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
-                     mesh: Mesh, exchange: str = "alltoall",
-                     telemetry: bool = False):
+def _sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
+                      mesh: Mesh, exchange: str = "alltoall",
+                      telemetry: bool = False):
     """Sharded twin of ``sim.engine.geo_scan``: segments are laid out
     CONTIGUOUSLY over the mesh (``segments % D == 0``, each device
     owning ``segments/D`` whole DCs), so ALL LAN traffic — the
@@ -1743,6 +1838,13 @@ def sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
         check_rep=False,
     )
     return run(state, key)
+
+
+sharded_geo_scan = jax.jit(
+    _sharded_geo_scan,
+    static_argnames=("cfg", "steps", "mesh", "exchange", "telemetry"),
+    donate_argnums=(0,),
+)
 
 
 # ---------------------------------------------------------------------------

@@ -633,24 +633,34 @@ def run_lifeguard(
     )
 
 
-def run_sweep(universe, warmup: bool = True, telemetry: bool = False):
+def run_sweep(universe, warmup: bool = True, telemetry: bool = False,
+              mesh=None, exchange: str = "alltoall"):
     """Run a universe sweep (consul_tpu/sweep): ONE jitted program
     advances all U universes — stacked carries, per-universe PRNG keys,
     knob values as vmapped [U] arrays — and the stacked per-tick
     counters reduce host-side into a SweepReport (FP rate, flaps,
     detection-latency quantiles, Pareto frontier).
 
-    The sweep program is cached per (entrypoint, U) — both positional-
-    static, like every engine entrypoint — so repeated calls with new
-    seeds or knob VALUES never retrace.  The stacked carry is donated
-    (same J3 rationale as membership_scan: at U x state it dominates
-    the footprint).  U=1 is bit-equal to the unbatched entrypoint.
+    ``mesh=`` composes the universe axis with the ``nodes`` mesh: the
+    U-universe vmap wraps the SHARDED scan twin, so one program holds
+    U universes x n/D nodes per device (make_sweep's composition
+    seam); the report gains ``outbox_overflow`` — the per-universe
+    loud overflow column — and U=1 x D=1 stays bit-equal to the
+    unsharded sweep.  ``exchange`` picks the outbox transport.
+
+    The sweep program is cached per (entrypoint, U, telemetry, mesh,
+    exchange) — all positional-static, like every engine entrypoint —
+    so repeated calls with new seeds or knob VALUES never retrace.
+    The stacked carry is donated (same J3 rationale as
+    membership_scan: at U x state it dominates the footprint).  U=1
+    is bit-equal to the unbatched entrypoint.
     """
     # Lazy: sweep imports this module's unjitted scan impls.
     from consul_tpu.sweep.frontier import summarize_sweep
     from consul_tpu.sweep.universe import make_sweep, stacked_init
 
-    sweep = make_sweep(universe.entrypoint, universe.U, telemetry)
+    sweep = make_sweep(universe.entrypoint, universe.U, telemetry,
+                       mesh, exchange)
     keys = universe.keys()
     values = universe.knob_arrays()
 
@@ -661,10 +671,15 @@ def run_sweep(universe, warmup: bool = True, telemetry: bool = False):
         )
 
     if warmup:
-        _, outs = call()
-        jax.tree_util.tree_map(np.asarray, outs)
+        out_w = call()
+        jax.tree_util.tree_map(np.asarray, out_w[1])
     t0 = time.perf_counter()
-    _final, outs = call()
+    if mesh is None:
+        _final, outs = call()
+        overflow = None
+    else:
+        _final, outs, overflow = call()
+        overflow = np.asarray(overflow)
     outs = jax.tree_util.tree_map(np.asarray, outs)
     wall = time.perf_counter() - t0
     trace = None
@@ -680,6 +695,9 @@ def run_sweep(universe, warmup: bool = True, telemetry: bool = False):
     if trace is not None:
         report.metric_names = metric_names(universe.entrypoint)
         report.metrics_trace = np.asarray(trace)
+    if overflow is not None:
+        report.outbox_overflow = overflow
+        report.devices = int(mesh.devices.size)
     return report
 
 
@@ -1594,16 +1612,26 @@ def jaxlint_registry(include=("small", "big"),
 
     def add_sweep(tag: str, model: str, cfg, steps: int, U: int,
                   knobs: tuple, track: tuple, n: int,
-                  telemetry: bool = False) -> None:
+                  telemetry: bool = False, d: int = 0) -> None:
+        # d > 0 builds the COMPOSED sweep x shard program: the
+        # U-universe vmap over the sharded inner study on a d-device
+        # mesh (make_sweep(mesh=); skipped when the process lacks the
+        # devices, like every sharded entry).
+        if d and d > len(jax.devices()):
+            return
+        mesh = make_mesh(jax.devices()[:d]) if d else None
+
         def build(model=model, cfg=cfg, steps=steps, U=U, knobs=knobs,
-                  track=track, telemetry=telemetry):
+                  track=track, telemetry=telemetry, mesh=mesh):
             return abstract_sweep_program(model, cfg, steps, U, knobs,
-                                          track, telemetry)
+                                          track, telemetry, mesh)
 
         sfx = "/telemetry" if telemetry else ""
-        programs[f"sweep_{model}@{tag}/U{U}{sfx}"] = SimProgram(
-            name=f"sweep_{model}@{tag}/U{U}{sfx}",
+        dfx = f"xD{d}" if d else ""
+        programs[f"sweep_{model}@{tag}/U{U}{dfx}{sfx}"] = SimProgram(
+            name=f"sweep_{model}@{tag}/U{U}{dfx}{sfx}",
             entrypoint="sweep_scan", build=build, n=n,
+            devices=d or 1, per_chip=bool(d),
         )
 
     if "small" in include:
@@ -1645,6 +1673,20 @@ def jaxlint_registry(include=("small", "big"),
         sw_model, sw_cfg, sw_steps, sw_knobs, sw_track, sw_n = sw_small[0]
         add_sweep("small", sw_model, sw_cfg, sw_steps, 8, sw_knobs,
                   sw_track, sw_n, telemetry=True)
+        # COMPOSED sweep x shard twins: the five sharded-twin families
+        # at U in {1, 8} x D in sharded_devices, so every zero-findings
+        # gate walks the vmapped-shard_map program (outbox pack/
+        # exchange under the universe batch, per-universe knob rebuild
+        # inside the shard body).  J6 pin: the composed footprint is
+        # ~U x the per-shard study + the replicated knob/key planes —
+        # tests/test_sweepshard.py reads it off these entries.
+        for model, cfg, steps, knobs, track, n in sw_small:
+            if model in ("swim", "lifeguard"):
+                continue  # no sharded twin (rejected loudly by make_sweep)
+            for u in (1, 8):
+                for d in sharded_devices:
+                    add_sweep("small", model, cfg, steps, u, knobs,
+                              track, n, d=d)
     if "big" in include:
         scfg100k = SparseMembershipConfig(
             base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,

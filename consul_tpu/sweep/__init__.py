@@ -15,10 +15,17 @@ robustness/latency frontier.
                 vmapped-array knobs vs positional-static structure,
                 stacked fault-schedule severities) and
                 :func:`make_sweep` — one compiled program per
-                (entrypoint, U), knob *values* never retrace
+                (entrypoint, U, telemetry, mesh, exchange), knob
+                *values* never retrace; ``mesh=`` composes the
+                universe axis with the sharded inner study
   frontier.py   per-universe metric reduction into a
                 :class:`SweepReport` + Pareto-frontier extraction
   presets.py    seed sweeps, knob grids, fault-severity matrices
+  optimize.py   closed-loop autotuning: successive-halving/bisection
+                generations over a grid preset's knob space, reusing
+                one cached sweep program (``cli sweep --optimize``)
+  compose.py    the standalone composed max-U / real-run datapoint
+                (``python -m consul_tpu.sweep.compose``)
 """
 
 from consul_tpu.sweep.universe import (
@@ -34,6 +41,7 @@ from consul_tpu.sweep.frontier import (
     pareto_mask,
     summarize_sweep,
 )
+from consul_tpu.sweep.optimize import OptimizeResult, optimize_sweep
 from consul_tpu.sweep.presets import PRESETS, make_preset
 
 __all__ = [
@@ -46,6 +54,8 @@ __all__ = [
     "SweepReport",
     "pareto_mask",
     "summarize_sweep",
+    "OptimizeResult",
+    "optimize_sweep",
     "PRESETS",
     "make_preset",
 ]

@@ -1,0 +1,201 @@
+"""Standalone composed sweep x shard datapoint.
+
+``python -m consul_tpu.sweep.compose`` emits ONE JSON line measuring
+the tentpole composition claim (ROADMAP item: sweep x shard): how many
+universes fit per chip once the inner study shards over the ``nodes``
+mesh, and a REAL composed run (U universes x n/D nodes per device in
+one program) with its loud overflow column.
+
+Like ``python -m consul_tpu.parallel.shard``, this is bench.py's
+subprocess on single-device (CPU) containers — XLA_FLAGS must force
+the host devices before the child's first backend use, which is
+impossible in the parent — and runs in-process on a real v5e-8.
+
+Two measurements:
+
+  max_u_table   J6-derived (abstract traces, zero device memory): the
+                composed sparse@100k program's per-chip peak at U=1 vs
+                U=8 on the D-device mesh gives bytes/universe/chip;
+                max-U = the 16 GB v5e budget divided by it.  Every
+                universe occupies that footprint on EVERY chip (the
+                mesh shards nodes, not universes), so this is the
+                whole mesh's capacity — do NOT multiply by D.  The
+                unsharded single-chip number (the PR 7
+                table's sparse@100k = 53) is recomputed live alongside
+                so the multiplication factor is measured, not quoted.
+  real_run      a composed sparse sweep actually executed on the mesh
+                (U x n/D per device), reporting rounds/s and the
+                per-universe overflow column — 0 means every message a
+                single chip would have delivered was delivered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+
+def _compose_max_u(d_devices: int, budget_bytes: int = 16 << 30) -> dict:
+    """The J6 table: composed sparse@100k bytes/universe/chip at
+    D = ``d_devices`` vs the unsharded program's, and the max-U each
+    implies under the 16 GB v5e gate."""
+    import jax
+
+    from consul_tpu.analysis.jaxlint import estimate_peak
+    from consul_tpu.models import SparseMembershipConfig
+    from consul_tpu.models.membership import MembershipConfig
+    from consul_tpu.parallel.mesh import mesh_for
+    from consul_tpu.protocol.profiles import LAN
+    from consul_tpu.sweep.universe import abstract_sweep_program
+
+    cfg = SparseMembershipConfig(
+        base=MembershipConfig(n=100_000, loss=0.01, profile=LAN,
+                              fail_at=((42, 5),)),
+        k_slots=64,
+    )
+    knobs, track, steps = ("base.loss",), (42,), 3
+
+    def peak_per_u(mesh):
+        peaks = {}
+        for u in (1, 8):
+            fn, args = abstract_sweep_program(
+                "sparse", cfg, steps, u, knobs, track, False, mesh
+            )
+            peaks[u] = estimate_peak(
+                jax.make_jaxpr(fn)(*args)
+            ).chip_bytes
+        per_u = max((peaks[8] - peaks[1]) / 7.0, 1.0)
+        fixed = max(peaks[1] - per_u, 0.0)
+        return per_u, fixed
+
+    per_u0, fixed0 = peak_per_u(None)
+    max_u0 = int((budget_bytes - fixed0) // per_u0)
+    mesh = mesh_for(d_devices)
+    per_ud, fixedd = peak_per_u(mesh)
+    max_ud = int((budget_bytes - fixedd) // per_ud)
+    return {
+        "sparse@100k": {
+            "single_chip": {
+                "per_universe_bytes": int(per_u0),
+                "max_u": max_u0,
+            },
+            f"composed_D{d_devices}": {
+                "per_universe_bytes_per_chip": int(per_ud),
+                "max_u_per_chip": max_ud,
+                # One program over the whole mesh holds max_u
+                # universes at n/D nodes per device — the capacity
+                # the composition multiplies.
+                "max_u": max_ud,
+                "devices": d_devices,
+            },
+            "multiplier_vs_single_chip": round(max_ud / max(max_u0, 1),
+                                               2),
+        }
+    }
+
+
+def _compose_real_run(d_devices: int, n: int, k_slots: int, U: int,
+                      steps: int, seed: int) -> dict:
+    """One composed sparse sweep EXECUTED on the mesh: U universes x
+    n/D nodes per device, loss knob laddered, overflow reported loudly
+    per universe."""
+    import numpy as np
+
+    from consul_tpu.models import SparseMembershipConfig
+    from consul_tpu.models.membership import MembershipConfig
+    from consul_tpu.parallel.mesh import mesh_for
+    from consul_tpu.protocol.profiles import LAN
+    from consul_tpu.sim.engine import run_sweep
+    from consul_tpu.sweep.universe import Universe
+
+    cfg = SparseMembershipConfig(
+        base=MembershipConfig(n=n, loss=0.01, profile=LAN,
+                              fail_at=((42, min(2, steps - 1)),)),
+        k_slots=k_slots,
+    )
+    losses = tuple(0.01 + 0.01 * u for u in range(U))
+    uni = Universe(entrypoint="sparse", cfg=cfg, steps=steps,
+                   seeds=(seed,) * U, track=(42,),
+                   knobs=("base.loss",), values=(losses,))
+    mesh = mesh_for(d_devices)
+    t0 = time.perf_counter()
+    rep = run_sweep(uni, warmup=True, mesh=mesh)
+    wall = time.perf_counter() - t0
+    ov = np.asarray(rep.outbox_overflow)
+    return {
+        "entrypoint": "sparse",
+        "nodes": n,
+        "k_slots": k_slots,
+        "universes": U,
+        "devices": d_devices,
+        "steps": steps,
+        "rounds_per_sec": round(U * steps / rep.wall_s, 2)
+        if rep.wall_s > 0 else None,
+        "wall_s": round(wall, 2),
+        "overflow_per_universe": [int(v) for v in ov],
+        "overflow_total": int(ov.sum()),
+        "dead_known_final": [
+            int(v) for v in rep.metrics["dead_known_final"]
+        ],
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="consul_tpu.sweep.compose")
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--n", type=int, default=16384,
+                        help="real-run aggregate nodes across the mesh")
+    parser.add_argument("--k", type=int, default=32)
+    parser.add_argument("--universes", type=int, default=4)
+    parser.add_argument("--steps", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--skip-real-run", action="store_true",
+                        help="J6 table only (abstract traces)")
+    args = parser.parse_args(argv)
+
+    forced = False
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.devices}"
+        ).strip()
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            forced = True
+        except RuntimeError:
+            pass  # backend already initialized; use whatever exists
+    elif int(m.group(1)) < args.devices:
+        # Loud pre-run contract: a pre-set smaller count would make
+        # mesh_for(D) raise deep inside the J6 tracing instead.
+        print(
+            f"Error: XLA_FLAGS already forces "
+            f"{m.group(1)} host device(s) < --devices {args.devices}; "
+            f"unset it or re-run with a matching count",
+            file=sys.stderr,
+        )
+        return 1
+
+    out = {
+        "devices": args.devices,
+        "max_u_table": _compose_max_u(args.devices),
+        "host_devices_forced": forced,
+    }
+    if not args.skip_real_run:
+        out["real_run"] = _compose_real_run(
+            args.devices, args.n, args.k, args.universes, args.steps,
+            args.seed,
+        )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

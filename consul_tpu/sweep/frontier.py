@@ -140,6 +140,12 @@ class SweepReport:
     # [U, steps, M] Consul-named metrics trace + its column names.
     metric_names: tuple = ()
     metrics_trace: "np.ndarray" = None
+    # Composed (mesh=) sweeps only: the per-universe loud overflow
+    # scalar — outbox budget misses plus the family's own budget
+    # deferrals (run_sweep(mesh=); None for unsharded sweeps).
+    outbox_overflow: "np.ndarray" = None
+    # Composed sweeps: device count of the mesh (1 for unsharded).
+    devices: int = 1
 
     @property
     def universes_per_sec(self) -> float:
@@ -200,7 +206,7 @@ class SweepReport:
                 "defined": int(ok.size),
             }
 
-        return {
+        out = {
             "entrypoint": self.entrypoint,
             "n": self.n,
             "universes": self.U,
@@ -214,6 +220,14 @@ class SweepReport:
             ),
             "metrics": {k: _stats(v) for k, v in self.metrics.items()},
         }
+        if self.outbox_overflow is not None:
+            # The composed plane's loud column: per-universe overflow
+            # (outbox misses + budget deferrals), never silent.
+            out["devices"] = self.devices
+            out["overflow_total"] = int(
+                np.asarray(self.outbox_overflow).sum()
+            )
+        return out
 
 
 def _scalar(v):

@@ -63,6 +63,7 @@ _SHAPE_FIELDS = frozenset({
     # trace-time constants and branch selectors
     "delivery", "profile", "base", "faults", "lifeguard", "done_frac",
     "subject_alive", "probe_enabled", "push_pull_enabled", "name",
+    "amortize",
     "probe_interval_ms", "probe_timeout_ms", "gossip_interval_ms",
     "push_pull_interval_ms", "gossip_to_the_dead_ms",
     "suspicion_mult", "suspicion_max_timeout_mult",
@@ -98,6 +99,17 @@ class _EntrypointSpec:
     # per-link byte accounting a BandwidthSchedule caps — sweeping its
     # severity on any other entrypoint would ladder identical universes.
     bandwidth_paths: bool = False
+    # The sweep x shard composition seam: the UNJITTED sharded twin
+    # (consul_tpu/parallel/shard.py), normalized to
+    #   (state, key, ucfg, steps, track, telemetry, mesh, exchange)
+    #     -> (final, outs_core, outbox_overflow)
+    # where ``outs_core`` has EXACTLY the unsharded impl's output
+    # structure (trace last when telemetry) — so U=1 x D=1 composed is
+    # bit-equal to the unsharded sweep by the sharded plane's D == 1
+    # pins — and ``outbox_overflow`` is the study's loud overflow
+    # scalar.  None: the entrypoint has no sharded twin (swim,
+    # lifeguard) and make_sweep(mesh=) rejects it loudly.
+    sharded: Optional[Callable] = None
 
 
 def _sparse_init(cfg):
@@ -122,6 +134,75 @@ def _geo_init(cfg):
     from consul_tpu.geo.model import geo_init
 
     return geo_init(cfg)
+
+
+# --- sharded-twin adapters (the sweep x shard composition seam) ------
+# Each wraps the UNJITTED sharded impl (parallel/shard.py) — the
+# jitted twins hash cfg statically, which a traced knob inside cfg can
+# never satisfy — and normalizes the family's native overflow output
+# into (final, outs_core, outbox_overflow) with outs_core shaped
+# exactly like the unsharded impl's outputs (trace stays LAST under
+# telemetry).  Imports are lazy like the inits above (shard.py pulls
+# in the model trees).
+
+
+def _sharded_broadcast(s, k, c, steps, track, telemetry, mesh, ex):
+    from consul_tpu.parallel.shard import _sharded_broadcast_scan
+
+    final, outs = _sharded_broadcast_scan(s, k, c, steps, mesh, ex,
+                                          telemetry)
+    if telemetry:
+        infected, ov, trace = outs
+        return final, (infected, trace), ov
+    infected, ov = outs
+    return final, infected, ov
+
+
+def _sharded_membership(s, k, c, steps, track, telemetry, mesh, ex):
+    from consul_tpu.parallel.shard import _sharded_membership_scan
+
+    final, outs = _sharded_membership_scan(s, k, c, steps, mesh, track,
+                                           ex, telemetry)
+    if telemetry:
+        *core, ov, trace = outs
+        return final, (*core, trace), ov
+    *core, ov = outs
+    return final, tuple(core), ov
+
+
+def _sharded_sparse(s, k, c, steps, track, telemetry, mesh, ex):
+    from consul_tpu.parallel.shard import _sharded_sparse_membership_scan
+
+    final, outs = _sharded_sparse_membership_scan(
+        s, k, c, steps, mesh, track, ex, telemetry
+    )
+    # The sparse plane carries its loud counter in the state (model
+    # overflow + outbox misses, one ledger as unsharded).
+    return final, outs, final.overflow
+
+
+def _sharded_streamcast(s, k, c, steps, track, telemetry, mesh, ex):
+    from consul_tpu.parallel.shard import _sharded_streamcast_scan
+
+    final, outs = _sharded_streamcast_scan(s, k, c, steps, mesh, ex,
+                                           telemetry)
+    if telemetry:
+        *core, ov_t, trace = outs
+        return final, (*core, trace), ov_t[-1]
+    *core, ov_t = outs
+    # ob_ov rides the per-tick outs; the final tick holds the total.
+    return final, tuple(core), ov_t[-1]
+
+
+def _sharded_geo(s, k, c, steps, track, telemetry, mesh, ex):
+    from consul_tpu.parallel.shard import _sharded_geo_scan
+
+    final, outs = _sharded_geo_scan(s, k, c, steps, mesh, ex, telemetry)
+    if telemetry:
+        *core, ov_t, trace = outs
+        return final, (*core, trace), ov_t[-1]
+    *core, ov_t = outs
+    return final, tuple(core), ov_t[-1]
 
 
 SWEEP_ENTRYPOINTS: dict = {
@@ -152,6 +233,7 @@ SWEEP_ENTRYPOINTS: dict = {
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss"}),
         aggregate_only=frozenset({"fanout"}),
+        sharded=_sharded_broadcast,
     ),
     "membership": _EntrypointSpec(
         name="membership",
@@ -161,6 +243,7 @@ SWEEP_ENTRYPOINTS: dict = {
         base_cfg=lambda c: c,
         knob_paths=frozenset({"loss", "suspicion_scale"}),
         aggregate_only=frozenset(),
+        sharded=_sharded_membership,
     ),
     "sparse": _EntrypointSpec(
         name="sparse",
@@ -171,6 +254,7 @@ SWEEP_ENTRYPOINTS: dict = {
         base_cfg=lambda c: c.base,
         knob_paths=frozenset({"base.loss", "base.suspicion_scale"}),
         aggregate_only=frozenset(),
+        sharded=_sharded_sparse,
     ),
     # The sustained-load plane (consul_tpu/streamcast): ``rate`` is the
     # offered load — per-universe arrival schedules derive from the
@@ -186,6 +270,7 @@ SWEEP_ENTRYPOINTS: dict = {
         knob_paths=frozenset({"loss", "rate", "chunk_budget"}),
         aggregate_only=frozenset({"fanout"}),
         fault_paths=True,
+        sharded=_sharded_streamcast,
     ),
     # The geo/WAN plane (consul_tpu/geo): LAN/WAN loss and the
     # adaptive controller's EWMA gain are rate knobs, and the
@@ -204,6 +289,7 @@ SWEEP_ENTRYPOINTS: dict = {
         aggregate_only=frozenset(),
         fault_paths=True,
         bandwidth_paths=True,
+        sharded=_sharded_geo,
     ),
 }
 
@@ -465,20 +551,37 @@ def stacked_init(universe: Universe):
     )
 
 
-def make_sweep(entrypoint: str, U: int, telemetry: bool = False):
-    """The batched scan program for (entrypoint, U, telemetry) — all
-    positional-static, mirroring the engine's jit-cache discipline.
-    ``telemetry=True`` threads the in-scan metrics seam
-    (consul_tpu/obs) through the vmapped impl, so the stacked outputs
-    gain one [U, steps, M] trace plane as their LAST element — every
-    existing output stays bit-equal.
+def make_sweep(entrypoint: str, U: int, telemetry: bool = False,
+               mesh=None, exchange: str = "alltoall"):
+    """The batched scan program for (entrypoint, U, telemetry, mesh,
+    exchange) — all positional-static, mirroring the engine's
+    jit-cache discipline.  ``telemetry=True`` threads the in-scan
+    metrics seam (consul_tpu/obs) through the vmapped impl, so the
+    stacked outputs gain one [U, steps, M] trace plane as their LAST
+    element — every existing output stays bit-equal.
 
-    Returns ONE jitted callable per (entrypoint, U) (lru-cached, so
-    repeated calls share the jit cache and the knob *values* never
-    retrace — only a new U or entrypoint compiles a new program):
+    ``mesh=`` composes the two parallelism axes: the U-universe vmap
+    wraps the SHARDED scan twin (parallel/shard.py) — one program
+    holding U universes x n/D nodes per device, replicated per-round
+    draws and per-universe folded keys exactly as unsharded, outbox
+    budgets sized from the per-universe per-shard emission bound
+    (every pack_outbox call batches per universe).  The composed
+    program returns a THIRD element — the per-universe loud overflow
+    scalar (outbox misses + the family's own budget deferrals) — and
+    U=1 x D=1 is bit-equal to the unsharded sweep and to the plain
+    scan (the sharded plane's D == 1 pins compose with the sweep's
+    U=1 pins; tests/test_sweepshard.py).  ``exchange`` picks the
+    outbox transport (``"alltoall"`` | ``"ring"``), bit-equal by
+    construction.  Entrypoints without a sharded twin (swim,
+    lifeguard) reject mesh= loudly.
+
+    Returns ONE jitted callable per (entrypoint, U, telemetry, mesh,
+    exchange) (lru-cached, so repeated calls share the jit cache and
+    the knob *values* never retrace — only a new axis point compiles
+    a new program):
 
         sweep(stacked_state, keys, values, cfg, steps, knobs, track)
-          -> (stacked_final, stacked_outs)
+          -> (stacked_final, stacked_outs[, overflow])
 
     ``stacked_state`` is donated (the [U, …] carry dominates the
     footprint exactly as the unbatched carries do — jaxlint J3);
@@ -492,11 +595,12 @@ def make_sweep(entrypoint: str, U: int, telemetry: bool = False):
     # Normalized here (not via lru_cache on this function) so the
     # 2-arg legacy call and an explicit telemetry=False share ONE
     # cache entry — the one-program-per-(entrypoint, U) guard.
-    return _make_sweep(entrypoint, U, bool(telemetry))
+    return _make_sweep(entrypoint, U, bool(telemetry), mesh, exchange)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_sweep(entrypoint: str, U: int, telemetry: bool):
+def _make_sweep(entrypoint: str, U: int, telemetry: bool, mesh,
+                exchange: str):
     if entrypoint not in SWEEP_ENTRYPOINTS:
         raise ValueError(
             f"unknown sweep entrypoint {entrypoint!r} "
@@ -505,6 +609,24 @@ def _make_sweep(entrypoint: str, U: int, telemetry: bool):
     if U < 1:
         raise ValueError(f"U must be >= 1, got {U}")
     spec = SWEEP_ENTRYPOINTS[entrypoint]
+    if mesh is None:
+        if exchange != "alltoall":
+            raise ValueError(
+                f"exchange={exchange!r} requires mesh= (the outbox "
+                "transport only exists on the composed multi-chip "
+                "plane)"
+            )
+    elif spec.sharded is None:
+        raise ValueError(
+            f"entrypoint {entrypoint!r} has no sharded twin — "
+            "sweep x shard composition covers: "
+            f"{sorted(n for n, s in SWEEP_ENTRYPOINTS.items() if s.sharded)}"
+        )
+    elif exchange not in ("alltoall", "ring"):
+        raise ValueError(
+            f"unknown exchange backend {exchange!r}; "
+            "choose 'alltoall' or 'ring'"
+        )
 
     def _sweep_scan(stacked_state, keys, values, cfg, steps,
                     knobs=(), track=()):
@@ -516,11 +638,16 @@ def _make_sweep(entrypoint: str, U: int, telemetry: bool):
 
         def one(state, key, vals):
             ucfg = apply_knobs(cfg, knobs, vals)
-            return spec.call(state, key, ucfg, steps, track, telemetry)
+            if mesh is None:
+                return spec.call(state, key, ucfg, steps, track,
+                                 telemetry)
+            return spec.sharded(state, key, ucfg, steps, track,
+                                telemetry, mesh, exchange)
 
         return jax.vmap(one)(stacked_state, keys, tuple(values))
 
-    _sweep_scan.__name__ = f"sweep_{entrypoint}_U{U}"
+    tag = "" if mesh is None else f"_D{int(mesh.devices.size)}"
+    _sweep_scan.__name__ = f"sweep_{entrypoint}_U{U}{tag}"
     return jax.jit(
         _sweep_scan, static_argnames=("cfg", "steps", "knobs", "track"),
         donate_argnums=(0,),
@@ -529,13 +656,15 @@ def _make_sweep(entrypoint: str, U: int, telemetry: bool):
 
 def abstract_sweep_program(entrypoint: str, cfg, steps: int, U: int,
                            knobs: tuple = (), track: tuple = (),
-                           telemetry: bool = False):
+                           telemetry: bool = False,
+                           mesh=None, exchange: str = "alltoall"):
     """(fn, abstract args) of the batched program — the jaxlint-
     registry build shape (sim/engine.py jaxlint_registry) and the
     bench max-U-per-chip estimator both trace it: eval_shape states,
-    zero device memory."""
+    zero device memory.  ``mesh=``/``exchange=`` build the composed
+    sweep x shard program (same seam as :func:`make_sweep`)."""
     spec = SWEEP_ENTRYPOINTS[entrypoint]
-    sweep = make_sweep(entrypoint, U, telemetry)
+    sweep = make_sweep(entrypoint, U, telemetry, mesh, exchange)
     state = jax.eval_shape(lambda: spec.init(cfg))
     stacked = jax.tree_util.tree_map(
         lambda s: jax.ShapeDtypeStruct((U,) + s.shape, s.dtype), state

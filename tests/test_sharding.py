@@ -59,13 +59,23 @@ def test_scan_preserves_sharding():
     assert not final.knows.sharding.is_fully_replicated
 
 
+@pytest.mark.slow
 def test_graft_dryrun_smoke():
+    # Slow tier (tier-1 budget policy, PR 13): the dryrun is the
+    # driver's own entrypoint and every subsystem it touches keeps a
+    # direct tier-1 twin (scan pins, sharded D-pins, check gates) —
+    # this end-to-end rerun is the single largest tier-1 test at ~55s.
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_membership_sharded_matches_unsharded():
+    # Slow tier (tier-1 budget policy, PR 13): the legacy GSPMD
+    # placement path — the explicit multi-chip plane's D-pins
+    # (tests/test_shard.py) carry the sharded-equality story in
+    # tier-1; this 40-step n=256 dense pair costs ~14s of compile.
     from consul_tpu.models import MembershipConfig
     from consul_tpu.sim import run_membership
 

@@ -604,3 +604,120 @@ class TestScanChunksPadding:
             4,
         )
         assert int(seen) == 5
+
+
+class TestPrioritizedAdmission:
+    """The allocation-substream admission order (ISSUE 13 satellite):
+    allocation-worthy arrivals (suspect/dead/never-seated news) admit
+    AHEAD of never-allocating alive traffic, so a cold K << n
+    push/pull-heavy tick — thousands of alive@inc rows early in stream
+    order, the suspect news at the tail — can no longer spend the
+    budget before the news arrives."""
+
+    def _cold_pp_stream(self, n=8, K=4, heads=120, worthy=6):
+        """The cold pp-heavy shape: ``heads`` ok never-allocating
+        unseated arrivals (alive rows, alloc=False — the pull leg of a
+        cold exchange) FIRST in stream order, then ``worthy`` suspect
+        arrivals for distinct unseated subjects."""
+        rng = np.random.default_rng(0)
+        slot_subj = np.full((n, K), -1, np.int32)
+        slot_subj[:, 0] = np.arange(n)          # self slot only: cold
+        recv, subj, val, sus, ok, alloc = [], [], [], [], [], []
+        for _ in range(heads):
+            r = int(rng.integers(0, n))
+            s = (r + 1 + int(rng.integers(0, n - 1))) % n
+            recv.append(r); subj.append(s); val.append(3)
+            sus.append(-1); ok.append(True); alloc.append(False)
+        picks = set()
+        while len(picks) < worthy:
+            r = int(rng.integers(0, n))
+            s = (r + 1 + int(rng.integers(0, n - 1))) % n
+            picks.add((r, s))
+        for r, s in sorted(picks):
+            recv.append(r); subj.append(s); val.append(9)
+            sus.append(2); ok.append(True); alloc.append(True)
+        stream = tuple(np.asarray(a, dt) for a, dt in zip(
+            (recv, subj, val, sus, ok, alloc),
+            (np.int32, np.int32, np.int32, np.int32, bool, bool),
+        ))
+        return slot_subj, stream, worthy
+
+    def test_worthy_news_admits_ahead_of_alive_traffic(self):
+        slot_subj, stream, worthy = self._cold_pp_stream()
+        n, K = slot_subj.shape
+        recv, subj, val, sus, ok, alloc = stream
+        budget = 16   # << the 120 alive arrivals ahead in stream order
+        # The premise of the regression: under stream-order admission
+        # the budget would fill with never-allocating traffic before
+        # any worthy arrival (first `budget` unseated arrivals are all
+        # alloc=False).
+        assert not alloc[:budget].any()
+        got = merge_into_rows(
+            jnp.asarray(slot_subj),
+            (jnp.asarray(slot_subj * 0),), (0,),
+            jnp.asarray(recv), jnp.asarray(subj), jnp.asarray(val),
+            jnp.asarray(sus), jnp.asarray(ok), jnp.asarray(alloc),
+            evictable=jnp.zeros((n, K), bool),
+            remembers=jnp.zeros((n, K), bool),
+            default_val=0, allocate=True, alloc_budget=budget,
+        )
+        new_subj, _planes, key_rx, _sus_rx, dropped, _forgot = got
+        assert int(dropped) == 0, "worthy news dropped despite priority"
+        # Every worthy (recv, subj) pair is now seated with its value.
+        new_subj = np.asarray(new_subj)
+        key_rx = np.asarray(key_rx)
+        seated = 0
+        for r, s, v, al in zip(recv, subj, val, alloc):
+            if not al:
+                continue
+            cols = np.flatnonzero(new_subj[r] == s)
+            assert cols.size == 1, (r, s)
+            assert key_rx[r, cols[0]] == v
+            seated += 1
+        assert seated == worthy
+
+    def test_exact_budget_still_bit_equal_to_full_sort(self):
+        # With no budget pressure the prioritized order is pure
+        # permutation — the lex-sort erases it, so the full-sort pin
+        # holds unchanged (the wider sweep lives in the classes above;
+        # this pins the reordered-substream path specifically).
+        (slot_subj, planes, defaults, stream, evictable, remembers,
+         _alloc) = _random_case(17)
+        want = full_sort_path(slot_subj, planes, defaults, stream,
+                              evictable, remembers, True)
+        recv, subj, val, sus, ok, alloc = stream
+        got = merge_into_rows(
+            jnp.asarray(slot_subj),
+            tuple(jnp.asarray(p) for p in planes), defaults,
+            jnp.asarray(recv), jnp.asarray(subj), jnp.asarray(val),
+            jnp.asarray(sus), jnp.asarray(ok), jnp.asarray(alloc),
+            evictable=jnp.asarray(evictable),
+            remembers=jnp.asarray(remembers),
+            default_val=0, allocate=True,
+            alloc_budget=len(np.asarray(recv)),
+        )
+        _assert_same(got, want, "prioritized, no pressure")
+
+    def test_amortize_false_pins_slow_branch_bit_equal(self):
+        # The static escape hatch (vmapped sweeps): amortize=False runs
+        # the slow branch unconditionally and must be bit-equal on the
+        # same inputs — including a claim-free stream, where the slow
+        # branch's permutation is the identity.
+        for seed in (3, 17):
+            (slot_subj, planes, defaults, stream, evictable, remembers,
+             allocate) = _random_case(seed)
+            recv, subj, val, sus, ok, alloc = stream
+            args = (
+                jnp.asarray(slot_subj),
+                tuple(jnp.asarray(p) for p in planes), defaults,
+                jnp.asarray(recv), jnp.asarray(subj), jnp.asarray(val),
+                jnp.asarray(sus), jnp.asarray(ok), jnp.asarray(alloc),
+            )
+            kw = dict(evictable=jnp.asarray(evictable),
+                      remembers=jnp.asarray(remembers),
+                      default_val=0, allocate=allocate)
+            _assert_same(
+                merge_into_rows(*args, **kw, amortize=False),
+                merge_into_rows(*args, **kw),
+                f"amortize seed {seed}",
+            )

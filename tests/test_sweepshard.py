@@ -1,0 +1,727 @@
+"""Sweep x shard composition + closed-loop autotuning (ISSUE 13).
+
+The composed exactness ladder, weakest precondition first:
+
+  * U=1 x D=1 — the composed program (vmap over the shard_map inner
+    study) reproduces the unsharded sweep AND the plain scan
+    bit-for-bit, per sharded-twin family.  Everything both planes pin
+    transfers to the composed plane through this.
+  * D=2 == D=1 with outbox overflow 0 — sharding the inner study under
+    the universe batch changes placement, nothing else.
+  * ring == alltoall at a composed config — the exchange backend stays
+    a pure transport knob under vmap (the Pallas kernel batches).
+  * one program per (entrypoint, U, D, exchange) — the composition
+    axes are positional-static; knob values and seeds never retrace.
+
+Optimizer (consul_tpu/sweep/optimize.py): driven against brute-force
+grid references through the ``evaluate`` injection seam — argmin
+within one grid cell, knee within one grid cell at <= half the grid's
+evaluations, NaN objectives never win — plus the real streamload
+knee end-to-end.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+
+from consul_tpu.models.broadcast import BroadcastConfig, broadcast_init
+from consul_tpu.models.membership import MembershipConfig, membership_init
+from consul_tpu.models.membership_sparse import (
+    SparseMembershipConfig,
+    sparse_membership_init,
+)
+from consul_tpu.models.swim import SwimConfig
+from consul_tpu.geo import GeoConfig, geo_init
+from consul_tpu.parallel.mesh import mesh_for
+from consul_tpu.sim.engine import (
+    broadcast_scan,
+    geo_scan,
+    membership_scan,
+    run_sweep,
+    sparse_membership_scan,
+    streamcast_scan,
+)
+from consul_tpu.streamcast import StreamcastConfig, streamcast_init
+from consul_tpu.sweep import Universe
+from consul_tpu.sweep.optimize import knob_space, optimize_sweep
+from consul_tpu.sweep.universe import make_sweep, stacked_init
+
+# One config per sharded-twin family (mirrors test_sweep._SMALL shapes;
+# sparse keeps K < n — the sharded plane's requirement).
+_FAMS = {
+    "broadcast": (BroadcastConfig(n=64, fanout=3, loss=0.05),
+                  lambda c: broadcast_init(c, origin=0),
+                  broadcast_scan, 10, None),
+    "membership": (MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),)),
+                   membership_init, membership_scan, 8, (3,)),
+    "sparse": (SparseMembershipConfig(
+        base=MembershipConfig(n=48, loss=0.05, fail_at=((3, 2),)),
+        k_slots=8), sparse_membership_init,
+        sparse_membership_scan, 8, (3,)),
+    "streamcast": (StreamcastConfig(n=64, events=10, chunks=2,
+                                    window=3, fanout=3, chunk_budget=2,
+                                    rate=0.4, names=3, loss=0.05,
+                                    delivery="edges"),
+                   streamcast_init, streamcast_scan, 10, None),
+    "geo": (GeoConfig(n=64, segments=8, bridges_per_segment=2,
+                      events=4, wan_window=4, wan_msg_bytes=100,
+                      wan_capacity_bytes=800.0, wan_queue_bytes=1600.0,
+                      ae_batch=4, loss_wan=0.05),
+            geo_init, geo_scan, 8, None),
+}
+
+
+def _np_tree(x):
+    return jax.tree_util.tree_map(np.asarray, x)
+
+
+def _trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all((np.asarray(x) == np.asarray(y)).all()
+               for x, y in zip(la, lb))
+
+
+@functools.lru_cache(maxsize=None)
+def _plain(model):
+    cfg, init, scan, steps, track = _FAMS[model]
+    args = (init(cfg), jax.random.PRNGKey(5), cfg, steps)
+    if track is not None:
+        args = args + (tuple(track),)
+    final, outs = scan(*args)
+    return _np_tree(final), _np_tree(outs)
+
+
+def _uni(model, seeds):
+    cfg, _i, _s, steps, track = _FAMS[model]
+    return Universe(entrypoint=model, cfg=cfg, steps=steps,
+                    seeds=seeds, track=tuple(track) if track else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _sweep_run(model, U, d, exchange="alltoall"):
+    """One composed (d >= 1) or unsharded (d == 0) sweep run; cached so
+    the module pays one compile per distinct program."""
+    uni = _uni(model, tuple(5 + 2 * u for u in range(U)))
+    mesh = mesh_for(d) if d else None
+    sweep = make_sweep(model, U, False, mesh, exchange)
+    out = sweep(stacked_init(uni), uni.keys(), (), uni.cfg, uni.steps,
+                (), uni.track)
+    if d:
+        final, outs, ov = out
+        return _np_tree(final), _np_tree(outs), np.asarray(ov)
+    final, outs = out
+    return _np_tree(final), _np_tree(outs), None
+
+
+class TestComposedU1D1Ladder:
+    """The acceptance pin: U=1 x D=1 composed == unsharded sweep ==
+    plain scan, for every registered sharded-twin family."""
+
+    @pytest.mark.parametrize("model", sorted(_FAMS))
+    def test_u1_d1_bit_equal(self, model):
+        pf, po = _plain(model)
+        uf, uo, _ = _sweep_run(model, 1, 0)
+        cf, co, ov = _sweep_run(model, 1, 1)
+        # composed == unsharded sweep: full final state + outs.
+        assert _trees_equal(uf, cf), f"{model}: final state (D1)"
+        assert _trees_equal(uo, co), f"{model}: outs (D1)"
+        assert int(ov.sum()) == 0
+        # unsharded sweep u=0 == plain scan (the U=1 leg of the pin).
+        assert _trees_equal(
+            po, jax.tree_util.tree_map(lambda x: x[0], uo)
+        ), f"{model}: sweep vs plain outs"
+        assert _trees_equal(
+            pf, jax.tree_util.tree_map(lambda x: x[0], uf)
+        ), f"{model}: sweep vs plain final"
+
+    def test_composed_run_sweep_reports_overflow(self):
+        uni = _uni("broadcast", (5,))
+        rep = run_sweep(uni, warmup=False, mesh=mesh_for(1))
+        assert rep.outbox_overflow is not None
+        assert rep.devices == 1
+        assert int(np.asarray(rep.outbox_overflow).sum()) == 0
+        assert rep.summary()["overflow_total"] == 0
+
+
+class TestComposedD2:
+    """D=2 == D=1 with outbox overflow 0 (placement-only), at U=2 —
+    both parallelism axes live at once."""
+
+    # One family tier-1 (the exact-scatter representative, cheap
+    # compiles); the other four ride the slow tier with the same
+    # ladder (tier-1 wall-clock budget policy — the sparse composed
+    # programs alone cost ~40s of compile).
+    @pytest.mark.parametrize("model", ["broadcast"])
+    def test_d2_equals_d1_overflow_zero(self, model):
+        f1, o1, ov1 = _sweep_run(model, 2, 1)
+        f2, o2, ov2 = _sweep_run(model, 2, 2)
+        assert int(ov2.sum()) == 0, f"{model}: D2 outbox overflow"
+        assert _trees_equal(o1, o2), f"{model}: outs D2 vs D1"
+        assert _trees_equal(f1, f2), f"{model}: final D2 vs D1"
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("model", ["sparse", "membership",
+                                       "streamcast", "geo"])
+    def test_d2_equals_d1_overflow_zero_slow(self, model):
+        f1, o1, ov1 = _sweep_run(model, 2, 1)
+        f2, o2, ov2 = _sweep_run(model, 2, 2)
+        assert int(ov2.sum()) == 0
+        assert _trees_equal(o1, o2)
+        assert _trees_equal(f1, f2)
+
+
+class TestComposedTelemetry:
+    @pytest.mark.slow
+    def test_composed_telemetry_trace_matches_unsharded(self):
+        # telemetry=True composed: the [U, steps, M] trace assembles
+        # through the sharded psum seam under vmap — bit-equal to the
+        # unsharded sweep's trace at D=1 (the obs parity pins compose).
+        uni = _uni("broadcast", (5,))
+        mesh = mesh_for(1)
+        su = make_sweep("broadcast", 1, True)
+        sc = make_sweep("broadcast", 1, True, mesh)
+        _, ou = su(stacked_init(uni), uni.keys(), (), uni.cfg,
+                   uni.steps, (), uni.track)
+        _, oc, ov = sc(stacked_init(uni), uni.keys(), (), uni.cfg,
+                       uni.steps, (), uni.track)
+        assert _trees_equal(_np_tree(ou), _np_tree(oc))
+        assert int(np.asarray(ov).sum()) == 0
+
+
+class TestRingBackend:
+    def test_ring_equals_alltoall_composed(self):
+        fa, oa, ova = _sweep_run("broadcast", 2, 2)
+        fr, orr, ovr = _sweep_run("broadcast", 2, 2, "ring")
+        assert _trees_equal(oa, orr)
+        assert _trees_equal(fa, fr)
+        assert int(ovr.sum()) == 0
+
+
+class TestComposedRetraceDiscipline:
+    def test_one_program_per_u_d_exchange(self):
+        from consul_tpu.analysis.guards import TraceGuard
+
+        mesh = mesh_for(2)
+        cfg = _FAMS["broadcast"][0]
+        sweep = make_sweep("broadcast", 3, False, mesh, "alltoall")
+        assert make_sweep("broadcast", 3, False, mesh,
+                          "alltoall") is sweep
+        guard = TraceGuard(sweep, max_traces=1,
+                           name="sweep_broadcast_U3_D2")
+        for seeds, losses in [((0, 1, 2), (0.0, 0.1, 0.2)),
+                              ((3, 4, 5), (0.3, 0.4, 0.05))]:
+            uni = Universe(entrypoint="broadcast", cfg=cfg, steps=4,
+                           seeds=seeds, knobs=("loss",),
+                           values=(losses,))
+            run_sweep(uni, warmup=False, mesh=mesh)
+        guard.check()
+        assert guard.traces == 1
+
+    def test_axis_points_are_distinct_programs(self):
+        mesh1, mesh2 = mesh_for(1), mesh_for(2)
+        base = make_sweep("broadcast", 2)
+        assert make_sweep("broadcast", 2, False, mesh1) is not base
+        assert make_sweep("broadcast", 2, False, mesh2) is not (
+            make_sweep("broadcast", 2, False, mesh1)
+        )
+        assert make_sweep("broadcast", 2, False, mesh2, "ring") is not (
+            make_sweep("broadcast", 2, False, mesh2, "alltoall")
+        )
+
+    def test_no_sharded_twin_rejected_loudly(self):
+        with pytest.raises(ValueError, match="no sharded twin"):
+            make_sweep("swim", 2, False, mesh_for(1))
+        with pytest.raises(ValueError, match="no sharded twin"):
+            make_sweep("lifeguard", 2, False, mesh_for(1))
+
+    def test_exchange_without_mesh_rejected(self):
+        with pytest.raises(ValueError, match="requires mesh="):
+            make_sweep("broadcast", 2, False, None, "ring")
+
+    def test_unknown_exchange_rejected(self):
+        with pytest.raises(ValueError, match="unknown exchange"):
+            make_sweep("broadcast", 2, False, mesh_for(1), "carrier")
+
+
+# ---------------------------------------------------------------------------
+# Composed registry footprint: J6 pin — composed ~ U x per-shard study
+# + replicated knobs (the max-U-per-chip table's scaling assumption).
+# ---------------------------------------------------------------------------
+
+
+class TestComposedFootprint:
+    def test_composed_footprint_scales_linearly_in_u(self):
+        from consul_tpu.analysis.jaxlint import estimate_peak
+        from consul_tpu.sweep.universe import abstract_sweep_program
+
+        cfg = _FAMS["sparse"][0]
+        mesh = mesh_for(2)
+        peaks = {}
+        for u in (1, 4, 8):
+            fn, args = abstract_sweep_program(
+                "sparse", cfg, 4, u, ("base.loss",), (3,), False, mesh
+            )
+            peaks[u] = estimate_peak(jax.make_jaxpr(fn)(*args)).chip_bytes
+        per_u_tail = (peaks[8] - peaks[4]) / 4.0
+        per_u_head = (peaks[4] - peaks[1]) / 3.0
+        assert per_u_tail > 0 and per_u_head > 0
+        # ~linear in U: the two marginal estimates agree within 25%
+        # (the fixed part — replicated knob/key planes — cancels).
+        assert abs(per_u_tail - per_u_head) <= 0.25 * per_u_head, peaks
+        # And the composed U8 program really holds ~8 studies' state.
+        assert peaks[8] >= peaks[1] + 6 * per_u_head
+
+
+# ---------------------------------------------------------------------------
+# Optimizer: brute-force references via the evaluate injection seam.
+# ---------------------------------------------------------------------------
+
+
+def _grid_universe(grid):
+    return Universe(
+        entrypoint="swim", cfg=SwimConfig(n=64, subject=1), steps=4,
+        seeds=(0,) * len(grid), knobs=("loss",), values=(tuple(grid),),
+    )
+
+
+class TestOptimizer:
+    GRID = tuple(np.round(np.linspace(0.0, 0.6, 16), 4))
+
+    def test_min_mode_matches_grid_argmin_within_one_cell(self):
+        uni = _grid_universe(self.GRID)
+        for target in (0.07, 0.37, 0.55):
+            calls = []
+
+            def ev(rows, target=target):
+                x = np.asarray(rows[0], float)
+                calls.append(x)
+                return (x - target) ** 2
+
+            res = optimize_sweep(uni, "first_suspect_ms",
+                                 minimize=True, evaluate=ev)
+            gx = np.asarray(self.GRID)
+            gbest = float(gx[np.argmin((gx - target) ** 2)])
+            cell = float(gx[1] - gx[0])
+            assert abs(res.best["loss"] - gbest) <= cell + 1e-9
+            # Constant-U generations: the program-reuse contract.
+            assert all(len(c) == len(calls[0]) for c in calls)
+
+    def test_max_mode(self):
+        uni = _grid_universe(self.GRID)
+        res = optimize_sweep(
+            uni, "first_suspect_ms",
+            evaluate=lambda rows: -np.abs(
+                np.asarray(rows[0], float) - 0.22),
+        )
+        assert abs(res.best["loss"] - 0.22) <= 0.04 + 1e-9
+
+    def test_knee_within_cell_at_half_grid_cost(self):
+        uni = _grid_universe(self.GRID)
+        gx = np.asarray(self.GRID)
+        cell = float(gx[1] - gx[0])
+        for knee_x in (0.11, 0.4133, 0.52):
+            def ev(rows, knee_x=knee_x):
+                x = np.asarray(rows[0], float)
+                return np.where(x <= knee_x, 0.0, (x - knee_x) * 100)
+
+            res = optimize_sweep(uni, "first_suspect_ms", knee_at=0.0,
+                                 evaluate=ev)
+            grid_knee = float(gx[np.flatnonzero(
+                ev((gx,)) <= 0)[-1]])
+            assert abs(res.best["loss"] - grid_knee) <= cell + 1e-9, (
+                knee_x, res.best
+            )
+            assert res.evaluations <= res.grid_evaluations // 2, (
+                knee_x, res.evaluations, res.grid_evaluations
+            )
+
+    def test_nan_objective_never_wins(self):
+        uni = _grid_universe(self.GRID)
+
+        def ev(rows):
+            x = np.asarray(rows[0], float)
+            out = (x - 0.05) ** 2   # best region is NaN-poisoned
+            out[x < 0.3] = np.nan
+            return out
+
+        res = optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                             evaluate=ev)
+        assert res.best["loss"] >= 0.3
+
+    def test_multi_knob_min(self):
+        cfg = SwimConfig(n=64, subject=1, delivery="aggregate")
+        lg = [(ls, sc) for ls in (0.0, 0.2, 0.4, 0.6)
+              for sc in (0.2, 0.6, 1.0, 1.4)]
+        uni = Universe(
+            entrypoint="swim", cfg=cfg, steps=4, seeds=(0,) * len(lg),
+            knobs=("loss", "suspicion_scale"),
+            values=(tuple(v[0] for v in lg), tuple(v[1] for v in lg)),
+        )
+
+        def ev(rows):
+            x = np.asarray(rows[0], float)
+            y = np.asarray(rows[1], float)
+            return (x - 0.4) ** 2 + (y - 0.6) ** 2
+
+        res = optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                             evaluate=ev)
+        assert abs(res.best["loss"] - 0.4) <= 0.2 + 1e-9
+        assert abs(res.best["suspicion_scale"] - 0.6) <= 0.4 + 1e-9
+
+    def test_bimodal_endpoints_terminate_without_stalling(self):
+        # Survivors at opposite lattice ends leave the clamped box
+        # unchanged; the driver must detect the identical next lattice
+        # and stop instead of re-paying U evaluations per generation
+        # until max_generations.
+        uni = _grid_universe(self.GRID)
+        calls = []
+
+        def ev(rows):
+            x = np.asarray(rows[0], float)
+            calls.append(x)
+            return -np.abs(x - 0.3)   # best points ARE the endpoints
+
+        res = optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                             evaluate=ev)
+        assert res.generations < 12, res.generations
+        assert res.best["loss"] in (0.0, 0.6)
+        # No two generations evaluated the identical lattice.
+        as_tuples = [tuple(c) for c in calls]
+        assert len(set(as_tuples)) == len(as_tuples)
+
+    def test_minimize_and_knee_at_rejected(self):
+        uni = _grid_universe(self.GRID)
+
+        def boom(rows):
+            raise AssertionError("evaluator must not run")
+
+        with pytest.raises(ValueError, match="contradictory"):
+            optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                           knee_at=0.0, evaluate=boom)
+
+    def test_points_per_gen_is_a_ceiling_on_multi_knob_lattices(self):
+        # points_per_gen sizes the batched program (the composed
+        # max-U-per-chip bound) — the lattice must never exceed it.
+        cfg = SwimConfig(n=64, subject=1, delivery="aggregate")
+        lg = [(ls, sc) for ls in (0.0, 0.2, 0.4, 0.6)
+              for sc in (0.2, 0.6, 1.0, 1.4)]
+        uni = Universe(
+            entrypoint="swim", cfg=cfg, steps=4, seeds=(0,) * len(lg),
+            knobs=("loss", "suspicion_scale"),
+            values=(tuple(v[0] for v in lg), tuple(v[1] for v in lg)),
+        )
+        calls = []
+
+        def ev(rows):
+            x = np.asarray(rows[0], float)
+            calls.append(x)
+            return (x - 0.4) ** 2
+
+        res = optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                             points_per_gen=5, evaluate=ev)
+        assert res.points_per_gen == 4     # largest g**2 <= 5
+        assert all(len(c) <= 5 for c in calls)
+        # And too small to lattice at all rejects loudly.
+        with pytest.raises(ValueError, match="2\\*\\*2"):
+            optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                           points_per_gen=3, evaluate=ev)
+
+    def test_knee_integer_axis_lays_distinct_interior_points(self):
+        from consul_tpu.models import LifeguardConfig
+
+        ladder = tuple(float(v) for v in range(2, 31))
+        uni = Universe(
+            entrypoint="lifeguard",
+            cfg=LifeguardConfig(n=64, subject=1, delivery="aggregate"),
+            steps=4, seeds=(0,) * len(ladder),
+            knobs=("profile.gossip_nodes",), values=(ladder,),
+        )
+        calls = []
+
+        def ev(rows):
+            x = np.asarray(rows[0], float)
+            calls.append(x)
+            assert np.array_equal(x, np.round(x))   # int axis stays int
+            return np.where(x <= 9, 0.0, 100.0)
+
+        res = optimize_sweep(uni, "detect_t90_ms", knee_at=0.0,
+                             evaluate=ev)
+        assert res.best["profile.gossip_nodes"] == 9.0
+        # Refinement generations lay strictly-interior integers (the
+        # measured bracket endpoints are never re-paid), distinct
+        # while the bracket holds >= U interior integers — naive
+        # rounding collided them onto each other and the endpoints.
+        first_refine = calls[1]
+        assert len(set(first_refine)) == len(first_refine)
+        assert 2.0 not in first_refine and 30.0 not in first_refine
+
+    def test_nonpositive_points_per_gen_rejected(self):
+        uni = _grid_universe(self.GRID)
+
+        def boom(rows):
+            raise AssertionError("evaluator must not run")
+
+        with pytest.raises(ValueError, match="points_per_gen"):
+            optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                           points_per_gen=0, evaluate=boom)
+
+    def test_split_from_universes_rejected(self):
+        # split_from folds a distinct key per universe slot, so the
+        # same knob value would measure differently across lattice
+        # slots — the grid semantics the bracket logic relies on.
+        uni = Universe(
+            entrypoint="swim", cfg=SwimConfig(n=64, subject=1),
+            steps=4, split_from=3, universes=len(self.GRID),
+            knobs=("loss",), values=(tuple(self.GRID),),
+        )
+
+        def boom(rows):
+            raise AssertionError("evaluator must not run")
+
+        with pytest.raises(ValueError, match="split_from"):
+            optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                           evaluate=boom)
+
+    def test_grid_cost_is_the_presets_own_universe_count(self):
+        # Diagonal (jointly-laddered) 2-knob preset: the fixed grid
+        # cli sweep burns is its 3 universes, NOT the 3x3 per-axis
+        # product and NOT a span/cell reconstruction.
+        uni = Universe(
+            entrypoint="swim", cfg=SwimConfig(n=64, subject=1),
+            steps=4, seeds=(0,) * 3,
+            knobs=("loss", "suspicion_scale"),
+            values=((0.0, 0.2, 0.4), (0.5, 1.0, 1.5)),
+        )
+        res = optimize_sweep(
+            uni, "first_suspect_ms", minimize=True,
+            evaluate=lambda rows: np.asarray(rows[0], float),
+        )
+        assert res.grid_evaluations == 3
+
+    def test_overflow_total_surfaces_in_summary(self):
+        uni = _grid_universe(self.GRID)
+        res = optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                             evaluate=lambda rows:
+                             np.asarray(rows[0], float))
+        # Injected evaluator: no outbox exists, key stays absent.
+        assert res.overflow_total is None
+        assert "overflow_total" not in res.summary()
+        # Composed runs sum it across generations (loud contract).
+        noisy = dataclasses.replace(res, overflow_total=7)
+        assert noisy.summary()["overflow_total"] == 7
+
+    def test_unknown_objective_rejected_before_any_program(self):
+        uni = _grid_universe(self.GRID)
+
+        def boom(rows):
+            raise AssertionError("evaluator must not run")
+
+        with pytest.raises(ValueError, match="unknown objective"):
+            optimize_sweep(uni, "detect_t90_mss", evaluate=boom)
+
+    def test_knee_needs_one_varying_knob(self):
+        cfg = SwimConfig(n=64, subject=1)
+        uni = Universe(
+            entrypoint="swim", cfg=cfg, steps=4, seeds=(0,) * 4,
+            knobs=("loss", "suspicion_scale"),
+            values=((0.0, 0.1, 0.0, 0.1), (0.5, 0.5, 1.0, 1.0)),
+        )
+        with pytest.raises(ValueError, match="ONE knob axis"):
+            optimize_sweep(uni, "first_suspect_ms", knee_at=0.0,
+                           evaluate=lambda rows: np.zeros(4))
+
+    def test_nothing_to_optimize_rejected(self):
+        uni = Universe(
+            entrypoint="swim", cfg=SwimConfig(n=64, subject=1),
+            steps=4, seeds=(0, 1), knobs=("loss",),
+            values=((0.1, 0.1),),
+        )
+        with pytest.raises(ValueError, match="nothing to optimize"):
+            optimize_sweep(uni, "first_suspect_ms",
+                           evaluate=lambda rows: np.zeros(2))
+
+    def test_knob_space_reads_the_ladder(self):
+        uni = _grid_universe(self.GRID)
+        varying, fixed, bounds, cell = knob_space(uni)
+        assert varying == ("loss",)
+        assert bounds["loss"] == (0.0, 0.6)
+        assert cell["loss"] == pytest.approx(0.04)
+
+    def test_fixed_knobs_ride_along_pinned(self):
+        cfg = SwimConfig(n=64, subject=1, delivery="aggregate")
+        uni = Universe(
+            entrypoint="swim", cfg=cfg, steps=4, seeds=(0,) * 4,
+            knobs=("loss", "suspicion_scale"),
+            values=((0.0, 0.2, 0.4, 0.6), (0.7, 0.7, 0.7, 0.7)),
+        )
+        seen = {}
+
+        def ev(rows):
+            seen["scale"] = tuple(rows[1])
+            return np.asarray(rows[0], float)
+
+        res = optimize_sweep(uni, "first_suspect_ms", minimize=True,
+                             evaluate=ev)
+        assert set(seen["scale"]) == {0.7}
+        assert res.fixed == {"suspicion_scale": 0.7}
+
+
+class TestOptimizerEndToEnd:
+    """The real closed loop: bisection over a fine streamload ladder
+    lands on the fixed grid's knee at a fraction of its cost (the
+    acceptance claim, at test-scale n)."""
+
+    @pytest.mark.slow
+    def test_streamload_knee_vs_fixed_grid(self):
+        from consul_tpu.sweep.presets import stream_load_curve
+
+        rates = tuple(round(0.02 + 0.03 * i, 4) for i in range(16))
+        uni = stream_load_curve(n=512, rates=rates, steps=100)
+        grid_rep = run_sweep(uni, warmup=False)
+        ov = np.asarray(grid_rep.metrics["window_overflow"])
+        passing = np.flatnonzero(ov <= 0)
+        assert passing.size, "ladder floor already overflows"
+        assert (ov > 0).any(), "ladder never overflows — no knee"
+        grid_knee = float(rates[passing[-1]])
+        res = optimize_sweep(uni, "window_overflow", knee_at=0.0)
+        cell = res.cell["rate"]
+        assert abs(res.best["rate"] - grid_knee) <= cell + 1e-9, (
+            res.best, grid_knee
+        )
+        assert res.evaluations <= res.grid_evaluations // 2
+
+    def test_cli_optimize_contract(self, capsys, monkeypatch):
+        import json as _json
+
+        from consul_tpu import cli
+        from consul_tpu.sweep import optimize as opt_mod
+
+        # Typo objective dies before any program (ValueError path).
+        rc = cli.main(["sweep", "streamload", "--optimize",
+                       "--objective", "window_overfloww"])
+        assert rc == 1
+        assert "unknown objective" in capsys.readouterr().err
+
+        # Optimizer-only flags without --optimize reject loudly
+        # instead of silently burning the full fixed grid.
+        rc = cli.main(["sweep", "streamload",
+                       "--objective", "window_overflow",
+                       "--knee-at", "0"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "require(s) --optimize" in err
+        assert "--objective" in err and "--knee-at" in err
+
+        # Missing objective names the registry.
+        rc = cli.main(["sweep", "streamload", "--optimize"])
+        assert rc == 1
+        assert "requires --objective" in capsys.readouterr().err
+
+        # Happy path with a stubbed driver: summary JSON round-trips.
+        def fake(uni, objective, **kw):
+            return opt_mod.OptimizeResult(
+                entrypoint=uni.entrypoint, objective=objective,
+                mode="knee", knee_at=0.0, knobs=("rate",), fixed={},
+                best={"rate": 0.3, "objective": 0.0},
+                bracket={"rate": [0.3, 0.32]}, cell={"rate": 0.02},
+                evaluations=8, generations=2, grid_evaluations=16,
+                points_per_gen=4, history=[],
+            )
+
+        monkeypatch.setattr(opt_mod, "optimize_sweep", fake)
+        rc = cli.main(["sweep", "streamload", "--optimize",
+                       "--objective", "window_overflow",
+                       "--knee-at", "0"])
+        assert rc == 0
+        out = _json.loads(capsys.readouterr().out)
+        assert out["best"]["rate"] == 0.3
+        assert out["evaluations_saved_vs_grid"] == 8
+
+    def test_cli_devices_rejects_unsharded_entrypoint(self, capsys):
+        from consul_tpu import cli
+
+        # seeds4k is a swim preset — no sharded twin, loud pre-run.
+        rc = cli.main(["sweep", "seeds4k", "--universes", "2",
+                       "--devices", "2"])
+        assert rc == 1
+        assert "no sharded twin" in capsys.readouterr().err
+
+    def test_cli_exchange_requires_devices(self, capsys):
+        from consul_tpu import cli
+
+        rc = cli.main(["sweep", "streamload", "--exchange", "ring"])
+        assert rc == 1
+        assert "requires --devices" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# amortize= escape hatch (ops/sortmerge dispatch pin at model level).
+# ---------------------------------------------------------------------------
+
+
+class TestAmortizeEscapeHatch:
+    @pytest.mark.slow
+    def test_amortize_false_is_bit_equal(self):
+        # Slow tier: the model-level twin of the tier-1 ops pin
+        # (test_sortmerge.TestPrioritizedAdmission.test_amortize_
+        # false_pins_slow_branch_bit_equal) — a fresh sparse compile.
+        cfg = _FAMS["sparse"][0]
+        key = jax.random.PRNGKey(5)
+        f1, o1 = sparse_membership_scan(
+            sparse_membership_init(cfg), key, cfg, 8, (3,))
+        cfg2 = dataclasses.replace(cfg, amortize=False)
+        f2, o2 = sparse_membership_scan(
+            sparse_membership_init(cfg2), key, cfg2, 8, (3,))
+        assert _trees_equal(_np_tree(o1), _np_tree(o2))
+        assert _trees_equal(_np_tree(f1), _np_tree(f2))
+
+    def test_amortize_is_shape_denied_for_sweeps(self):
+        with pytest.raises(ValueError,
+                           match="shapes or trace-time structure"):
+            Universe(entrypoint="sparse", cfg=_FAMS["sparse"][0],
+                     steps=4, seeds=(0,), knobs=("amortize",),
+                     values=((0,),))
+
+    @pytest.mark.slow
+    def test_amortize_false_reaches_the_chunked_driver(self):
+        # The >=2M-row regime routes delivery through _deliver_chunked;
+        # amortize=False must pin the slow branch THERE too (abstract
+        # trace only — count the dispatch conds, zero device memory).
+        from consul_tpu.models.membership import LAN, MembershipConfig
+        from consul_tpu.models.membership_sparse import (
+            _CHUNK_A, arrival_count)
+        from consul_tpu.sim import engine
+
+        def conds(amortize):
+            cfg = SparseMembershipConfig(
+                base=MembershipConfig(n=3_000_000, loss=0.01,
+                                      profile=LAN, fail_at=((42, 5),)),
+                k_slots=64, amortize=amortize)
+            assert arrival_count(cfg) > _CHUNK_A
+            state = jax.eval_shape(
+                lambda: sparse_membership_init(cfg))
+            jaxpr = jax.make_jaxpr(
+                lambda s, k: engine._sparse_membership_scan(
+                    s, k, cfg, 2, (42,))
+            )(state, jax.random.PRNGKey(0))
+            n = [0]
+
+            def walk(j):
+                for e in j.eqns:
+                    if e.primitive.name == "cond":
+                        n[0] += 1
+                    for v in e.params.values():
+                        for cj in (v if isinstance(v, (list, tuple))
+                                   else (v,)):
+                            if hasattr(cj, "jaxpr"):
+                                walk(cj.jaxpr)
+            walk(jaxpr.jaxpr)
+            return n[0]
+
+        assert conds(False) == 0 < conds(True)
