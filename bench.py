@@ -433,6 +433,113 @@ def _run_multichip() -> dict:
     return {"multichip": json.loads(out.stdout.strip().splitlines()[-1])}
 
 
+def _owned_draws_section(sweepshard: dict, membership: dict) -> dict:
+    """The owned per-(round, node) randomness plane datapoints:
+
+      draw_term       the J6 draw-term before/after pin — one round's
+                      draw planes at sparse@100k shapes, traced as the
+                      pre-owned REPLICATED set (full [n, .] planes, the
+                      O(n)/chip term every shard used to pay) vs the
+                      owned set at blk = n/D for D in {1, 2, 4, 8}: the
+                      per-chip draw bytes fall ~n/D.
+      composed_max_u  the acceptance headline — composed sparse@100k
+                      universes per 8-device mesh (live from the
+                      sweepshard section's J6 table when it ran) vs the
+                      PR 13 replicated-draw baseline, PINNED as a
+                      historical constant (the code that produced it is
+                      gone, the sparse_1m_flops precedent).
+      rounds_per_sec  the steady-state sparse@100k throughput next to
+                      its PR 12 pinned baseline — owned derivation adds
+                      one vmapped fold_in per draw site, so this is the
+                      "did the counter-based keys cost wall clock"
+                      honesty row.
+
+    Abstract J6 tracing only (zero device memory) except the reused
+    live numbers; rides BENCH_SECTION_BUDGET_S like every section.
+    """
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from consul_tpu.analysis.jaxlint import estimate_peak
+    from consul_tpu.ops import (
+        bernoulli_mask_owned,
+        owned_uniform,
+        sample_peers_owned,
+    )
+
+    n, fanout, k_slots = 100_000, 3, 64
+
+    def _peak(fn):
+        return estimate_peak(
+            _jax.make_jaxpr(fn)(_jax.random.PRNGKey(0))
+        ).chip_bytes
+
+    def replicated(key):
+        # The pre-owned draw set of one sparse round, full-population
+        # on EVERY chip (PR 4's slice-per-block design).
+        k1, k2, k3, k4, k5 = _jax.random.split(key, 5)
+        return (_jax.random.uniform(k1, (n, k_slots)),
+                _jax.random.randint(k2, (n, fanout), 0, n - 1,
+                                    dtype=_jnp.int32),
+                _jax.random.uniform(k3, (n, fanout)),
+                _jax.random.uniform(k4, (n,)),
+                _jax.random.uniform(k5, (n,)))
+
+    def owned(blk):
+        def f(key):
+            ids = _jnp.arange(blk, dtype=_jnp.int32)
+            k1, k2, k3, k4, k5 = _jax.random.split(key, 5)
+            return (owned_uniform(k1, ids, (k_slots,)),
+                    sample_peers_owned(k2, ids, n, fanout),
+                    bernoulli_mask_owned(k3, ids, (fanout,), 0.9),
+                    owned_uniform(k4, ids),
+                    owned_uniform(k5, ids))
+        return f
+
+    repl_bytes = _peak(replicated)
+    table = {
+        "replicated_full_population_bytes": int(repl_bytes),
+        "owned_bytes_per_chip": {
+            f"D{d}": int(_peak(owned(n // d))) for d in (1, 2, 4, 8)
+        },
+    }
+    d8 = table["owned_bytes_per_chip"]["D8"]
+    table["owned_D8_vs_replicated"] = round(d8 / repl_bytes, 4)
+
+    out: dict = {"draw_term_sparse100k": table}
+
+    # Composed max-U: live from sweepshard's compose table; baseline
+    # pinned (PR 13, replicated draws: 58.1 MB/universe/chip -> 295
+    # universes per 8-device mesh).
+    comp = (sweepshard or {}).get("composed", {})
+    live = (comp.get("max_u_table", comp) or {}).get("sparse@100k", {})
+    max_u = {
+        "composed_max_u_pr13_baseline_pinned": 295,
+        "per_universe_mb_per_chip_pr13_baseline_pinned": 58.1,
+    }
+    composed_live = live.get("composed_D8") or next(
+        (v for k, v in live.items() if k.startswith("composed_D")), None
+    )
+    if composed_live:
+        max_u["composed_max_u_live"] = composed_live["max_u"]
+        max_u["per_universe_mb_per_chip_live"] = round(
+            composed_live["per_universe_bytes_per_chip"] / 1e6, 1
+        )
+        max_u["max_u_vs_pr13_baseline"] = round(
+            composed_live["max_u"] / 295, 2
+        )
+    out["composed_sparse100k_max_u"] = max_u
+
+    # Wall-clock honesty row (the steady-state number is measured by
+    # the membership_sparse_100k section; reused, not re-run).
+    rps = (membership or {}).get("membership_sparse_rounds_per_sec")
+    out["sparse100k_steady_rounds_per_sec"] = {
+        "pr12_baseline_pinned": 1.31,
+        "live": rps,
+    }
+    return out
+
+
 def _sweepshard_section() -> dict:
     """The sweep x shard composition datapoints (ROADMAP item 4):
 
@@ -986,6 +1093,18 @@ def main() -> None:
 
     sweepshard = section("sweepshard", _sweepshard, {})
 
+    # The owned-draws randomness plane: the J6 draw-term ~n/D pin
+    # (replicated-baseline trace vs owned blocks), the composed max-U
+    # headline vs the PR 13 pinned baseline, and the steady-state
+    # rounds/s honesty row (ops/sampling.py owned streams).
+    def _owned():
+        try:
+            return _owned_draws_section(sweepshard, membership)
+        except Exception as e:  # noqa: BLE001 - report, keep headline
+            return {"owned_draws_error": str(e)[:300]}
+
+    owned_draws = section("owned_draws", _owned, {})
+
     # The memory axis of the perf trajectory: estimated peak-HBM per
     # benchmarked program from jaxlint's J6 estimator (consul_tpu/
     # analysis/jaxlint.py) over the big-config entrypoint registry.
@@ -1238,6 +1357,7 @@ def main() -> None:
                     **membership,
                     **multichip,
                     "sweepshard": sweepshard,
+                    "owned_draws": owned_draws,
                     **jaxlint_peaks,
                     **analysis,
                     **observability,

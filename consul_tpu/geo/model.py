@@ -66,7 +66,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from consul_tpu.ops import bernoulli_mask
+from consul_tpu.ops import bernoulli_mask, owned_uniform
 from consul_tpu.protocol import retransmit_limit
 from consul_tpu.protocol.profiles import GossipProfile, LAN, WAN
 from consul_tpu.sim.faults import (
@@ -385,7 +385,7 @@ def geo_round(state: GeoState, key: jax.Array, cfg: GeoConfig):
         / max(ss - 1, 1)
     )
     got_lan = (
-        jax.random.uniform(k_lan, (n, E)) < -jnp.expm1(-lam)
+        owned_uniform(k_lan, idx, (E,)) < -jnp.expm1(-lam)
     ) & ~knows
 
     # -- 2. WAN feedback: bridge-known masks + the delayed belief ------

@@ -67,6 +67,7 @@ from consul_tpu.models.swim import (
 )
 from consul_tpu.ops import (
     deliver_max,
+    owned_uniform,
     poissonized_arrivals,
     sample_peers,
     sample_probe_targets,
@@ -136,6 +137,7 @@ def lifeguard_round(
 ) -> LifeguardState:
     n, f = cfg.n, cfg.subject
     t = state.tick
+    rows = jnp.arange(n, dtype=jnp.int32)
     k_gossip, k_loss, k_probe, k_pfail, k_aware, k_nack, k_churn = (
         jax.random.split(key, 7)
     )
@@ -163,13 +165,13 @@ def lifeguard_round(
     # ------------------------------------------------------------------
     if cfg.delivery == "edges":
         targets = sample_peers(k_gossip, n, cfg.fanout)          # [n, F]
-        src = jnp.arange(n, dtype=jnp.int32)[:, None]
+        src = rows[:, None]
         p_edge = (
             (1.0 - loss_t)
             * send_ok[:, None]
             * (1.0 - edge_block_prob(cfg.faults, t, src, targets, n))
         )
-        wire_ok = jax.random.uniform(k_loss, (n, cfg.fanout)) < p_edge
+        wire_ok = owned_uniform(k_loss, rows, (cfg.fanout,)) < p_edge
         wire_ok = wire_ok & jnp.take(participates, targets)
 
         def rx_era(tx_left, era):
@@ -280,12 +282,12 @@ def lifeguard_round(
         # asarray: ack_late is a sweepable rate knob.
         jnp.asarray(cfg.ack_late, jnp.float32), degraded_late(cfg.faults, n)
     )
-    ack_is_late = jax.random.uniform(k_late, (n,)) < p_late
+    ack_is_late = owned_uniform(k_late, rows) < p_late
     rescued = jnp.bool_(cfg.lifeguard) & (state.awareness >= 1)
     late_fail = ack_is_late & jnp.logical_not(rescued)
 
     hard_fail_subject = (
-        jax.random.uniform(k_hard, (n,)) < p_fail_subject
+        owned_uniform(k_hard, rows) < p_fail_subject
     )
     probe_failed = (
         probed_f
@@ -322,7 +324,7 @@ def lifeguard_round(
     other_failed = (
         probing_any
         & ~probed_f
-        & ((jax.random.uniform(k_aware, (n,)) < p_fail_other) | late_fail)
+        & ((owned_uniform(k_aware, rows) < p_fail_other) | late_fail)
     )
     any_failed = probe_failed | other_failed
 
@@ -336,7 +338,7 @@ def lifeguard_round(
     k_ind = cfg.profile.indirect_checks
     p_nack = (ok1 * send_ok) * (ok1 * mean_ok)
     nacks = jnp.sum(
-        jax.random.uniform(k_nack, (n, max(k_ind, 1))) < p_nack[:, None],
+        owned_uniform(k_nack, rows, (max(k_ind, 1),)) < p_nack[:, None],
         axis=1,
         dtype=jnp.int32,
     )

@@ -66,7 +66,12 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from consul_tpu.ops import bernoulli_mask, sample_peers, sample_probe_targets
+from consul_tpu.ops import (
+    bernoulli_mask,
+    owned_uniform,
+    sample_peers,
+    sample_probe_targets,
+)
 from consul_tpu.protocol import retransmit_limit, suspicion_timeout_bounds
 from consul_tpu.protocol.profiles import GossipProfile, LAN
 
@@ -294,7 +299,7 @@ def membership_round(
     # ------------------------------------------------------------------
     # Priority = remaining budget (fresh news has the most), random
     # tie-break (queue.go orders by transmit count, ties random).
-    prio = tx.astype(jnp.float32) + jax.random.uniform(k_tie, (n, n))
+    prio = tx.astype(jnp.float32) + owned_uniform(k_tie, rows, (n,))
     _, subj = jax.lax.top_k(prio, M)                         # int32[n, M]
     subj = subj.astype(jnp.int32)
     msg_key = jnp.take_along_axis(key_m, subj, axis=1)       # [n, M]

@@ -71,7 +71,7 @@ hashmap IS sparse; this is its SPMD analogue):
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -91,7 +91,9 @@ from consul_tpu.models.membership import (
 )
 from consul_tpu.ops import (
     bernoulli_mask,
+    compact_to_budget,
     merge_into_rows,
+    owned_uniform,
     row_locate,
     sample_peers,
     sample_probe_targets,
@@ -193,15 +195,19 @@ class SparseMembershipConfig:
     # is no longer width-limited.  Kept so existing study configs load.
     stage_width: int = 8
     # STATIC escape hatch for the amortized-invariant dispatch
-    # (ops/sortmerge.merge_into_rows): True (default) cond-gates the
-    # allocation machinery per tick; False pins the slow branch
-    # unconditionally — bit-equal outputs, and the knob universe
-    # sweeps pin when the predicate is structurally constant (under
-    # vmap the cond lowers to both-branches select, so a cold study
-    # that allocates every tick pays the sort AND the dead fast
-    # branch; see the sweepshard bench section).  Trace-time structure:
-    # shape-denied for sweeping (consul_tpu/sweep/universe.py).
-    amortize: bool = True
+    # (ops/sortmerge.merge_into_rows): True cond-gates the allocation
+    # machinery per tick; False pins the slow branch unconditionally —
+    # bit-equal outputs, and the knob universe sweeps pin when the
+    # predicate is structurally constant (under vmap the cond lowers
+    # to both-branches select, so a cold study that allocates every
+    # tick pays the sort AND the dead fast branch; see the sweepshard
+    # bench section).  None (default) = AUTO: plain scans resolve to
+    # the amortized dispatch, the vmapped sweep plane pins the slow
+    # branch (consul_tpu/sweep/universe.py) — the measured-1.5x
+    # escape hatch applied by default, with an explicit True/False
+    # honored everywhere.  Trace-time structure: shape-denied for
+    # sweeping.
+    amortize: Optional[bool] = None
 
     def __post_init__(self):
         if self.base.join_at:
@@ -254,6 +260,21 @@ class SparseMembershipState(NamedTuple):
     overflow: jax.Array         # int32 — news dropped to slot pressure
     forgotten: jax.Array        # int32 — settled cells evicted (benign)
     tick: jax.Array             # int32 scalar
+
+
+def resolve_amortize(cfg, vmapped: bool = False) -> bool:
+    """The effective amortized-invariant dispatch for a config: an
+    explicit ``amortize=True``/``False`` wins; ``None`` (auto)
+    amortizes plain scans and pins the slow branch for vmapped sweep
+    programs — under vmap the dispatch cond lowers to both-branches
+    select, so the cold-path sort would be paid ON TOP of the dead
+    fast branch (the measured 1.5x tax, bench "sweepshard").  The
+    sweep plane resolves the auto BEFORE tracing
+    (consul_tpu/sweep/universe.py), so the model only ever sees
+    ``vmapped=False`` here."""
+    if cfg.amortize is None:
+        return not vmapped
+    return cfg.amortize
 
 
 def pp_initiator_budget(n: int, push_pull_ticks: int) -> int:
@@ -709,7 +730,7 @@ def sparse_membership_round(
     # -- 1. gossip ------------------------------------------------------
     prio = jnp.where(
         occupied, tx.astype(jnp.float32), -jnp.inf
-    ) + jax.random.uniform(k_tie, (n, K))
+    ) + owned_uniform(k_tie, rows, (K,))
     _, sslot = jax.lax.top_k(prio, M)                    # slot idx [n, M]
     sslot = sslot.astype(jnp.int32)
     msg_subj = jnp.take_along_axis(slot_subj, sslot, axis=1)
@@ -739,24 +760,8 @@ def sparse_membership_round(
         # count into overflow, never silent.
         S_b = gossip_sender_budget(n)
         has_msg = jnp.any(msg_valid, axis=1)
-        cpos = jnp.cumsum(has_msg.astype(jnp.int32)) - 1
-        ctgt = jnp.where(
-            has_msg & (cpos < S_b), jnp.clip(cpos, 0, S_b - 1), S_b
-        )
-        snd = (
-            jnp.full((S_b + 1,), n, jnp.int32)
-            .at[ctgt].set(rows)[:S_b]
-        )
-        sel_s = snd < n
-        overflow = jnp.minimum(overflow, COUNTER_CAP) + (
-            jnp.sum(has_msg.astype(jnp.int32))
-            - jnp.sum(sel_s.astype(jnp.int32))
-        )
-        sndc = jnp.minimum(snd, n - 1)
-        # No scatter: unused budget slots all clamp to row n-1, and a
-        # duplicate-index .set() racing True (real selection) against
-        # False (unused slot) is unspecified under XLA.
-        sel_mask = has_msg & (cpos < S_b)
+        sndc, sel_s, sel_mask, missed = compact_to_budget(has_msg, S_b)
+        overflow = jnp.minimum(overflow, COUNTER_CAP) + missed
         msg_valid = msg_valid & sel_mask[:, None]
         g_targets = targets[sndc]
         g_packet_ok = packet_ok[sndc] & sel_s[:, None]
@@ -796,19 +801,16 @@ def sparse_membership_round(
         pp_ok = initiate & participates[partner]
         if K < n:
             # Compacted exchange: only ~n/push_pull_ticks nodes
-            # initiate per tick, so select the initiators into a
-            # static budget of I slots (top_k is deterministic: ties
-            # resolve lowest-index-first) instead of materializing
-            # 2·n·K ~all-masked arrivals.  Initiators past the budget
-            # lose this tick's exchange — counted into overflow, never
+            # initiate per tick, so compact the initiators into a
+            # static budget of I slots in index order (the same
+            # selection the old top_k-over-0/1 made, one cumsum
+            # instead of a sort) instead of materializing 2·n·K
+            # ~all-masked arrivals.  Initiators past the budget lose
+            # this tick's exchange — counted into overflow, never
             # silent — and the Poissonized schedule retries them.
             I = pp_initiator_budget(n, base.push_pull_ticks)
-            got, who = jax.lax.top_k(pp_ok.astype(jnp.int32), I)
-            who = who.astype(jnp.int32)
-            sel = got > 0
-            overflow = jnp.minimum(overflow, COUNTER_CAP) + (
-                jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got)
-            )
+            who, sel, _, missed = compact_to_budget(pp_ok, I)
+            overflow = jnp.minimum(overflow, COUNTER_CAP) + missed
             pwho = partner[who]
             pp_sel = (who, pwho, sel)
         else:
@@ -822,7 +824,7 @@ def sparse_membership_round(
         slots_t, key_rx, sus_rx, overflow, forgotten = _deliver_chunked(
             slots_in, g_targets, g_packet_ok, g_msg_subj, g_msg_key,
             g_msg_valid, pp_sel, n, K, overflow, state.forgotten,
-            amortize=cfg.amortize,
+            amortize=resolve_amortize(cfg),
         )
     else:
         Sg = g_targets.shape[0]
@@ -888,7 +890,7 @@ def sparse_membership_round(
 
         slots_t, key_rx, sus_rx, overflow, forgotten = _merge_arrivals(
             slots_in, recv, subj, val, sus, ok, alloc, n, K,
-            overflow, state.forgotten, amortize=cfg.amortize,
+            overflow, state.forgotten, amortize=resolve_amortize(cfg),
         )
     slot_subj, key_m, suspect_since, confirms, tx = slots_t
     # The merge re-sorts rows when it allocates: positional handles are
@@ -1032,7 +1034,7 @@ def sparse_membership_round(
             need = mature & (mslot < 0)
             slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
             slots_p, can, pos, forgot, ov = _claim_one(
-                slots_p, need, probe_subject, amortize=cfg.amortize,
+                slots_p, need, probe_subject, amortize=resolve_amortize(cfg),
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
             forgotten = jnp.minimum(forgotten, COUNTER_CAP) + forgot

@@ -6,13 +6,22 @@ broadcast fan-out delivery (memberlist/state.go:566-616 gossip +
 queue.go TransmitLimitedQueue), and the per-edge packet-loss model.
 """
 
+from consul_tpu.ops.compact import compact_to_budget
 from consul_tpu.ops.sampling import (
     sample_peers,
+    sample_peers_owned,
     sample_alive_peers,
+    sample_alive_peers_owned,
     sample_probe_targets,
+    sample_probe_targets_owned,
     bernoulli_mask,
+    bernoulli_mask_owned,
     aggregate_arrivals,
+    owned_keys,
+    owned_randint,
+    owned_uniform,
     poissonized_arrivals,
+    poissonized_arrivals_owned,
 )
 from consul_tpu.ops.scatter import (
     deliver_or,
@@ -30,6 +39,7 @@ from consul_tpu.ops.ring_exchange import ring_exchange
 
 __all__ = [
     "ring_exchange",
+    "compact_to_budget",
     "insert_rows_one",
     "merge_deliveries",
     "merge_into_rows",
@@ -37,11 +47,19 @@ __all__ = [
     "row_locate_lo",
     "sort_slot_rows",
     "sample_peers",
+    "sample_peers_owned",
     "sample_alive_peers",
+    "sample_alive_peers_owned",
     "sample_probe_targets",
+    "sample_probe_targets_owned",
     "bernoulli_mask",
+    "bernoulli_mask_owned",
     "aggregate_arrivals",
+    "owned_keys",
+    "owned_randint",
+    "owned_uniform",
     "poissonized_arrivals",
+    "poissonized_arrivals_owned",
     "deliver_or",
     "deliver_max",
 ]

@@ -1,0 +1,71 @@
+"""Budget compaction: rank the wanted entries of a static stream into
+a fixed slot budget, loudly counting what missed.
+
+The cumsum→clip→scatter→slice idiom was hand-rolled at five call sites
+(the sparse gossip sender lanes — unsharded and sharded — the sharded
+push/pull owned legs, the push/pull initiator selection, and the
+sort-merge allocation substream's two-class admission), and PR 12 and
+PR 13 each fixed a duplicate-scatter bug in a fresh copy.  This module
+is the proven form made the only form:
+
+  * positions come from a cumsum over the wanted mask (two cumsums in
+    class-major order when a priority class is given), so admitted
+    entries keep STREAM ORDER — the property every bit-equality pin
+    rides on (top_k over a 0/1 mask selects the same prefix);
+  * the slot table is built by scattering the stream index at its
+    admitted position into ``budget + 1`` slots (the +1 swallows every
+    non-admitted entry) and slicing — never by scattering a boolean
+    with duplicate indices, which races True against False with
+    unspecified results under XLA (the PR 12 bug class);
+  * misses are returned as a count, never dropped silently.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def compact_to_budget(want: jax.Array, budget: int,
+                      first: jax.Array = None):
+    """Compact the True entries of ``want`` (bool[A]) into ``budget``
+    slots in stream order.
+
+    ``first`` (bool[A], optional) marks a priority class: entries with
+    ``want & first`` admit ahead of the rest (class-major, stream
+    order within each class) — the sort-merge allocation substream's
+    prioritized admission, where allocation-worthy news must never
+    queue behind never-allocating traffic.
+
+    Returns ``(idx, taken, kept, dropped)``:
+
+      idx      int32[budget] — stream index seated in each slot,
+               clamped to A-1 on empty slots (gather-safe; mask with
+               ``taken``);
+      taken    bool[budget] — the slot holds a real entry;
+      kept     bool[A] — want, and admitted within the budget;
+      dropped  int32 — wanted entries past the budget (callers with
+               class-specific ledgers refine this from ``kept``).
+    """
+    a_len = want.shape[0]
+    if first is None:
+        cpos = jnp.cumsum(want.astype(jnp.int32)) - 1
+    else:
+        prio = want & first
+        pq = jnp.cumsum(prio.astype(jnp.int32))
+        cpos = jnp.where(
+            prio, pq - 1,
+            pq[-1] + jnp.cumsum((want & ~first).astype(jnp.int32)) - 1,
+        )
+    kept = want & (cpos < budget)
+    ctgt = jnp.where(kept, jnp.clip(cpos, 0, budget - 1), budget)
+    idx = (
+        jnp.full((budget + 1,), a_len, jnp.int32)
+        .at[ctgt].set(jnp.arange(a_len, dtype=jnp.int32))[:budget]
+    )
+    taken = idx < a_len
+    dropped = (
+        jnp.sum(want.astype(jnp.int32))
+        - jnp.sum(taken.astype(jnp.int32))
+    )
+    return jnp.minimum(idx, a_len - 1), taken, kept, dropped

@@ -2,10 +2,36 @@
 
 The reference selects gossip/probe targets by rejection-sampling random
 member-list offsets, excluding self and filtered nodes
-(memberlist/util.go:125-153, state.go:541-562).  Here every node draws its
-targets in parallel from a per-(round, node) PRNG stream, so a simulated
-round is a pure function of ``(state, key)`` and therefore reproducible
-across shardings and device counts.
+(memberlist/util.go:125-153, state.go:541-562).  Here every node draws
+its targets in parallel from a per-(round, node) PRNG stream, so a
+simulated round is a pure function of ``(state, key)`` and therefore
+reproducible across shardings and device counts.
+
+Owned-draw discipline (the counter-based randomness plane)
+----------------------------------------------------------
+
+Every node-indexed draw derives from
+
+    ``fold_in(fold_in(fold_in(scan_key, round), site), global_node_id)``
+
+— the scan wrappers fold the round index into the scan key
+(``sim/engine.py``), the round functions split that round key into one
+key per draw *site* (target draw, loss draw, tie-break, …), and the
+helpers below fold the GLOBAL node id in per row (:func:`owned_keys`).
+Node ``i``'s values therefore depend only on ``(scan_key, round, site,
+i)`` — never on which rows happen to be materialized alongside it — so
+a shard holding the owned block ``[start, start+blk)`` generates draws
+for **its rows only** and gets bit-identical values to the unsharded
+scan evaluating all ``n`` rows.  That is what makes every sharded
+plane's per-chip draw cost O(n/D) instead of the replicated
+full-population O(n) plane that PR 4's slice-per-block design paid
+(parallel/shard.py), while keeping the exactness ladder (D == 1 ≡
+unsharded) a matter of evaluating the same functions over different id
+blocks.
+
+The salted-fold_in chain is the key discipline rangelint J8 certifies:
+each site key is folded (never drawn) and each folded per-node stream
+is drawn exactly once.
 """
 
 from __future__ import annotations
@@ -14,42 +40,81 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_peers(key: jax.Array, n: int, fanout: int) -> jax.Array:
-    """Each of the n nodes picks ``fanout`` peers uniformly, excluding self.
+def owned_keys(key: jax.Array, ids: jax.Array) -> jax.Array:
+    """Per-node key stream: ``fold_in(key, id)`` for each global id.
 
-    Returns int32 [n, fanout] of target indices in [0, n), never equal to
-    the row index.  Self-exclusion uses the shift trick: draw from
-    [0, n-1) and bump values >= self by one — exact uniform over the
+    ``ids`` int32[m] — the GLOBAL node ids this caller owns (a shard
+    passes ``start + arange(blk)``, the unsharded scan ``arange(n)``).
+    Row ``j`` of every draw built on these keys depends only on
+    ``(key, ids[j])``, which is the whole owned-draw contract."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
+
+
+def owned_uniform(key: jax.Array, ids: jax.Array, shape: tuple = (),
+                  dtype=jnp.float32) -> jax.Array:
+    """float[m, *shape] uniform in [0, 1): row j is node ids[j]'s
+    private stream for this site key."""
+    return jax.vmap(
+        lambda k: jax.random.uniform(k, shape, dtype=dtype)
+    )(owned_keys(key, ids))
+
+
+def owned_randint(key: jax.Array, ids: jax.Array, shape: tuple,
+                  minval, maxval) -> jax.Array:
+    """int32[m, *shape] uniform integers in [minval, maxval): the
+    owned form of ``jax.random.randint``.  Bounds may be traced
+    scalars (they broadcast under the vmap)."""
+    return jax.vmap(
+        lambda k: jax.random.randint(
+            k, shape, minval=minval, maxval=maxval, dtype=jnp.int32
+        )
+    )(owned_keys(key, ids))
+
+
+def sample_peers_owned(key: jax.Array, ids: jax.Array, n: int,
+                       fanout: int) -> jax.Array:
+    """Each owned node picks ``fanout`` peers uniformly over the other
+    n-1 nodes, excluding itself.  Returns int32[m, fanout] of GLOBAL
+    target ids, never equal to the row's own id.
+
+    Self-exclusion uses the shift trick: draw from [0, n-1) and bump
+    values >= the row's own GLOBAL id by one — exact uniform over the
     other n-1 nodes, no rejection loop (which would be data-dependent
     control flow under jit).
 
-    Unlike kRandomNodes (memberlist/util.go:131-153) we do not dedupe the
-    ``fanout`` draws within one node/round; for n >> fanout the collision
-    probability is O(fanout^2/n) and does not measurably distort
-    convergence (a collision just wastes one transmission, which real UDP
-    loss does far more often).
-    """
-    draws = jax.random.randint(
-        key, (n, fanout), minval=0, maxval=max(n - 1, 1), dtype=jnp.int32
+    Unlike kRandomNodes (memberlist/util.go:131-153) we do not dedupe
+    the ``fanout`` draws within one node/round; for n >> fanout the
+    collision probability is O(fanout^2/n) and does not measurably
+    distort convergence (a collision just wastes one transmission,
+    which real UDP loss does far more often)."""
+    draws = owned_randint(key, ids, (fanout,), 0, max(n - 1, 1))
+    return jnp.where(draws >= ids[:, None], draws + 1, draws) % n
+
+
+def sample_peers(key: jax.Array, n: int, fanout: int) -> jax.Array:
+    """Full-population :func:`sample_peers_owned` over ``arange(n)`` —
+    the unsharded call shape.  int32[n, fanout]."""
+    return sample_peers_owned(
+        key, jnp.arange(n, dtype=jnp.int32), n, fanout
     )
-    self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
-    return jnp.where(draws >= self_idx, draws + 1, draws) % n
 
 
-def sample_alive_peers(key: jax.Array, alive: jax.Array, fanout: int) -> jax.Array:
-    """Each node picks ``fanout`` peers uniformly among the ALIVE nodes,
-    excluding itself — the masked form of :func:`sample_peers`.
+def sample_alive_peers_owned(key: jax.Array, ids: jax.Array,
+                             alive: jax.Array, fanout: int) -> jax.Array:
+    """Each owned node picks ``fanout`` peers uniformly among the ALIVE
+    nodes, excluding itself — the masked form of
+    :func:`sample_peers_owned`.
 
     kRandomNodes filters dead/left members out of the candidate list
-    (memberlist/util.go:131-153 via state.go:575-585), so a sender never
-    spends a transmission on a node it knows to be gone.  Vectorized:
-    order the alive indices first (stable argsort of the dead mask),
-    rank each node within that order, draw from [0, A-1) over the other
-    A-1 alive nodes with the same shift trick as :func:`sample_peers`,
-    and map the draw through the alive-first index table.  Dead rows
-    still draw (static shapes under jit) but their packets are masked by
-    the caller's sender set.  Returns int32 [n, fanout].
-    """
+    (memberlist/util.go:131-153 via state.go:575-585), so a sender
+    never spends a transmission on a node it knows to be gone.
+    The alive ORDERING (rank table, alive count) is a pure function of
+    the full ``alive`` plane — a bool[n] the callers already hold —
+    while the draws themselves are owned: draw from [0, A-1) over the
+    other A-1 alive nodes with the same shift trick, and map through
+    the alive-first index table.  Dead rows still draw (static shapes
+    under jit) but their packets are masked by the caller's sender
+    set.  Returns int32[m, fanout] of global ids."""
     n = alive.shape[0]
     cnt = jnp.sum(alive, dtype=jnp.int32)
     order = jnp.argsort(~alive, stable=True).astype(jnp.int32)
@@ -58,33 +123,65 @@ def sample_alive_peers(key: jax.Array, alive: jax.Array, fanout: int) -> jax.Arr
         .at[order]
         .set(jnp.arange(n, dtype=jnp.int32))
     )
-    draws = jax.random.randint(
-        key, (n, fanout), minval=0, maxval=jnp.maximum(cnt - 1, 1),
-        dtype=jnp.int32,
+    draws = owned_randint(
+        key, ids, (fanout,), 0, jnp.maximum(cnt - 1, 1)
     )
-    draws = jnp.where(draws >= rank[:, None], draws + 1, draws)
+    draws = jnp.where(draws >= rank[ids][:, None], draws + 1, draws)
     return order[draws % jnp.maximum(cnt, 1)]
 
 
-def sample_probe_targets(key: jax.Array, n: int) -> jax.Array:
-    """One probe target per node per probe round (memberlist probes one
-    node per ProbeInterval, state.go:214-256).  Uniform excluding self.
+def sample_alive_peers(key: jax.Array, alive: jax.Array,
+                       fanout: int) -> jax.Array:
+    """Full-population :func:`sample_alive_peers_owned` over
+    ``arange(n)``.  int32[n, fanout]."""
+    n = alive.shape[0]
+    return sample_alive_peers_owned(
+        key, jnp.arange(n, dtype=jnp.int32), alive, fanout
+    )
 
-    The reference iterates a shuffled ring rather than sampling uniformly;
-    over timescales of the suspicion timeout (many probe rounds) the
-    per-round marginal is the same 1/(n-1) per peer, which is what the
-    SWIM paper's analysis assumes.  Returns int32 [n].
-    """
-    return sample_peers(key, n, 1)[:, 0]
+
+def sample_probe_targets_owned(key: jax.Array, ids: jax.Array,
+                               n: int) -> jax.Array:
+    """One probe target per owned node per probe round (memberlist
+    probes one node per ProbeInterval, state.go:214-256).  Uniform
+    excluding self; int32[m] global ids.
+
+    The reference iterates a shuffled ring rather than sampling
+    uniformly; over timescales of the suspicion timeout (many probe
+    rounds) the per-round marginal is the same 1/(n-1) per peer, which
+    is what the SWIM paper's analysis assumes."""
+    return sample_peers_owned(key, ids, n, 1)[:, 0]
+
+
+def sample_probe_targets(key: jax.Array, n: int) -> jax.Array:
+    """Full-population :func:`sample_probe_targets_owned`.  int32[n]."""
+    return sample_probe_targets_owned(
+        key, jnp.arange(n, dtype=jnp.int32), n
+    )
+
+
+def bernoulli_mask_owned(key: jax.Array, ids: jax.Array, shape: tuple,
+                         p_success) -> jax.Array:
+    """Per-message delivery mask over the owned rows: bool[m, *shape],
+    True = delivered.  ``p_success`` broadcasts against the result
+    (scalar, or any caller-sliced per-row probability plane)."""
+    return owned_uniform(key, ids, shape) < p_success
 
 
 def bernoulli_mask(key: jax.Array, shape, p_success) -> jax.Array:
     """Per-message delivery mask: True = delivered.
 
-    The BASELINE loss configs (1% failure, 30% loss) are Bernoulli masks
-    on simulated edges (SURVEY.md §5).  ``p_success`` = 1 - loss rate.
-    """
-    return jax.random.uniform(key, shape) < p_success
+    The BASELINE loss configs (1% failure, 30% loss) are Bernoulli
+    masks on simulated edges (SURVEY.md §5); ``p_success`` = 1 - loss
+    rate.  ``shape[0]`` indexes the drawing entity (node rows; the geo
+    link plane passes link ids): the mask rides the owned per-row
+    streams (row i depends only on ``(key, i)``), so a sharded twin
+    evaluates the same function over its block's ids and a replicated
+    consumer gets the same plane on every shard."""
+    n = shape[0]
+    return bernoulli_mask_owned(
+        key, jnp.arange(n, dtype=jnp.int32), tuple(shape[1:]), p_success
+    )
 
 
 def aggregate_arrivals(
@@ -125,6 +222,15 @@ def aggregate_arrivals(
     return got if alive is None else got & alive
 
 
+def poissonized_arrivals_owned(key: jax.Array, ids: jax.Array,
+                               lam: jax.Array) -> jax.Array:
+    """bool per OWNED receiver: >= 1 arrival under Poisson(``lam``),
+    with ``lam`` already sliced to the owned rows (leading axis m).
+    Row j's draw depends only on ``(key, ids[j])``."""
+    shape = tuple(lam.shape[1:])
+    return owned_uniform(key, ids, shape) < -jnp.expm1(-lam)
+
+
 def poissonized_arrivals(key: jax.Array, lam: jax.Array) -> jax.Array:
     """bool per receiver: >= 1 arrival under Poisson(``lam``).
 
@@ -134,6 +240,8 @@ def poissonized_arrivals(key: jax.Array, lam: jax.Array) -> jax.Array:
     ``lam_j = recv_ok_j * fanout * (sum_i w_i - w_j) / (n - 1)`` with
     ``w_i`` each sender's per-copy survival probability — and this
     applies only P(>=1) = 1 - exp(-lam).  With uniform weights it
-    reduces exactly to :func:`aggregate_arrivals`.
-    """
-    return jax.random.uniform(key, lam.shape) < -jnp.expm1(-lam)
+    reduces exactly to :func:`aggregate_arrivals`.  The leading axis
+    indexes nodes (owned streams over ``arange``)."""
+    return poissonized_arrivals_owned(
+        key, jnp.arange(lam.shape[0], dtype=jnp.int32), lam
+    )

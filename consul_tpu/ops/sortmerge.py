@@ -71,6 +71,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from consul_tpu.ops.compact import compact_to_budget
+
 _SUBJ_MAX = jnp.iinfo(jnp.int32).max  # empty-slot sort sentinel
 
 # Row-block ceiling for the huge-table claim construction in
@@ -447,37 +449,25 @@ def merge_into_rows(
         (slot_subj, planes, rxk0, rxs0, recv_, subj_, val_, susv_,
          lo0_, el0_, flat0_, uns_) = _unpack(ops)
         # Compact the unseated arrivals into the B-entry substream with
-        # PRIORITIZED admission: allocation-worthy arrivals (suspect/
+        # PRIORITIZED admission (ops/compact.compact_to_budget, the
+        # proven cumsum→scatter→slice form shared by every budget
+        # compaction in the tree): allocation-worthy arrivals (suspect/
         # dead/never-seated news — the ``el`` bit) take positions
         # [0, W) in stream order, never-allocating traffic (alive@inc
         # rows whose only job is contributing to a claimed group's
         # value max) queues behind them at [W, ...) — so a pp-heavy
         # cold tick can no longer spend the budget on alive rows ahead
-        # of tail-of-stream suspect news.  Two cumsums + one scatter —
-        # NOT jnp.nonzero, whose size= lowering pays a stream-length
-        # sort — and allocation-worthy arrivals past the budget still
-        # drop LOUDLY into ``dropped``.
-        worthy = uns_ & el0_
-        wq = jnp.cumsum(worthy.astype(jnp.int32))
-        cpos = jnp.where(
-            worthy, wq - 1,
-            wq[-1] + jnp.cumsum((uns_ & ~el0_).astype(jnp.int32)) - 1,
-        )
-        ctgt = jnp.where(uns_ & (cpos < B), jnp.clip(cpos, 0, B - 1), B)
-        idx_n = (
-            jnp.full((B + 1,), A, jnp.int32)
-            .at[ctgt].set(jnp.arange(A, dtype=jnp.int32))[:B]
-        )
-        taken = idx_n < A
-        gi = jnp.minimum(idx_n, A - 1)
+        # of tail-of-stream suspect news — and allocation-worthy
+        # arrivals past the budget still drop LOUDLY into ``dropped``.
+        gi, taken, kept, _ = compact_to_budget(uns_, B, first=el0_)
         missed = (jnp.sum((el0_ & uns_).astype(jnp.int32))
-                  - jnp.sum((taken & el0_[gi]).astype(jnp.int32)))
+                  - jnp.sum((kept & el0_).astype(jnp.int32)))
         r = jnp.where(taken, recv_.astype(jnp.int32)[gi], n)
         s = jnp.where(taken, subj_.astype(jnp.int32)[gi], n)
         idx = jnp.arange(B, dtype=jnp.int32)
         r, s, perm = jax.lax.sort((r, s, idx), num_keys=2)
         valid = r < n
-        gs = jnp.minimum(idx_n[perm], A - 1)
+        gs = gi[perm]
         v = jnp.where(valid, val_.astype(jnp.int32)[gs], -1)
         su = jnp.where(valid, susv_[gs], -1)
         el = jnp.where(valid, el0_[gs], False)

@@ -13,11 +13,14 @@ parallel-replication structure of "Rethinking State-Machine Replication
 for Parallelism", pipelined cross-shard per "The Algorithm of Pipelined
 Gossiping"):
 
-  1. **Sample globally.**  Probe/gossip targets are GLOBAL node ids.
-     Every shard derives the full population's draws from the same
-     replicated per-round key and slices its own row block, so the RNG
-     stream is bit-identical to the unsharded scan regardless of D —
-     the property the D == 1 equality pin rides on.
+  1. **Sample owned.**  Probe/gossip targets are GLOBAL node ids, but
+     every draw is generated for the shard's OWNED rows only: node i's
+     values derive from the per-(round, node) keyed streams
+     ``fold_in(site_key, i)`` (ops/sampling.py), so the shard evaluates
+     the same functions the unsharded scan evaluates over ``arange(n)``
+     — bit-identical values at any D with O(n/D) per-chip draw cost,
+     the property the D == 1 equality pin rides on.  No replicated
+     full-population draw plane exists anywhere in the round.
   2. **Route.**  Messages whose receiver lives on another shard are
      packed into a fixed per-destination **outbox** (budget =
      c x the Poissonized mean arrivals per destination,
@@ -46,13 +49,15 @@ Exactness ladder:
                   the remedy) or push/pull initiator-budget misses
                   (the Poissonized schedule retries next interval).
 
-Replicated-draw memory note: the bit-equality discipline makes each
-device materialize full-population random draws ([n, fanout] targets;
-the sparse plane's [n, K] gossip-priority tie-break) before slicing its
-block.  At the v5e-8 flagship scale (8M aggregate nodes, K = 64) the
-largest transient is ~2 GB/device against 16 GB HBM; a future
-per-(round, node) keyed stream could drop it to O(n/D) at the cost of a
-new RNG discipline.
+Owned-draw memory note: the per-(round, node) keyed streams make every
+per-node random plane O(n/D)/chip — the [n, fanout] target and loss
+planes and the sparse plane's [n, K] gossip-priority tie-break (the
+term that dominated the composed sweep's per-universe footprint) are
+generated at [blk, .].  What remains replicated is static cfg-derived
+structure (fail/leave schedules, the participates masks) and the geo
+link plane — pure functions every shard steps identically — plus the
+O(i_slots) push/pull initiator id lists exchanged by all_gather
+(:func:`_global_initiators`), never an [n]-scale draw.
 """
 
 from __future__ import annotations
@@ -163,6 +168,42 @@ def _rows(x: jax.Array, start: jax.Array, blk: int) -> jax.Array:
     return jax.lax.dynamic_slice_in_dim(x, start, blk, axis=0)
 
 
+def _global_initiators(pp_ok_l: jax.Array, partner_l: jax.Array,
+                       rows_g: jax.Array, n: int, i_slots: int):
+    """Assemble the global budgeted push/pull initiator set from OWNED
+    per-row draws — the replicated [n] initiate/partner planes'
+    replacement.
+
+    Each shard compacts its own initiators (ascending global id,
+    ``ops.compact_to_budget``) into ``min(i_slots, blk)`` slots — a
+    LOSSLESS cap for the global first-``i_slots`` cut, since no single
+    shard can contribute more than the budget — all_gathers the
+    (initiator, partner) id lists (2 x D x i_slots int32 per chip,
+    O(i_slots), never O(n)), and compacts the concatenation, which is
+    already globally ascending because shards own contiguous ascending
+    blocks, down to the final budget.  The selected set is therefore
+    EXACTLY the unsharded compaction's prefix at every D; empty slots
+    hold the sentinel ``n`` and ``sel`` False.  Returns
+    ``(who, pwho, sel, missed)`` with ``missed`` the global initiators
+    past the budget (loud, retried by the Poissonized schedule)."""
+    from consul_tpu.ops import compact_to_budget
+
+    blk = rows_g.shape[0]
+    li, lt, _, _ = compact_to_budget(pp_ok_l, min(i_slots, blk))
+    who_l = jnp.where(lt, rows_g[li], n)
+    pwho_l = jnp.where(lt, partner_l[li], n)
+    who_all = jax.lax.all_gather(who_l, NODE_AXIS, tiled=True)
+    pwho_all = jax.lax.all_gather(pwho_l, NODE_AXIS, tiled=True)
+    gi, sel, _, _ = compact_to_budget(who_all < n, i_slots)
+    who = jnp.where(sel, who_all[gi], n)
+    pwho = jnp.where(sel, pwho_all[gi], n)
+    missed = (
+        jax.lax.psum(jnp.sum(pp_ok_l.astype(jnp.int32)), NODE_AXIS)
+        - jnp.sum(sel.astype(jnp.int32))
+    )
+    return who, pwho, sel, missed
+
+
 # ---------------------------------------------------------------------------
 # Sharded broadcast (serf user-event epidemic).
 # ---------------------------------------------------------------------------
@@ -185,7 +226,12 @@ def _sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
     — same contract on every sharded scan below."""
     from consul_tpu.models.broadcast import BroadcastState
     from consul_tpu.obs.spec import emit_local, reduce_over_mesh
-    from consul_tpu.ops import bernoulli_mask, deliver_or, sample_peers
+    from consul_tpu.ops import (
+        bernoulli_mask_owned,
+        deliver_or,
+        owned_uniform,
+        sample_peers_owned,
+    )
 
     n, fanout = cfg.n, cfg.fanout
     d_shards = int(mesh.devices.size)
@@ -199,16 +245,18 @@ def _sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
         st, ov = carry
         me = jax.lax.axis_index(NODE_AXIS)
         start = me * blk
+        rows_g = start + jnp.arange(blk, dtype=jnp.int32)
         k_sel, k_loss = jax.random.split(k)
         senders = st.knows & (st.tx_left > 0)
 
         if cfg.delivery == "edges":
-            # Global sampling, local slice: same draws as the
-            # unsharded round for any D.
-            targets = _rows(sample_peers(k_sel, n, fanout), start, blk)
-            ok = senders[:, None] & _rows(
-                bernoulli_mask(k_loss, (n, fanout), 1.0 - cfg.loss),
-                start, blk,
+            # Owned draws: each shard generates draws for ITS global
+            # ids only — the same per-(round, node) streams the
+            # unsharded round evaluates over arange(n), so values are
+            # bit-identical at any D with no replicated [n, F] plane.
+            targets = sample_peers_owned(k_sel, rows_g, n, fanout)
+            ok = senders[:, None] & bernoulli_mask_owned(
+                k_loss, rows_g, (fanout,), 1.0 - cfg.loss
             )
             recv = targets.ravel()
             okf = ok.ravel()
@@ -240,7 +288,7 @@ def _sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
                 * (1.0 - cfg.loss)
                 / max(n - 1, 1)
             )
-            u = _rows(jax.random.uniform(k_loss, (n,)), start, blk)
+            u = owned_uniform(k_loss, rows_g)
             new_knows = st.knows | (u < -jnp.expm1(-lam))
 
         spent = jnp.where(senders, fanout, 0).astype(jnp.int32)
@@ -263,9 +311,9 @@ def _sharded_broadcast_scan(state, key: jax.Array, cfg, steps: int,
         return (nxt, ov), out
 
     def body(st, key):
-        keys = jax.random.split(key, steps)
         (final, ov), outs = jax.lax.scan(
-            tick, (st, jnp.int32(0)), keys
+            lambda carry, t: tick(carry, jax.random.fold_in(key, t)),
+            (st, jnp.int32(0)), jnp.arange(steps, dtype=jnp.int32),
         )
         return final, outs, ov
 
@@ -331,9 +379,10 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
     from consul_tpu.models.membership_sparse import pp_initiator_budget
     from consul_tpu.obs.spec import emit_local, reduce_over_mesh
     from consul_tpu.ops import (
-        bernoulli_mask,
-        sample_peers,
-        sample_probe_targets,
+        bernoulli_mask_owned,
+        owned_uniform,
+        sample_peers_owned,
+        sample_probe_targets_owned,
     )
 
     n, fanout = cfg.n, cfg.fanout
@@ -356,7 +405,8 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
         rows_l = jnp.arange(blk, dtype=jnp.int32)
         rows_g = start + rows_l
 
-        # Ground truth (replicated [n] schedules; local boolean slices).
+        # Ground truth (replicated [n] schedules — static cfg-derived,
+        # not draws; local boolean slices).
         fail_tick = _schedule_array(n, cfg.fail_at, NEVER)
         leave_tick = _schedule_array(n, cfg.leave_at, NEVER)
         join_tick = _schedule_array(n, cfg.join_at, 0)
@@ -393,9 +443,10 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
             jnp.where(diag_val > diag, cfg.tx_limit, tx[rows_l, rows_g])
         )
 
-        # -- 1. gossip -------------------------------------------------
-        prio = tx.astype(jnp.float32) + _rows(
-            jax.random.uniform(k_tie, (n, n)), start, blk
+        # -- 1. gossip (owned draws: [blk, .] streams keyed by global
+        # id — no replicated [n, .] planes) -----------------------------
+        prio = tx.astype(jnp.float32) + owned_uniform(
+            k_tie, rows_g, (n,)
         )
         _, subj = jax.lax.top_k(prio, m_drain)
         subj = subj.astype(jnp.int32)                  # [blk, M] global
@@ -406,7 +457,7 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
             & part_l[:, None]
         )
 
-        targets = _rows(sample_peers(k_tgt, n, fanout), start, blk)
+        targets = sample_peers_owned(k_tgt, rows_g, n, fanout)
         tgt_view = jnp.take_along_axis(key_m, targets, axis=1)
         tgt_sendable = (
             (tgt_view >= 0) & (key_rank(tgt_view) <= RANK_SUSPECT)
@@ -414,9 +465,8 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
         packet_ok = (
             part_l[:, None]
             & tgt_sendable
-            & _rows(
-                bernoulli_mask(k_loss, (n, fanout), 1.0 - cfg.loss),
-                start, blk,
+            & bernoulli_mask_owned(
+                k_loss, rows_g, (fanout,), 1.0 - cfg.loss
             )
             & participates[targets]
         )
@@ -473,25 +523,29 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
             0,
         )
 
-        # -- 2. push/pull ----------------------------------------------
+        # -- 2. push/pull (owned draws; the initiation coin and the
+        # partner pick exist only for the owned rows — the global
+        # initiator set assembles from per-shard compacted id lists,
+        # never from a replicated [n] draw plane) ----------------------
         ov_repl = jnp.int32(0)
         if cfg.push_pull_enabled:
             known_l = jnp.sum(
                 (key_m >= 0) & (key_rank(key_m) <= RANK_SUSPECT), axis=1
             )
-            known_cnt = jax.lax.all_gather(
-                known_l, NODE_AXIS, tiled=True
+            needs_join_l = part_l & (known_l <= 1)
+            initiate_l = part_l & (
+                needs_join_l
+                | bernoulli_mask_owned(
+                    k_pp, rows_g, (), 1.0 / cfg.push_pull_ticks
+                )
             )
-            needs_join = participates & (known_cnt <= 1)
-            initiate = participates & (
-                needs_join
-                | bernoulli_mask(k_pp, (n,), 1.0 / cfg.push_pull_ticks)
-            )
-            partner = sample_probe_targets(k_ppsel, n)
-            pp_ok = initiate & participates[partner]
+            partner_l = sample_probe_targets_owned(k_ppsel, rows_g, n)
+            pp_ok_l = initiate_l & participates[partner_l]
             if d_shards == 1:
                 # Full-width exchange — bit-equal to the unsharded
-                # round (the D == 1 pin, like sparse == dense at K == n).
+                # round (the D == 1 pin, like sparse == dense at K == n):
+                # at D == 1 the owned rows ARE the population.
+                pp_ok, partner = pp_ok_l, partner_l
                 key_rx = jnp.maximum(
                     key_rx,
                     jnp.where(pp_ok[:, None], key_m[partner], -1),
@@ -500,19 +554,14 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
                 key_rx = key_rx.at[prow].max(key_m, mode="drop")
             else:
                 # Budgeted initiators (pp_initiator_budget, the sparse
-                # model's discipline); the [I, n] initiator and partner
-                # rows assemble by pmax — each shard contributes the
-                # rows it owns, -1 elsewhere.
+                # model's discipline) via _global_initiators; the
+                # [I, n] initiator and partner rows assemble by pmax —
+                # each shard contributes the rows it owns, -1 elsewhere.
                 i_slots = pp_initiator_budget(n, cfg.push_pull_ticks)
-                got_i, who = jax.lax.top_k(
-                    pp_ok.astype(jnp.int32), i_slots
+                who, pwho, sel, missed = _global_initiators(
+                    pp_ok_l, partner_l, rows_g, n, i_slots
                 )
-                who = who.astype(jnp.int32)
-                sel = got_i > 0
-                ov_repl = ov_repl + (
-                    jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got_i)
-                )
-                pwho = partner[who]
+                ov_repl = ov_repl + missed
 
                 def rows_of(ids, live):
                     loc = ids - start
@@ -578,10 +627,10 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
         tx = jnp.where(changed | gained_conf, cfg.tx_limit, tx)
         key_m = new_key
 
-        # -- 5. probes -------------------------------------------------
+        # -- 5. probes (owned draws) -----------------------------------
         if cfg.probe_enabled:
             is_probe_tick = (t % cfg.probe_interval_ticks) == 0
-            ptarget = _rows(sample_probe_targets(k_probe, n), start, blk)
+            ptarget = sample_probe_targets_owned(k_probe, rows_g, n)
             pt_view = key_m[rows_l, ptarget]
             probing = (
                 is_probe_tick
@@ -594,8 +643,7 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
                 target_up, jnp.float32(cfg.probe_fail_prob_alive), 1.0
             )
             failed = probing & (
-                _rows(jax.random.uniform(k_pfail, (n,)), start, blk)
-                < p_fail
+                owned_uniform(k_pfail, rows_g) < p_fail
             )
             can_pend = failed & (st.probe_pending_at == NEVER)
             matures_at = (
@@ -703,9 +751,9 @@ def _sharded_membership_scan(state, key: jax.Array, cfg, steps: int,
     )
 
     def body(st, key):
-        keys = jax.random.split(key, steps)
         (final, ov), outs = jax.lax.scan(
-            tick, (st, jnp.int32(0)), keys
+            lambda carry, t: tick(carry, jax.random.fold_in(key, t)),
+            (st, jnp.int32(0)), jnp.arange(steps, dtype=jnp.int32),
         )
         return final, outs, ov
 
@@ -782,14 +830,17 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         _view_of,
         gossip_sender_budget,
         pp_initiator_budget,
+        resolve_amortize,
         settled_of,
     )
     from consul_tpu.obs.spec import emit_local, reduce_over_mesh
     from consul_tpu.ops import (
-        bernoulli_mask,
+        bernoulli_mask_owned,
+        compact_to_budget,
+        owned_uniform,
         row_locate,
-        sample_peers,
-        sample_probe_targets,
+        sample_peers_owned,
+        sample_probe_targets_owned,
     )
 
     base = cfg.base
@@ -809,13 +860,16 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
     # this IS the unsharded plane's budget), not the full block width.
     s_budget = gossip_sender_budget(blk)
     # Owned-leg budget of the push/pull exchange: a shard SOURCES only
-    # the legs whose row it owns (~i_slots/D per leg class under
-    # uniform placement), so the per-chip [., K] leg gathers compact
-    # to 2x that mean (floor 64) instead of the full i_slots — the
-    # term that dominated the composed sweep's per-universe footprint.
-    # At D == 1 this is exactly i_slots (bit-equality); misses count
+    # the legs whose row it owns — mean ~n/(push_pull_ticks * D) per
+    # leg class under uniform placement — so the per-chip [., K] leg
+    # gathers compact to i_slots/D (floor 64).  i_slots is already 8x
+    # the GLOBAL Poissonized mean (pp_initiator_budget), so i_slots/D
+    # keeps the same 8x safety margin per shard; the former 2x on top
+    # of that doubled the composed plane's stream, outbox, and merge
+    # temps for tail mass that is already negligible at 8x.  At
+    # D == 1 this is exactly i_slots (bit-equality); misses count
     # into overflow and the Poissonized schedule retries them.
-    pp_owned = min(i_slots, max(64, (2 * i_slots) // d_shards))
+    pp_owned = min(i_slots, max(64, i_slots // d_shards))
     stream_len = s_budget * fanout * m_drain
     if base.push_pull_enabled:
         stream_len += 2 * pp_owned * k_slots
@@ -873,10 +927,12 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             )
         )
 
-        # -- 1. gossip -------------------------------------------------
+        # -- 1. gossip (owned draws: [blk, .] streams keyed by global
+        # id — the [n, K] tie-break plane that dominated the composed
+        # per-universe footprint no longer exists) ---------------------
         prio = jnp.where(
             occupied, tx.astype(jnp.float32), -jnp.inf
-        ) + _rows(jax.random.uniform(k_tie, (n, k_slots)), start, blk)
+        ) + owned_uniform(k_tie, rows_g, (k_slots,))
         _, sslot = jax.lax.top_k(prio, m_drain)
         sslot = sslot.astype(jnp.int32)
         msg_subj = jnp.take_along_axis(slot_subj, sslot, axis=1)
@@ -887,15 +943,14 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             & part_l[:, None]
         )
 
-        targets = _rows(sample_peers(k_tgt, n, fanout), start, blk)
+        targets = sample_peers_owned(k_tgt, rows_g, n, fanout)
         tgt_view = _view_of(slot_subj, key_m, rows_l[:, None], targets)
         tgt_sendable = key_rank(tgt_view) <= RANK_SUSPECT
         packet_ok = (
             part_l[:, None]
             & tgt_sendable
-            & _rows(
-                bernoulli_mask(k_loss, (n, fanout), 1.0 - base.loss),
-                start, blk,
+            & bernoulli_mask_owned(
+                k_loss, rows_g, (fanout,), 1.0 - base.loss
             )
             & participates[targets]
         )
@@ -906,25 +961,9 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         # BEFORE the [., F, M] lane expansion; unselected senders keep
         # their tx (pure deferral), count into overflow, and retry.
         has_msg = jnp.any(msg_valid, axis=1)
-        cpos = jnp.cumsum(has_msg.astype(jnp.int32)) - 1
-        ctgt = jnp.where(
-            has_msg & (cpos < s_budget),
-            jnp.clip(cpos, 0, s_budget - 1), s_budget,
+        sndc, sel_s, sel_mask, ov_gossip = compact_to_budget(
+            has_msg, s_budget
         )
-        snd = (
-            jnp.full((s_budget + 1,), blk, jnp.int32)
-            .at[ctgt].set(rows_l)[:s_budget]
-        )
-        sel_s = snd < blk
-        ov_gossip = (
-            jnp.sum(has_msg.astype(jnp.int32))
-            - jnp.sum(sel_s.astype(jnp.int32))
-        )
-        sndc = jnp.minimum(snd, blk - 1)
-        # No scatter for the mask rebuild: unused budget slots clamp to
-        # row blk-1, and a duplicate-index .set() racing True against
-        # False is unspecified under XLA (the unsharded twin's note).
-        sel_mask = has_msg & (cpos < s_budget)
         msg_valid = msg_valid & sel_mask[:, None]
 
         shape3 = (s_budget, fanout, m_drain)
@@ -956,7 +995,8 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             0,
         )
 
-        # -- 2. push/pull (compacted; sources emit, outbox routes) -----
+        # -- 2. push/pull (owned draws; compacted; sources emit,
+        # outbox routes) -----------------------------------------------
         ov_repl = jnp.int32(0)
         ov_legs = jnp.int32(0)
         streams = [(recv_g, subj_g, val_g, sus_g, ok_g, alloc_g)]
@@ -965,51 +1005,33 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                 occupied & (key_rank(key_m) > RANK_SUSPECT), axis=1
             )
             known_l = n - dead_cnt_l
-            known_cnt = jax.lax.all_gather(
-                known_l, NODE_AXIS, tiled=True
+            needs_join_l = part_l & (known_l <= 1)
+            initiate_l = part_l & (
+                needs_join_l
+                | bernoulli_mask_owned(
+                    k_pp, rows_g, (), 1.0 / base.push_pull_ticks
+                )
             )
-            needs_join = participates & (known_cnt <= 1)
-            initiate = participates & (
-                needs_join
-                | bernoulli_mask(k_pp, (n,), 1.0 / base.push_pull_ticks)
+            partner_l = sample_probe_targets_owned(k_ppsel, rows_g, n)
+            pp_ok_l = initiate_l & participates[partner_l]
+            who, pwho, sel, missed = _global_initiators(
+                pp_ok_l, partner_l, rows_g, n, i_slots
             )
-            partner = sample_probe_targets(k_ppsel, n)
-            pp_ok = initiate & participates[partner]
-            got_i, who = jax.lax.top_k(pp_ok.astype(jnp.int32), i_slots)
-            who = who.astype(jnp.int32)
-            sel = got_i > 0
-            ov_repl = ov_repl + (
-                jnp.sum(pp_ok.astype(jnp.int32)) - jnp.sum(got_i)
-            )
-            pwho = partner[who]
+            ov_repl = ov_repl + missed
 
             # Each shard emits the exchange legs whose SOURCE row it
             # owns, COMPACTED into pp_owned slots (the budget note
-            # above): the j-th owned leg takes slot j via one cumsum +
-            # scatter — no stream-length sort — and legs past the
-            # budget drop LOUDLY into the overflow ledger.  At D == 1
-            # every leg is owned and pp_owned == i_slots, so the
-            # selected legs keep their positions (top_k's sel is an
-            # index prefix) and the stream is bit-identical to the
-            # unsharded exchange after masking.
+            # above; ops.compact_to_budget) — legs past the budget
+            # drop LOUDLY into the overflow ledger.  At D == 1 every
+            # leg is owned and pp_owned == i_slots, so the selected
+            # legs keep their positions (the compacted sel is an index
+            # prefix) and the stream is bit-identical to the unsharded
+            # exchange after masking.
             def owned_legs(src_g, recv_g_ids):
                 loc = src_g - start
                 own = (loc >= 0) & (loc < blk) & sel
-                cposl = jnp.cumsum(own.astype(jnp.int32)) - 1
-                tgtl = jnp.where(
-                    own & (cposl < pp_owned),
-                    jnp.clip(cposl, 0, pp_owned - 1), pp_owned,
-                )
-                slot = (
-                    jnp.full((pp_owned + 1,), i_slots, jnp.int32)
-                    .at[tgtl].set(
-                        jnp.arange(i_slots, dtype=jnp.int32))[:pp_owned]
-                )
-                taken = slot < i_slots
-                j = jnp.minimum(slot, i_slots - 1)
+                j, taken, _, d_legs = compact_to_budget(own, pp_owned)
                 src_l = jnp.clip(src_g[j] - start, 0, blk - 1)
-                d_legs = (jnp.sum(own.astype(jnp.int32))
-                          - jnp.sum(taken.astype(jnp.int32)))
                 return taken, src_l, recv_g_ids[j], d_legs
 
             tk_p, src_p, recv_p, d_p = owned_legs(pwho, who)
@@ -1067,7 +1089,7 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                 (slot_subj, key_m, suspect_since, confirms, tx),
                 recv_l, subj_l, val_l, sus_l, ok_l, alloc_l, n, k_slots,
                 jnp.int32(0), jnp.int32(0), row_ids=rows_g,
-                amortize=cfg.amortize,
+                amortize=resolve_amortize(cfg),
             )
         )
         slot_subj, key_m, suspect_since, confirms, tx = slots_t
@@ -1126,10 +1148,10 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
         tx = jnp.where(changed | gained_conf, base.tx_limit, tx)
         key_m = new_key
 
-        # -- 5. probes -------------------------------------------------
+        # -- 5. probes (owned draws) -----------------------------------
         if base.probe_enabled:
             is_probe_tick = (t % base.probe_interval_ticks) == 0
-            ptarget = _rows(sample_probe_targets(k_probe, n), start, blk)
+            ptarget = sample_probe_targets_owned(k_probe, rows_g, n)
             pt_view = _view_of(slot_subj, key_m, rows_l, ptarget)
             probing = (
                 is_probe_tick
@@ -1141,8 +1163,7 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
                 target_up, jnp.float32(base.probe_fail_prob_alive), 1.0
             )
             failed = probing & (
-                _rows(jax.random.uniform(k_pfail, (n,)), start, blk)
-                < p_fail
+                owned_uniform(k_pfail, rows_g) < p_fail
             )
             can_pend = failed & (st.probe_pending_at == NEVER)
             matures_at = (
@@ -1168,7 +1189,7 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
             slots_p = (slot_subj, key_m, suspect_since, confirms, tx)
             slots_p, can, pos, forgot, ov = _claim_one(
                 slots_p, need, probe_subject, row_ids=rows_g,
-                amortize=cfg.amortize,
+                amortize=resolve_amortize(cfg),
             )
             slot_subj, key_m, suspect_since, confirms, tx = slots_p
             forgotten = jnp.minimum(forgotten, COUNTER_CAP) + (
@@ -1308,8 +1329,10 @@ def _sharded_sparse_membership_scan(state, key: jax.Array, cfg,
     )
 
     def body(st, key):
-        keys = jax.random.split(key, steps)
-        return jax.lax.scan(tick, st, keys)
+        return jax.lax.scan(
+            lambda carry, t: tick(carry, jax.random.fold_in(key, t)),
+            st, jnp.arange(steps, dtype=jnp.int32),
+        )
 
     n_outs = 5 if telemetry else 4
     run = shard_map(
@@ -1347,13 +1370,18 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
     ``exchange`` = ``"alltoall"`` | ``"ring"``); aggregate mode needs
     only a [W, E] psum of per-class sender counts.  Returns
     ``(final_state, (*outs, outbox_overflow))`` with the unsharded
-    scan's per-tick outs; D == 1 is bit-equal by the replicated-draw
-    discipline.
+    scan's per-tick outs; D == 1 is bit-equal by the owned-draw
+    discipline (per-(round, node) keyed streams over the block's
+    global ids).
 
     ``state`` is donated (jaxlint J3, same contract as the unsharded
     scan): callers pass a fresh init positionally."""
     from consul_tpu.obs.spec import emit_local, reduce_over_mesh
-    from consul_tpu.ops import bernoulli_mask, sample_peers
+    from consul_tpu.ops import (
+        bernoulli_mask_owned,
+        owned_uniform,
+        sample_peers_owned,
+    )
     from consul_tpu.streamcast.model import (
         _AUX_SALT,
         _SCHED_SALT,
@@ -1382,6 +1410,7 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
             jax.random.fold_in(k, _AUX_SALT)
         )
         rows_l = jnp.arange(blk, dtype=jnp.int32)
+        rows_g = start + rows_l
 
         # -- 1. arrivals + window admission (replicated) -------------
         ev_tick, ev_origin, ev_name = sched
@@ -1392,20 +1421,19 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         chunks = st.chunks & ~(freed | filled)[None, :, None]
         tx_left = jnp.where((freed | filled)[None, :], 0, st.tx_left)
         org = ev_origin[jnp.maximum(slot_event, 0)]
-        seed = filled[None, :] & (
-            (start + rows_l)[:, None] == org[None, :]
-        )
+        seed = filled[None, :] & (rows_g[:, None] == org[None, :])
         chunks = chunks | seed[:, :, None]
         tx_left = jnp.where(seed, cfg.tx_limit, tx_left)
 
-        # -- 2. transmit (replicated draws, local slices) ------------
+        # -- 2. transmit (owned draws: [blk, .] streams keyed by
+        # global id) -------------------------------------------------
         occ = slot_event >= 0
         eligible = (
             jnp.any(chunks, axis=2) & (tx_left > 0) & occ[None, :]
         )
         prio = jnp.where(
             eligible, tx_left.astype(jnp.float32), -jnp.inf
-        ) + _rows(jax.random.uniform(k_tie, (n, w_slots)), start, blk)
+        ) + owned_uniform(k_tie, rows_g, (w_slots,))
         # Slot-index tie-break: float32 tie draws collide at scale and
         # would breach the chunk_budget bound (see the unsharded round).
         widx = jnp.arange(w_slots, dtype=jnp.int32)
@@ -1415,10 +1443,7 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         )
         rank = jnp.sum(ahead.astype(jnp.int32), axis=2)
         serviced = eligible & (rank < cfg.chunk_budget)
-        g = _rows(
-            jax.random.uniform(k_chunk, (n, w_slots, e_chunks)),
-            start, blk,
-        )
+        g = owned_uniform(k_chunk, rows_g, (w_slots, e_chunks))
         sel = jnp.argmax(jnp.where(chunks, g, -1.0), axis=2).astype(
             jnp.int32
         )
@@ -1426,12 +1451,9 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         dropped = jnp.int32(0)
 
         if cfg.delivery == "edges":
-            targets = _rows(sample_peers(k_sel, n, fanout), start, blk)
-            ok = serviced[:, :, None] & _rows(
-                bernoulli_mask(
-                    k_loss, (n, w_slots, fanout), p_live
-                ),
-                start, blk,
+            targets = sample_peers_owned(k_sel, rows_g, n, fanout)
+            ok = serviced[:, :, None] & bernoulli_mask_owned(
+                k_loss, rows_g, (w_slots, fanout), p_live
             )
             recv = jnp.broadcast_to(
                 targets[:, None, :], (blk, w_slots, fanout)
@@ -1489,10 +1511,7 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
                 (s_tot[None, :, :] - contrib) * fanout * p_live
                 / max(n - 1, 1)
             )
-            u = _rows(
-                jax.random.uniform(k_loss, (n, w_slots, e_chunks)),
-                start, blk,
-            )
+            u = owned_uniform(k_loss, rows_g, (w_slots, e_chunks))
             new_chunks = chunks | (u < -jnp.expm1(-lam))
 
         sent = jax.lax.psum(
@@ -1558,10 +1577,11 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         sched = arrival_arrays(
             cfg, jax.random.fold_in(key, _SCHED_SALT)
         )
-        keys = jax.random.split(key, steps)
         (final, _ov), outs = jax.lax.scan(
-            lambda carry, k: tick(carry, k, sched),
-            (st, jnp.int32(0)), keys,
+            lambda carry, t: tick(
+                carry, jax.random.fold_in(key, t), sched
+            ),
+            (st, jnp.int32(0)), jnp.arange(steps, dtype=jnp.int32),
         )
         return final, outs
 
@@ -1613,7 +1633,8 @@ def _sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
     schedule, the latency ring, the EWMA controller) is REPLICATED —
     it is a pure function of the replicated per-segment bridge-known
     masks and the replicated round keys, so every shard steps it
-    bit-identically.  Delivery slots are replicated draws; each shard
+    bit-identically.  Delivery slots are link-plane draws (replicated
+    by design, S²-scale); each shard
     emits only the slots whose SOURCE segment it owns, local
     deliveries scatter directly, and remote ones ride the
     per-destination outbox (pack_outbox -> exchange_outbox,
@@ -1630,7 +1651,7 @@ def _sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
         expand_delivery_slots,
     )
     from consul_tpu.obs.spec import emit_local, reduce_over_mesh
-    from consul_tpu.ops import bernoulli_mask
+    from consul_tpu.ops import bernoulli_mask, owned_uniform
     from consul_tpu.sim.faults import link_capacity_at
 
     n, S, ss = cfg.n, cfg.segments, cfg.seg_size
@@ -1657,6 +1678,7 @@ def _sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
         k_lan, k_gossip, k_tgt, k_loss = jax.random.split(k, 4)
         knows = st.knows
         rows_l = jnp.arange(blk, dtype=jnp.int32)
+        rows_g = start + rows_l
         seg_l = rows_l // ss                       # local segment index
 
         # -- 1. LAN gossip: per-segment Poissonized, device-local ----
@@ -1670,9 +1692,12 @@ def _sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
             * (1.0 - jnp.asarray(cfg.loss_lan, jnp.float32))
             / max(ss - 1, 1)
         )
+        # Owned LAN draws ([blk, E], keyed by global id); the WAN link
+        # plane's [S2, .] draws below stay REPLICATED by design — the
+        # link plane is a pure function every shard must step
+        # identically, and it is S²-scale, not n-scale.
         got_lan = (
-            _rows(jax.random.uniform(k_lan, (n, E)), start, blk)
-            < -jnp.expm1(-lam)
+            owned_uniform(k_lan, rows_g, (E,)) < -jnp.expm1(-lam)
         ) & ~knows
 
         # -- 2. bridge-known masks: local slices, replicated assembly -
@@ -1814,9 +1839,9 @@ def _sharded_geo_scan(state, key: jax.Array, cfg, steps: int,
         return (nxt, ob_ov), outs
 
     def body(st, key):
-        keys = jax.random.split(key, steps)
         (final, _ov), outs = jax.lax.scan(
-            tick, (st, jnp.int32(0)), keys
+            lambda carry, t: tick(carry, jax.random.fold_in(key, t)),
+            (st, jnp.int32(0)), jnp.arange(steps, dtype=jnp.int32),
         )
         return final, outs
 

@@ -4,6 +4,14 @@
 mesh (consul_tpu.parallel).  Each scan carries compact per-tick counters
 out (infection counts), so a million-node, thousand-tick study transfers
 only O(ticks) scalars back to the host.
+
+Round-key derivation is COUNTER-BASED: round ``t`` draws from
+``fold_in(scan_key, t)`` (not ``split(key, steps)``, whose keys depend
+on the step count), the round functions split that into per-site keys,
+and every node-indexed draw folds the global node id in
+(ops/sampling.py owned streams) — so trajectories are prefix-stable in
+``steps`` and the sharded twins generate draws for their owned n/D
+block only while staying bit-equal at D == 1.
 """
 
 from __future__ import annotations
@@ -76,15 +84,14 @@ def _broadcast_scan(state, key: jax.Array, cfg: BroadcastConfig, steps: int,
     output (pinned by tests/test_obs.py; same contract on every scan
     below)."""
 
-    def tick(carry, k):
-        nxt = broadcast_round(carry, k, cfg)
+    def tick(carry, t):
+        nxt = broadcast_round(carry, jax.random.fold_in(key, t), cfg)
         out = jnp.sum(nxt.knows, dtype=jnp.int32)
         if telemetry:
             out = (out, emit_metrics("broadcast", carry, nxt, out, cfg))
         return nxt, out
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 broadcast_scan = jax.jit(
@@ -97,8 +104,8 @@ def multidc_scan(state, key: jax.Array, cfg: MultiDCConfig, steps: int):
     """Run ``steps`` LAN ticks of the two-edge-class broadcast; returns
     (final_state, (infected_total[steps], infected_per_segment[steps, S]))."""
 
-    def tick(carry, k):
-        nxt = multidc_round(carry, k, cfg)
+    def tick(carry, t):
+        nxt = multidc_round(carry, jax.random.fold_in(key, t), cfg)
         per_seg = jnp.sum(
             nxt.knows.reshape(cfg.segments, cfg.seg_size),
             axis=1,
@@ -106,8 +113,7 @@ def multidc_scan(state, key: jax.Array, cfg: MultiDCConfig, steps: int):
         )
         return nxt, (jnp.sum(nxt.knows, dtype=jnp.int32), per_seg)
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 def _swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int,
@@ -115,8 +121,8 @@ def _swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int,
     """Run ``steps`` ticks; returns (final_state, (suspecting, dead_known)).
     Unjitted impl of :data:`swim_scan` (see :func:`_broadcast_scan`)."""
 
-    def tick(carry, k):
-        nxt = swim_round(carry, k, cfg)
+    def tick(carry, t):
+        nxt = swim_round(carry, jax.random.fold_in(key, t), cfg)
         out = (
             jnp.sum(nxt.view == VIEW_SUSPECT, dtype=jnp.int32),
             jnp.sum(nxt.view == VIEW_DEAD, dtype=jnp.int32),
@@ -125,8 +131,7 @@ def _swim_scan(state, key: jax.Array, cfg: SwimConfig, steps: int,
             out = (*out, emit_metrics("swim", carry, nxt, out, cfg))
         return nxt, out
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 swim_scan = jax.jit(
@@ -150,8 +155,8 @@ def _lifeguard_scan(state, key: jax.Array, cfg, steps: int,
     # the package __init__s.
     from consul_tpu.models.lifeguard import lifeguard_round
 
-    def tick(carry, k):
-        nxt = lifeguard_round(carry, k, cfg)
+    def tick(carry, t):
+        nxt = lifeguard_round(carry, jax.random.fold_in(key, t), cfg)
         newly_suspect = jnp.sum(
             (nxt.view == VIEW_SUSPECT) & (carry.view != VIEW_SUSPECT),
             dtype=jnp.int32,
@@ -170,8 +175,7 @@ def _lifeguard_scan(state, key: jax.Array, cfg, steps: int,
             out = (*out, emit_metrics("lifeguard", carry, nxt, out, cfg))
         return nxt, out
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 lifeguard_scan = jax.jit(
@@ -198,8 +202,8 @@ def _membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
         (0,), jnp.int32
     )
 
-    def tick(carry, k):
-        nxt = membership_round(carry, k, cfg)
+    def tick(carry, t):
+        nxt = membership_round(carry, jax.random.fold_in(key, t), cfg)
         ranks = key_rank(nxt.key)
         cols = ranks[:, track_idx] if track else jnp.zeros(
             (cfg.n, 0), jnp.int32
@@ -214,8 +218,7 @@ def _membership_scan(state, key: jax.Array, cfg: MembershipConfig, steps: int,
             out = (*out, emit_metrics("membership", carry, nxt, out, cfg))
         return nxt, out
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 membership_scan = jax.jit(
@@ -479,8 +482,10 @@ def _sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
         (0,), jnp.int32
     )
 
-    def tick(carry, k):
-        nxt = sparse_membership_round(carry, k, cfg)
+    def tick(carry, t):
+        nxt = sparse_membership_round(
+            carry, jax.random.fold_in(key, t), cfg
+        )
         ranks = key_rank(nxt.key)
         if track:
             # [n, K] slots vs tracked ids → per-subject observer counts.
@@ -513,8 +518,7 @@ def _sparse_membership_scan(state, key: jax.Array, cfg, steps: int,
             out = (*out, emit_metrics("sparse", carry, nxt, out, cfg))
         return nxt, out
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 sparse_membership_scan = jax.jit(
@@ -723,14 +727,15 @@ def _streamcast_scan(state, key: jax.Array, cfg, steps: int,
 
     sched = arrival_arrays(cfg, jax.random.fold_in(key, _SCHED_SALT))
 
-    def tick(carry, k):
-        nxt, out = streamcast_round(carry, k, cfg, sched)
+    def tick(carry, t):
+        nxt, out = streamcast_round(
+            carry, jax.random.fold_in(key, t), cfg, sched
+        )
         if telemetry:
             out = (*out, emit_metrics("streamcast", carry, nxt, out, cfg))
         return nxt, out
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 streamcast_scan = jax.jit(
@@ -823,14 +828,13 @@ def _geo_scan(state, key: jax.Array, cfg, steps: int,
     # the package __init__s (the models.lifeguard pattern).
     from consul_tpu.geo.model import geo_round
 
-    def tick(carry, k):
-        nxt, out = geo_round(carry, k, cfg)
+    def tick(carry, t):
+        nxt, out = geo_round(carry, jax.random.fold_in(key, t), cfg)
         if telemetry:
             out = (*out, emit_metrics("geo", carry, nxt, out, cfg))
         return nxt, out
 
-    keys = jax.random.split(key, steps)
-    return jax.lax.scan(tick, state, keys)
+    return jax.lax.scan(tick, state, jnp.arange(steps, dtype=jnp.int32))
 
 
 geo_scan = jax.jit(

@@ -293,11 +293,16 @@ def offline_prob_at(sched: FaultSchedule, tick: jax.Array) -> jax.Array:
 def online_mask(
     sched: FaultSchedule, key: jax.Array, tick: jax.Array, n: int
 ) -> jax.Array:
-    """bool[n]: nodes participating this tick (True = online)."""
+    """bool[n]: nodes participating this tick (True = online).
+
+    The churn draw rides the owned per-(round, node) streams
+    (ops/sampling.py): node i's coin depends only on ``(key, i)``."""
     if not sched.churn:
         return jnp.ones((n,), bool)
+    from consul_tpu.ops.sampling import owned_uniform
+
     p_off = offline_prob_at(sched, tick)
-    return jax.random.uniform(key, (n,)) >= p_off
+    return owned_uniform(key, jnp.arange(n, dtype=jnp.int32)) >= p_off
 
 
 def _link_mask(bs: BandwidthSchedule, segments: int):

@@ -41,7 +41,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from consul_tpu.ops import bernoulli_mask, sample_peers
+from consul_tpu.ops import bernoulli_mask, owned_uniform, sample_peers
 from consul_tpu.protocol import retransmit_limit
 from consul_tpu.protocol.profiles import GossipProfile, LAN
 from consul_tpu.sim.faults import FaultSchedule, _concrete, extra_loss_at
@@ -50,8 +50,12 @@ from consul_tpu.streamcast.window import admit, retire
 # Salt folded into the scan key for draws broadcast_round does not make
 # (slot-priority tie-breaks, chunk choice, the arrival schedule), so
 # the k_sel/k_loss stream stays bit-identical to broadcast_scan's.
-_AUX_SALT = 0x73C0
-_SCHED_SALT = 0x73C1
+# Salt constants sit far above any realistic round index: round keys
+# now derive as fold_in(scan_key, t) (the counter-based randomness
+# plane, sim/engine.py), so a salt below the step count would collide
+# with a round's key stream.
+_AUX_SALT = 0x73C00000
+_SCHED_SALT = 0x73C00001
 
 
 @dataclasses.dataclass(frozen=True)
@@ -334,7 +338,7 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
     )
     prio = jnp.where(
         eligible, tx_left.astype(jnp.float32), -jnp.inf
-    ) + jax.random.uniform(k_tie, (n, w_slots))
+    ) + owned_uniform(k_tie, rows, (w_slots,))
     # Strict total order: float32 tie-break draws DO collide at 1M x W
     # draws/round (birthday over 2^24), and a tie would let a node
     # service chunk_budget + 1 slots — break ties by slot index so
@@ -346,7 +350,7 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
     )
     rank = jnp.sum(ahead.astype(jnp.int32), axis=2)
     serviced = eligible & (rank < cfg.chunk_budget)
-    g = jax.random.uniform(k_chunk, (n, w_slots, e_chunks))
+    g = owned_uniform(k_chunk, rows, (w_slots, e_chunks))
     sel = jnp.argmax(jnp.where(chunks, g, -1.0), axis=2).astype(
         jnp.int32
     )
@@ -395,7 +399,7 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
             (s_tot[None, :, :] - contrib) * fanout * p_live
             / max(n - 1, 1)
         )
-        u = jax.random.uniform(k_loss, (n, w_slots, e_chunks))
+        u = owned_uniform(k_loss, rows, (w_slots, e_chunks))
         new_chunks = chunks | (u < -jnp.expm1(-lam))
 
     sent = jnp.sum(serviced, dtype=jnp.int32) * fanout

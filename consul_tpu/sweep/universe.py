@@ -562,7 +562,7 @@ def make_sweep(entrypoint: str, U: int, telemetry: bool = False,
 
     ``mesh=`` composes the two parallelism axes: the U-universe vmap
     wraps the SHARDED scan twin (parallel/shard.py) — one program
-    holding U universes x n/D nodes per device, replicated per-round
+    holding U universes x n/D nodes per device, owned per-(round, node)
     draws and per-universe folded keys exactly as unsharded, outbox
     budgets sized from the per-universe per-shard emission bound
     (every pack_outbox call batches per universe).  The composed
@@ -634,6 +634,22 @@ def _make_sweep(entrypoint: str, U: int, telemetry: bool, mesh,
             raise ValueError(
                 f"this sweep program is built for U={U}, got "
                 f"{keys.shape[0]} keys"
+            )
+        if entrypoint == "sparse" and cfg.amortize is None:
+            # Auto-pin the slow branch for the vmapped plane: under
+            # vmap the amortized dispatch cond lowers to both-branches
+            # select, so sparse sweeps would pay the cold-path sort on
+            # top of the dead fast branch (the measured 1.5x tax,
+            # bench "sweepshard").  An explicit amortize=True/False is
+            # honored — only the None auto resolves here, through the
+            # ONE policy function (resolve_amortize), so the plain-scan
+            # and vmapped sides of the auto can never diverge.
+            from consul_tpu.models.membership_sparse import (
+                resolve_amortize,
+            )
+
+            cfg = dataclasses.replace(
+                cfg, amortize=resolve_amortize(cfg, vmapped=True)
             )
 
         def one(state, key, vals):

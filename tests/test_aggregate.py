@@ -104,7 +104,10 @@ def _assert_quantile_band(r_e, r_a, denom, fracs, attr="infected"):
         )
 
 
+@pytest.mark.slow
 def test_broadcast_quantile_band_at_10k():
+    # Large-n distributional band (tier-1 budget policy): the
+    # edges/aggregate agreement claims stay tier-1 at small n above.
     n = 10_000
     cfg_e = BroadcastConfig(n=n, fanout=4, loss=0.2, delivery="edges")
     cfg_a = dataclasses.replace(cfg_e, delivery="aggregate")

@@ -680,13 +680,14 @@ class TestGoldenProgramSize:
     round) fails tier-1 loudly instead of surfacing as a compile-time
     regression.  Counts include every sub-jaxpr equation."""
 
-    # sparse re-pinned this PR: the sort-merge segmented sum moved from
-    # the log-depth associative scan to cumsum+cummax (fewer combine
-    # levels), net of the narrowing's dtype-cast equations.
+    # Re-pinned for the owned-draws randomness plane: every per-node
+    # draw site gained the vmapped fold_in key derivation
+    # (ops/sampling.owned_keys) — a few equations per site — net of
+    # the compact_to_budget consolidation.
     PINS = {
-        "broadcast@small": 123,
-        "membership@small": 882,
-        "sparse@small": 2499,
+        "broadcast@small": 142,
+        "membership@small": 928,
+        "sparse@small": 3022,
     }
     RTOL = 0.2
 

@@ -74,7 +74,11 @@ class TestExactParity:
                                       np.asarray(dense.awareness))
         assert int(sparse.overflow) == 0
 
+    @pytest.mark.slow
     def test_k_equals_n_no_failures_stays_quiet(self):
+        # Corollary of the bit-for-bit parity pin above on a separate
+        # no-failure program (tier-1 budget policy: the bit-for-bit
+        # pin keeps the K == n claim in tier-1).
         n = 32
         cfg = MembershipConfig(n=n, loss=0.3, profile=LAN)
         scfg = SparseMembershipConfig(base=cfg, k_slots=n)

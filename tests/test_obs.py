@@ -389,6 +389,40 @@ class TestBridge:
               if g["Labels"].get("universe") == "1"}
         assert set(g0) == set(g1)
 
+    def test_composed_sweep_trace_bridges_per_universe(self):
+        # The PR-13 leftover closed: a COMPOSED (D > 1) sweep's psum'd
+        # [U, steps, M] trace gets the same universe-Label treatment —
+        # the sharded twins assemble the identical trace via one
+        # integer psum, so the composed bridge is the unsharded bridge
+        # on the same shapes, universe index as a metric Label.
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        from consul_tpu.parallel import make_mesh
+
+        mesh = make_mesh(jax.devices()[:2])
+        u2 = Universe(entrypoint="broadcast", cfg=BCFG, steps=STEPS,
+                      seeds=(0, 1))
+        rep = run_sweep(u2, warmup=False, telemetry=True, mesh=mesh)
+        assert rep.metrics_trace.shape[0] == 2
+        assert rep.devices == 2
+        sink = bridge_report("broadcast", rep, Metrics())
+        for u in (0, 1):
+            labels = {"universe": str(u)}
+            for j, spec in enumerate(METRIC_SPECS["broadcast"]):
+                col = rep.metrics_trace[u, :, j]
+                if spec.kind == "counter":
+                    assert sink.get_counter(
+                        spec.name, labels=labels
+                    ) == STEPS
+                else:
+                    assert sink.get_gauge(
+                        spec.name, labels=labels
+                    ) == float(col[-1])
+        # Composed == unsharded sweep trace (U=… x D=2 parity): the
+        # psum'd assembly reproduces the unsharded trace bit-for-bit.
+        rep_u = run_sweep(u2, warmup=False, telemetry=True)
+        assert np.array_equal(rep.metrics_trace, rep_u.metrics_trace)
+
     def test_bad_trace_and_missing_trace_rejected_loudly(self):
         with pytest.raises(ValueError, match="expected a"):
             bridge_trace("swim", np.zeros((4, 3), np.float32),

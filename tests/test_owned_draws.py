@@ -1,0 +1,295 @@
+"""The owned per-(round, node) randomness plane (ops/sampling.py).
+
+Three property families:
+
+  * the OWNED contract — a draw for global id i depends only on
+    ``(site_key, i)``, so any block of ids reproduces the full
+    population's rows exactly (what every sharded twin's bit-equality
+    rides on), including non-contiguous and permuted blocks;
+  * stream independence — draws for distinct (round, node, site,
+    universe) coordinates are statistically independent, checked
+    against plain-numpy moment/correlation references;
+  * the counter-based round derivation — per-round keys are
+    ``fold_in(scan_key, t)``, so trajectories are PREFIX-STABLE in the
+    step count (a shorter scan is a prefix of a longer one), and the
+    per-chip draw-plane footprint of a block is ~n/D (the J6 draw-term
+    pin the composed max-U acceptance rides on).
+
+compact_to_budget (ops/compact.py) is property-tested here too — it is
+the one budget-compaction form every call site now shares.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import (
+    bernoulli_mask,
+    bernoulli_mask_owned,
+    compact_to_budget,
+    owned_keys,
+    owned_randint,
+    owned_uniform,
+    poissonized_arrivals,
+    poissonized_arrivals_owned,
+    sample_alive_peers,
+    sample_alive_peers_owned,
+    sample_peers,
+    sample_peers_owned,
+    sample_probe_targets,
+    sample_probe_targets_owned,
+)
+
+KEY = jax.random.PRNGKey(1234)
+N = 96
+
+
+def _ids(kind):
+    if kind == "contiguous":
+        return jnp.arange(24, 72, dtype=jnp.int32)
+    if kind == "strided":
+        return jnp.arange(0, N, 3, dtype=jnp.int32)
+    return jnp.asarray([7, 3, 91, 0, 44, 44, 12], jnp.int32)  # permuted+dup
+
+
+# ---------------------------------------------------------------------------
+# The owned contract: block rows == full-population rows.
+# ---------------------------------------------------------------------------
+
+
+class TestOwnedContract:
+    @pytest.mark.parametrize("kind", ["contiguous", "strided", "permuted"])
+    def test_owned_uniform_matches_full(self, kind):
+        ids = _ids(kind)
+        full = owned_uniform(KEY, jnp.arange(N, dtype=jnp.int32), (5,))
+        own = owned_uniform(KEY, ids, (5,))
+        assert np.array_equal(np.asarray(full)[np.asarray(ids)],
+                              np.asarray(own))
+
+    @pytest.mark.parametrize("kind", ["contiguous", "strided", "permuted"])
+    def test_samplers_match_full(self, kind):
+        ids = _ids(kind)
+        idx = np.asarray(ids)
+        pairs = [
+            (sample_peers(KEY, N, 4),
+             sample_peers_owned(KEY, ids, N, 4)),
+            (sample_probe_targets(KEY, N),
+             sample_probe_targets_owned(KEY, ids, N)),
+            (bernoulli_mask(KEY, (N, 3), 0.7),
+             bernoulli_mask_owned(KEY, ids, (3,), 0.7)),
+            (owned_randint(KEY, jnp.arange(N, dtype=jnp.int32), (2,),
+                           0, 17),
+             owned_randint(KEY, ids, (2,), 0, 17)),
+        ]
+        alive = jnp.arange(N) % 5 != 0
+        pairs.append((sample_alive_peers(KEY, alive, 4),
+                      sample_alive_peers_owned(KEY, ids, alive, 4)))
+        lam_full = jnp.linspace(0.1, 2.0, N)
+        pairs.append((poissonized_arrivals(KEY, lam_full),
+                      poissonized_arrivals_owned(KEY, ids,
+                                                 lam_full[ids])))
+        for full, own in pairs:
+            assert np.array_equal(np.asarray(full)[idx], np.asarray(own))
+
+    def test_sharded_block_union_is_full_population(self):
+        # The D-shard picture verbatim: disjoint contiguous blocks
+        # re-assemble the unsharded draw plane exactly.
+        full = np.asarray(sample_peers(KEY, N, 3))
+        for d in (2, 4):
+            blk = N // d
+            parts = [
+                np.asarray(sample_peers_owned(
+                    KEY, me * blk + jnp.arange(blk, dtype=jnp.int32),
+                    N, 3))
+                for me in range(d)
+            ]
+            assert np.array_equal(np.concatenate(parts), full)
+
+    def test_self_exclusion_and_alive_pool(self):
+        tgt = np.asarray(sample_peers(KEY, N, 6))
+        assert (tgt != np.arange(N)[:, None]).all()
+        assert ((tgt >= 0) & (tgt < N)).all()
+        alive = jnp.arange(N) % 4 != 1
+        at = np.asarray(sample_alive_peers(KEY, alive, 6))
+        al = np.asarray(alive)
+        assert al[at].all()
+        assert (at != np.arange(N)[:, None])[al].all()
+
+
+# ---------------------------------------------------------------------------
+# Stream independence (numpy references on moments/correlations).
+# ---------------------------------------------------------------------------
+
+
+class TestStreamIndependence:
+    def _round_site_plane(self, scan_key, t, site, cols=64):
+        """The model derivation verbatim: round key = fold_in(scan_key,
+        t), site keys = split(round key, 7), node streams owned."""
+        k_site = jax.random.split(jax.random.fold_in(scan_key, t), 7)[site]
+        return np.asarray(owned_uniform(
+            k_site, jnp.arange(N, dtype=jnp.int32), (cols,)
+        ))
+
+    def test_reproducible_and_distinct_across_coordinates(self):
+        base = self._round_site_plane(KEY, 3, 2)
+        assert np.array_equal(base, self._round_site_plane(KEY, 3, 2))
+        for other in (
+            self._round_site_plane(KEY, 4, 2),       # round moved
+            self._round_site_plane(KEY, 3, 5),       # site moved
+            self._round_site_plane(jax.random.fold_in(KEY, 1), 3, 2),
+        ):                                           # universe moved
+            assert not np.array_equal(base, other)
+            # distinct coordinates are fresh streams, not shifts: no
+            # row collides either
+            assert not (base == other).all(axis=1).any()
+
+    def test_uniform_moments_match_numpy_reference(self):
+        # Pool draws across rounds x nodes: mean/var of U(0,1) within
+        # 5 sigma of the numpy reference bounds.
+        planes = np.stack([
+            self._round_site_plane(KEY, t, 1) for t in range(4)
+        ])
+        m = planes.size
+        assert abs(planes.mean() - 0.5) < 5 * np.sqrt(1 / 12 / m)
+        assert abs(planes.var() - 1 / 12) < 5 * np.sqrt(1 / 180 / m)
+
+    def test_rounds_and_nodes_uncorrelated(self):
+        a = self._round_site_plane(KEY, 0, 0).ravel()
+        b = self._round_site_plane(KEY, 1, 0).ravel()
+        # Pearson r ~ N(0, 1/sqrt(m)) under independence.
+        r_rounds = np.corrcoef(a, b)[0, 1]
+        assert abs(r_rounds) < 5 / np.sqrt(a.size)
+        plane = self._round_site_plane(KEY, 0, 3)
+        r_nodes = np.corrcoef(plane[:-1].ravel(), plane[1:].ravel())[0, 1]
+        assert abs(r_nodes) < 5 / np.sqrt(plane[:-1].size)
+
+    def test_peer_targets_uniform_over_population(self):
+        # Frequency reference: pooled target counts over many rounds
+        # are Binomial(m, 1/(n-1)) per (receiver != sender) cell.
+        counts = np.zeros(N)
+        rounds = 40
+        for t in range(rounds):
+            k = jax.random.split(jax.random.fold_in(KEY, t), 7)[1]
+            tgt = np.asarray(sample_peers(k, N, 4)).ravel()
+            counts += np.bincount(tgt, minlength=N)
+        m = rounds * N * 4
+        p = 1 / (N - 1)
+        sigma = np.sqrt(m * p * (1 - p))
+        assert (np.abs(counts - m * p) < 6 * sigma).all()
+
+
+# ---------------------------------------------------------------------------
+# Counter-based rounds: prefix stability + the ~n/D draw-term pin.
+# ---------------------------------------------------------------------------
+
+
+class TestCounterRounds:
+    def test_scan_prefix_stability(self):
+        # fold_in(scan_key, t) round keys make a shorter run a strict
+        # prefix of a longer one — split(key, steps) could not (its
+        # keys depend on steps).  Pinned on the cheapest scan family.
+        from consul_tpu.models.broadcast import (
+            BroadcastConfig,
+            broadcast_init,
+        )
+        from consul_tpu.sim.engine import broadcast_scan
+
+        cfg = BroadcastConfig(n=128, fanout=3, loss=0.2)
+        key = jax.random.PRNGKey(9)
+        _, short = broadcast_scan(broadcast_init(cfg), key, cfg, 6)
+        _, full = broadcast_scan(broadcast_init(cfg), key, cfg, 14)
+        assert np.array_equal(np.asarray(short), np.asarray(full)[:6])
+
+    def test_draw_plane_footprint_scales_as_n_over_d(self):
+        # The J6 draw-term pin: one round's draw planes for an owned
+        # block, traced at blk = n/D — the term the replicated design
+        # paid at O(n) per chip for every D.  Exact 1/D scaling up to
+        # the vmap key constant.
+        n, fanout, k_slots = 4096, 4, 32
+
+        def draws(blk):
+            def f(key):
+                ids = jnp.arange(blk, dtype=jnp.int32)
+                k1, k2, k3 = jax.random.split(key, 3)
+                return (sample_peers_owned(k1, ids, n, fanout),
+                        bernoulli_mask_owned(k2, ids, (fanout,), 0.9),
+                        owned_uniform(k3, ids, (k_slots,)))
+
+            from consul_tpu.analysis.jaxlint import estimate_peak
+
+            return estimate_peak(
+                jax.make_jaxpr(f)(jax.random.PRNGKey(0))
+            ).chip_bytes
+
+        full = draws(n)
+        for d in (2, 4, 8):
+            ratio = draws(n // d) / full
+            assert abs(ratio - 1 / d) < 0.15 / d, (d, ratio)
+
+
+# ---------------------------------------------------------------------------
+# compact_to_budget: the one budget-compaction form (numpy reference).
+# ---------------------------------------------------------------------------
+
+
+class TestCompactToBudget:
+    def _reference(self, want, budget, first=None):
+        order = np.flatnonzero(want & first) if first is not None else None
+        if first is None:
+            admitted = np.flatnonzero(want)[:budget]
+        else:
+            admitted = np.concatenate([
+                np.flatnonzero(want & first),
+                np.flatnonzero(want & ~first),
+            ])[:budget]
+        kept = np.zeros(len(want), bool)
+        kept[admitted] = True
+        return admitted, kept, int(want.sum() - len(admitted))
+
+    @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.parametrize("budget", [1, 7, 40, 64])
+    def test_matches_reference(self, seed, budget):
+        rng = np.random.RandomState(seed)
+        want = rng.rand(64) < rng.choice([0.05, 0.4, 0.95])
+        idx, taken, kept, dropped = compact_to_budget(
+            jnp.asarray(want), budget
+        )
+        adm, kept_ref, dropped_ref = self._reference(want, budget)
+        assert np.array_equal(np.asarray(idx)[np.asarray(taken)], adm)
+        assert np.array_equal(np.asarray(kept), kept_ref)
+        assert int(dropped) == dropped_ref
+        # Empty slots are gather-safe (clamped in range).
+        assert (np.asarray(idx) < 64).all() and (np.asarray(idx) >= 0).all()
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_two_class_admission_matches_reference(self, seed):
+        rng = np.random.RandomState(100 + seed)
+        want = rng.rand(96) < 0.5
+        first = rng.rand(96) < 0.3
+        budget = 24
+        idx, taken, kept, dropped = compact_to_budget(
+            jnp.asarray(want), budget, jnp.asarray(first)
+        )
+        adm, kept_ref, dropped_ref = self._reference(want, budget, first)
+        assert np.array_equal(np.asarray(idx)[np.asarray(taken)], adm)
+        assert np.array_equal(np.asarray(kept), kept_ref)
+        assert int(dropped) == dropped_ref
+        # Priority property: no admitted class-1 entry while a class-0
+        # entry dropped.
+        k = np.asarray(kept)
+        if (want & first & ~k).any():
+            assert not (want & ~first & k).any()
+
+    def test_degenerate_streams(self):
+        none = jnp.zeros((16,), bool)
+        idx, taken, kept, dropped = compact_to_budget(none, 4)
+        assert not np.asarray(taken).any()
+        assert int(dropped) == 0
+        all_w = jnp.ones((16,), bool)
+        idx, taken, kept, dropped = compact_to_budget(all_w, 16)
+        assert np.array_equal(np.asarray(idx), np.arange(16))
+        assert int(dropped) == 0

@@ -273,11 +273,16 @@ class TestJ8KeyLineage:
     KEY = SDS((2,), jnp.uint32)
 
     def test_fires_on_double_draw(self):
+        # Shape (41,) is deliberately unique: jax caches the traced
+        # jaxpr of its internally-jitted uniform per (shape, dtype),
+        # source info included, so a shape another module already
+        # traced (e.g. the owned-draw helpers' per-row (fanout,)
+        # draws) would carry THAT call site's provenance.
         def bad(key, x):
-            return (jax.random.uniform(key, (4,))
-                    + jax.random.uniform(key, (4,)) + x)
+            return (jax.random.uniform(key, (41,))
+                    + jax.random.uniform(key, (41,)) + x)
 
-        rep = _analyze(bad, (self.KEY, SDS((4,), F32)))
+        rep = _analyze(bad, (self.KEY, SDS((41,), F32)))
         assert ["J8"] == [f.rule for f in rep.findings]
         assert "test_rangelint" in rep.findings[0].where
 

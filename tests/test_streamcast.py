@@ -488,7 +488,11 @@ class TestEngine:
         # The exact (cfg, steps) the sharded ladder uses, so the whole
         # module pays ONE unsharded compile.
         cfg = _SHARDED_CFG
-        rep = run_streamcast(cfg, steps=12, seed=0, warmup=False)
+        # seed=2: under the counter-based key derivation (fold_in
+        # round keys, owned node streams) seed 0's first Poisson
+        # arrival lands past tick 12 — pick a seed whose schedule
+        # offers events inside the 12-step window the module shares.
+        rep = run_streamcast(cfg, steps=12, seed=2, warmup=False)
         # warmup=False + a second seed through the SAME program: the
         # single_trace guard asserts one compile for both.
         rep2 = run_streamcast(cfg, steps=12, seed=1, warmup=False)
@@ -530,7 +534,7 @@ def _sharded_runs():
     from consul_tpu.parallel.shard import sharded_streamcast_scan
 
     cfg = _SHARDED_CFG
-    key = jax.random.PRNGKey(0)
+    key = jax.random.PRNGKey(2)  # events inside 12 ticks (TestEngine note)
     steps = 12
     runs = {}
     _, runs["unsharded"] = streamcast_scan(
@@ -569,7 +573,7 @@ class TestSharded:
         from consul_tpu.parallel import make_mesh
 
         rep = run_streamcast(
-            _SHARDED_CFG, steps=12, warmup=False,
+            _SHARDED_CFG, steps=12, seed=2, warmup=False,
             mesh=make_mesh(jax.devices()[:2]),
         )
         assert rep.shard_overflow == 0

@@ -327,14 +327,17 @@ class TestSeedSweepDistribution:
 
     def test_mean_within_swim_paper_band(self):
         # Same band as test_swim_paper.test_first_detection_mean_
-        # within_5pct, measured over 96 universes from one batched
+        # within_5pct, measured over 500 universes from one batched
         # program (fold_in keys are prefix-stable, so these ARE the
-        # first universes of a larger error-bar sweep).  96, not 64:
+        # first universes of a larger error-bar sweep).  500, not 96:
         # the per-universe std is ~0.61x the mean, so the 5% band is
-        # ~0.8 sigma at U=64 — this deterministic fold_in draw sits at
-        # 6.1% there and 0.2% at U=96.
+        # only ~0.8 sigma at U=96 — the owned-draws derivation's
+        # deterministic fold_in prefix lands 2.2 sigma high there
+        # (verified converging: rel_err 16.7% @96 -> 2.4% @500 ->
+        # 1.3% @2000), so the band needs ~1.8 sigma of room to be a
+        # statistics claim instead of a seed-luck claim.
         n = 256
-        periods = _sweep_first_detection(n, 96)
+        periods = _sweep_first_detection(n, 500)
         p = 1.0 - (1.0 - 1.0 / (n - 1)) ** (n - 1)
         expected = 1.0 / p
         rel_err = abs(periods.mean() - expected) / expected
@@ -346,7 +349,7 @@ class TestSeedSweepDistribution:
         n = 256
         cfg = SwimConfig(n=n, subject=7, fail_at_tick=0)
         P = cfg.probe_interval_ticks
-        periods = _sweep_first_detection(n, 96)
+        periods = _sweep_first_detection(n, 500)  # shares the cached run
         base = jax.random.PRNGKey(0)
         for u in (0, 3):
             _, (sus, _dead) = swim_scan(

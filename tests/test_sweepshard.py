@@ -681,6 +681,69 @@ class TestAmortizeEscapeHatch:
         assert _trees_equal(_np_tree(o1), _np_tree(o2))
         assert _trees_equal(_np_tree(f1), _np_tree(f2))
 
+    @staticmethod
+    def _count_conds(jaxpr):
+        n = [0]
+
+        def walk(j):
+            for e in j.eqns:
+                if e.primitive.name == "cond":
+                    n[0] += 1
+                for v in e.params.values():
+                    for cj in (v if isinstance(v, (list, tuple))
+                               else (v,)):
+                        if hasattr(cj, "jaxpr"):
+                            walk(cj.jaxpr)
+        walk(jaxpr)
+        return n[0]
+
+    def test_amortize_auto_pins_slow_branch_for_sweeps(self):
+        # amortize=None (the default) is AUTO: make_sweep resolves it
+        # to False for the vmapped sparse plane — the measured-1.5x
+        # both-branches escape hatch applied by default — while an
+        # explicit amortize=True stays honored.  Under vmap the
+        # dispatch cond lowers to select with BOTH branches inlined
+        # (the tax itself), so the pin is program identity: the auto
+        # program IS the explicit-False program, and the explicit-True
+        # program carries the extra dead-branch equations.  Abstract
+        # traces only.
+        from consul_tpu.analysis.jaxlint import eqn_count
+        from consul_tpu.sweep.universe import abstract_sweep_program
+
+        def sweep_jaxpr(cfg):
+            fn, args = abstract_sweep_program("sparse", cfg, 2, 1, (),
+                                              (3,))
+            return jax.make_jaxpr(fn)(*args)
+
+        auto = _FAMS["sparse"][0]
+        assert auto.amortize is None
+        j_auto = sweep_jaxpr(auto)
+        j_false = sweep_jaxpr(dataclasses.replace(auto, amortize=False))
+        j_true = sweep_jaxpr(dataclasses.replace(auto, amortize=True))
+        assert str(j_auto) == str(j_false)
+        assert eqn_count(j_true) > eqn_count(j_auto)
+
+    def test_amortize_auto_keeps_plain_scans_amortized(self):
+        # The plain-scan side of the auto: None resolves to the
+        # amortized dispatch (cond present), explicit values win.
+        from consul_tpu.models.membership_sparse import resolve_amortize
+        from consul_tpu.sim import engine
+
+        auto = _FAMS["sparse"][0]
+        assert resolve_amortize(auto) is True
+        assert resolve_amortize(
+            dataclasses.replace(auto, amortize=False)) is False
+        assert resolve_amortize(auto, vmapped=True) is False
+        assert resolve_amortize(
+            dataclasses.replace(auto, amortize=True), vmapped=True
+        ) is True
+        state = jax.eval_shape(lambda: sparse_membership_init(auto))
+        jaxpr = jax.make_jaxpr(
+            lambda s, k: engine._sparse_membership_scan(
+                s, k, auto, 2, (3,))
+        )(state, jax.random.PRNGKey(0))
+        assert self._count_conds(jaxpr.jaxpr) > 0
+
     def test_amortize_is_shape_denied_for_sweeps(self):
         with pytest.raises(ValueError,
                            match="shapes or trace-time structure"):

@@ -483,6 +483,18 @@ class TestRepoGate:
         ), "ops/sortmerge.py left the linted trees"
         assert lint_paths([target]) == []
 
+    def test_owned_draws_and_compaction_are_covered_and_clean(self):
+        # The owned per-(round, node) randomness plane and the shared
+        # budget compaction are traced code under every scan; pin both
+        # into the zero-violations gate by name so a tree reshuffle
+        # can't silently drop them from LINT_TREES.
+        for target in (PKG_ROOT / "ops" / "sampling.py",
+                       PKG_ROOT / "ops" / "compact.py"):
+            assert any(
+                target.is_relative_to(tree) for tree in LINT_TREES
+            ), f"{target.name} left the linted trees"
+            assert lint_paths([target]) == []
+
     def test_ring_exchange_kernel_is_covered_and_clean(self):
         # The Pallas ring-DMA exchange kernel is the newest traced
         # code; pin it into the zero-violations gate by name so a
