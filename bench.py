@@ -198,29 +198,90 @@ def _sweep_max_u(budget_bytes: int = 16 << 30) -> dict:
     return rows
 
 
-def _streaming_curve() -> dict:
-    """The sustained-load throughput curve (consul_tpu/streamcast):
-    delivered events/sec at the north-star n=1M versus >= 3 offered
-    loads, with per-event t50/t99 delivery quantiles per point and the
-    saturation knee — the first offered load whose pipeline window
-    overflows.  All load points run in ONE vmapped program (the sweep
-    plane: ``rate`` is a traced knob, so the curve costs one compile).
+#: The streaming-bench workload (one shared shape for every policy so
+#: the knees compare): W=7 slots of E=4-chunk events, fanout 4, a
+#: 4-slot-per-round budget, delivery bar 99%.  Budget and bar are set
+#: so the knee measures CHUNK CHOICE, not policy-blind overheads:
+#: chunk_budget=2 made every policy collapse identically past the knee
+#: (at a full window a node serviced each slot once per W/2 rounds —
+#: service dilution, not duplicate waste, pinned slot lifetime), a
+#: 99.9% bar pads every lifetime with the pure-Poisson straggler tail
+#: that no schedule can shorten, and W calibrates the window so the
+#: uniform baseline saturates at its historical 0.3 ev/tick anchor
+#: (PR 8's knee).  The knee curve runs the PACED (staggered) arrival
+#: stream — same mean load, zero burst variance — so the knee is the
+#: deterministic capacity boundary rate x lifetime = W, not Poisson
+#: burst luck.  The per-round bandwidth bound is chunk_budget x
+#: fanout = 16 chunk copies/node under every policy.
+_STREAM_WORK = dict(window=7, chunks=4, fanout=4, chunk_budget=4,
+                    done_frac=0.99)
 
-    CPU containers run at reduced n under the same MemAvailable
-    discipline as the sparse-1M section — the curve's SHAPE is the
-    deliverable there; the 1M magnitude belongs to accelerators.
-    """
-    import jax as _jax
+# The streaming section curves every chunk-selection policy, in
+# registry order (streamcast.model.POLICIES, imported lazily inside
+# _streaming_curve — bench keeps module import jax-free): the
+# original uniform draw, the paper's round-robin pipeline, the greedy
+# lowest-index twin.
+
+
+def _stream_points(rep, rates) -> tuple:
+    """(curve points, knee) off a streamload SweepReport — knee = the
+    first offered load whose window overflowed."""
     import numpy as _np
 
-    from consul_tpu.sim.engine import run_sweep
-    from consul_tpu.sweep.presets import stream_load_curve
+    points, knee = [], None
+    for i, rate in enumerate(rates):
+        ov = int(rep.metrics["window_overflow"][i])
+        t50 = rep.metrics["t50_ms"][i]
+        t99 = rep.metrics["t99_ms"][i]
+        points.append({
+            "offered_rate_events_per_tick": rate,
+            "offered_events_per_sim_s": round(
+                float(rep.metrics["offered_events_per_sim_s"][i]), 3),
+            "delivered_events_per_sim_s": round(
+                float(rep.metrics["delivered_events_per_sim_s"][i]), 3),
+            "t50_ms": None if _np.isnan(t50) else float(t50),
+            "t99_ms": None if _np.isnan(t99) else float(t99),
+            "window_overflow": ov,
+        })
+        if knee is None and ov > 0:
+            knee = rate
+    return points, knee
 
-    # The ladder spans both sides of the knee: full completion of a
-    # 4-chunk event takes tens of ticks at these n, so W=8 sustains a
-    # few-x-0.01 events/tick before arrivals start finding the window
-    # full.
-    rates = (0.02, 0.08, 0.3, 1.0)
+
+def _streaming_curve() -> dict:
+    """The sustained-load throughput curve (consul_tpu/streamcast),
+    PER SELECTION POLICY: delivered events/sec at the north-star n=1M
+    versus the offered-load ladder, with per-event t50/t99 quantiles
+    per point and the saturation knee — the first offered load whose
+    pipeline window overflows.  Each policy's whole ladder runs in ONE
+    vmapped program (``rate`` is a traced knob; the policy is static,
+    so policy × load is exactly len(POLICIES) compiled programs).
+
+    The deliverable headline is the KNEE MOVE: the paper's round-robin
+    pipeline schedule stops wasting the fixed per-round budget on
+    duplicate chunk re-draws, so its knee must sit at >= 2x uniform's
+    (ROADMAP item 5 acceptance).  A second, adversarial ladder per
+    policy (the streamadv preset: standing backlog = W, hotspot 0.5,
+    heavy-tail severity as the knob) shows which schedule survives
+    production-shaped traffic.
+
+    CPU containers run at reduced n under the same MemAvailable
+    discipline as the sparse-1M section — the curve's SHAPE and the
+    knee (measured in offered-load units) are the deliverable there;
+    the 1M magnitude belongs to accelerators.
+    """
+    import jax as _jax
+
+    from consul_tpu.sim.engine import run_sweep
+    from consul_tpu.streamcast.model import POLICIES as _STREAM_POLICIES
+    from consul_tpu.sweep.presets import (
+        stream_adversarial_ladder,
+        stream_load_curve,
+    )
+
+    # The ladder brackets both knees: uniform first overflows at 0.3,
+    # the pipeline schedule must stay clean there and knee at >= 0.6.
+    rates = (0.1, 0.3, 0.6, 1.2)
     steps = 150
     n = 1_000_000
     out: dict = {}
@@ -240,35 +301,72 @@ def _streaming_curve() -> dict:
             f"({'unknown' if avail_gb is None else round(avail_gb, 1)}"
             "GB available)"
         )
-    uni = stream_load_curve(n=n, rates=rates, steps=steps)
-    rep = run_sweep(uni, warmup=False)
-    points = []
-    knee = None
-    for i, rate in enumerate(rates):
-        ov = int(rep.metrics["window_overflow"][i])
-        t50 = rep.metrics["t50_ms"][i]
-        t99 = rep.metrics["t99_ms"][i]
-        points.append({
-            "offered_rate_events_per_tick": rate,
-            "offered_events_per_sim_s": round(
-                float(rep.metrics["offered_events_per_sim_s"][i]), 3),
-            "delivered_events_per_sim_s": round(
-                float(rep.metrics["delivered_events_per_sim_s"][i]), 3),
-            "t50_ms": None if _np.isnan(t50) else float(t50),
-            "t99_ms": None if _np.isnan(t99) else float(t99),
-            "window_overflow": ov,
-        })
-        if knee is None and ov > 0:
-            knee = rate
+    policies: dict = {}
+    for pol in _STREAM_POLICIES:
+        uni = stream_load_curve(n=n, rates=rates, steps=steps,
+                                policy=pol, arrivals="paced",
+                                **_STREAM_WORK)
+        rep = run_sweep(uni, warmup=False)
+        points, knee = _stream_points(rep, rates)
+        policies[pol] = {
+            "curve": points,
+            "knee_rate": knee,
+            "wall_s": round(rep.wall_s, 2),
+        }
     out.update({
         "streaming_n": n,
         "streaming_steps": steps,
-        "streaming_window": uni.cfg.window,
-        "streaming_chunks_per_event": uni.cfg.chunks,
-        "streaming_curve": points,
-        "streaming_knee_rate": knee,
-        "streaming_wall_s": round(rep.wall_s, 2),
+        "streaming_window": _STREAM_WORK["window"],
+        "streaming_chunks_per_event": _STREAM_WORK["chunks"],
+        "streaming_chunk_budget": _STREAM_WORK["chunk_budget"],
+        "streaming_policies": policies,
+        # Legacy top-level keys ride the uniform arm.  NOT continuous
+        # with BENCH_r05-r14: the workload was recalibrated for the
+        # policy comparison (window 8→7, budget 2→4, done_frac
+        # 0.999→0.99, Poisson→paced, rate ladder 0.02-1.0→0.1-1.2) —
+        # compare knees across revisions only within one workload.
+        "streaming_workload_note":
+            "recalibrated in PR 15 (policy seam): knees are NOT "
+            "comparable to pre-PR-15 BENCH_r* values",
+        "streaming_curve": policies["uniform"]["curve"],
+        "streaming_knee_rate": policies["uniform"]["knee_rate"],
+        "streaming_knee_rate_pipeline":
+            policies["pipeline"]["knee_rate"],
+        # The uniform arm's wall (the historical meaning of this key);
+        # the per-policy walls ride streaming_policies[*].wall_s.
+        "streaming_wall_s": policies["uniform"]["wall_s"],
     })
+
+    # Adversarial ladder per policy: the window starts the run FULL
+    # (backlog = W), half the arrivals publish from one hot node, and
+    # the heavy-tail severity ladders as the traced knob — one vmapped
+    # program per policy (streamadv preset).
+    tails = (0.25, 0.5, 1.0, 2.0)
+    adv: dict = {}
+    for pol in _STREAM_POLICIES:
+        uni = stream_adversarial_ladder(
+            n=n, tails=tails, steps=steps, rate=0.3, policy=pol,
+            **_STREAM_WORK,
+        )
+        rep = run_sweep(uni, warmup=False)
+        rungs = []
+        for i, tail in enumerate(tails):
+            rungs.append({
+                "size_tail": tail,
+                "delivered_events_per_sim_s": round(float(
+                    rep.metrics["delivered_events_per_sim_s"][i]), 3),
+                "window_overflow": int(
+                    rep.metrics["window_overflow"][i]),
+                "events_quiesced": int(
+                    rep.metrics["events_quiesced"][i]),
+            })
+        adv[pol] = {"rungs": rungs, "wall_s": round(rep.wall_s, 2)}
+    out["streaming_adversarial"] = {
+        "backlog": _STREAM_WORK["window"],
+        "hotspot": 0.5,
+        "offered_rate_events_per_tick": 0.3,
+        "policies": adv,
+    }
     return out
 
 

@@ -29,6 +29,12 @@ from consul_tpu.version import __version__
 
 DEFAULT_HTTP = "127.0.0.1:8500"
 
+# Streamcast chunk-selection policies for `cli sim --policy`.  A
+# LITERAL twin of consul_tpu.streamcast.model.POLICIES — the parser
+# must build without importing the JAX-heavy sim tree — pinned equal
+# in tests/test_streamcast.py so the copies cannot drift.
+SIM_POLICY_CHOICES = ("uniform", "pipeline", "rarest")
+
 
 def main(argv: Optional[list[str]] = None) -> int:
     parser = build_parser()
@@ -305,6 +311,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "seam on (consul_tpu/obs) and print the "
                          "bridged /v1/agent/metrics-shaped snapshot "
                          "under \"metrics\"")
+    sp.add_argument("--policy", default="",
+                    choices=("",) + SIM_POLICY_CHOICES,
+                    help="chunk-selection schedule of the streamcast "
+                         "plane (stream100k only; other presets "
+                         "reject it loudly): 'uniform' = random held "
+                         "chunk (the original program), 'pipeline' = "
+                         "the round-robin cursor schedule of the "
+                         "pipelined-gossiping paper, 'rarest' = "
+                         "greedy lowest-index")
 
     sp = sub.add_parser(
         "profile",
@@ -1263,7 +1278,8 @@ async def cmd_sim(args) -> int:
     out = run_scenario(args.scenario, seed=args.seed,
                        devices=args.devices or None,
                        exchange=args.exchange or None,
-                       telemetry=args.metrics)
+                       telemetry=args.metrics,
+                       policy=args.policy or None)
     print(json.dumps(out, indent=2, default=str))
     return 0
 

@@ -1388,6 +1388,9 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         StreamcastState,
         _p_live,
         arrival_arrays,
+        chunk_validity,
+        cursor_phase,
+        select_chunk,
     )
     from consul_tpu.streamcast.window import admit, retire
 
@@ -1413,23 +1416,34 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         rows_g = start + rows_l
 
         # -- 1. arrivals + window admission (replicated) -------------
-        ev_tick, ev_origin, ev_name = sched
+        ev_tick, ev_origin, ev_name, ev_chunks = sched
         arrive = ev_tick == t
         slot_event, slot_birth, filled, freed, ov, co = admit(
             st.slot_event, st.slot_birth, arrive, ev_name, t
         )
         chunks = st.chunks & ~(freed | filled)[None, :, None]
         tx_left = jnp.where((freed | filled)[None, :], 0, st.tx_left)
+        cursor = jnp.where(
+            (freed | filled)[None, :],
+            cursor_phase(rows_g, e_chunks, st.cursor.dtype)[:, None],
+            st.cursor,
+        )
         org = ev_origin[jnp.maximum(slot_event, 0)]
         seed = filled[None, :] & (rows_g[:, None] == org[None, :])
-        chunks = chunks | seed[:, :, None]
+        # Heavy-tail chunk-validity mask (replicated — a pure function
+        # of the replicated window/schedule): padding chunks born
+        # delivered on every shard's block (model.streamcast_round).
+        occ = slot_event >= 0
+        cvalid = chunk_validity(slot_event, ev_chunks, e_chunks)
+        born = occ[:, None] & ~cvalid
+        chunks = chunks | seed[:, :, None] | born[None, :, :]
         tx_left = jnp.where(seed, cfg.tx_limit, tx_left)
 
         # -- 2. transmit (owned draws: [blk, .] streams keyed by
         # global id) -------------------------------------------------
-        occ = slot_event >= 0
+        held_real = chunks & cvalid[None, :, :]
         eligible = (
-            jnp.any(chunks, axis=2) & (tx_left > 0) & occ[None, :]
+            jnp.any(held_real, axis=2) & (tx_left > 0) & occ[None, :]
         )
         prio = jnp.where(
             eligible, tx_left.astype(jnp.float32), -jnp.inf
@@ -1443,9 +1457,8 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         )
         rank = jnp.sum(ahead.astype(jnp.int32), axis=2)
         serviced = eligible & (rank < cfg.chunk_budget)
-        g = owned_uniform(k_chunk, rows_g, (w_slots, e_chunks))
-        sel = jnp.argmax(jnp.where(chunks, g, -1.0), axis=2).astype(
-            jnp.int32
+        sel, cursor = select_chunk(
+            cfg, k_chunk, rows_g, held_real, cursor, serviced
         )
         p_live = _p_live(cfg, t)
         dropped = jnp.int32(0)
@@ -1497,7 +1510,7 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         else:
             # Aggregate: the only cross-shard traffic is the [W, E]
             # per-class sender count.
-            onehot = chunks & (
+            onehot = held_real & (
                 sel[:, :, None]
                 == jnp.arange(e_chunks, dtype=jnp.int32)[None, None, :]
             )
@@ -1529,8 +1542,9 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         )
         active = jax.lax.psum(
             jnp.sum(
-                jnp.any(new_chunks, axis=2) & (tx_left > 0), axis=0,
-                dtype=jnp.int32,
+                jnp.any(new_chunks & cvalid[None, :, :], axis=2)
+                & (tx_left > 0),
+                axis=0, dtype=jnp.int32,
             ),
             NODE_AXIS,
         )
@@ -1554,6 +1568,9 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
         nxt = StreamcastState(
             chunks=new_chunks & ~cleared[None, :, None],
             tx_left=jnp.where(cleared[None, :], 0, tx_left),
+            cursor=jnp.where(
+                cleared[None, :], jnp.asarray(0, cursor.dtype), cursor
+            ),
             slot_event=jnp.where(cleared, -1, slot_event),
             slot_birth=slot_birth,
             offered=offered,
@@ -1588,6 +1605,7 @@ def _sharded_streamcast_scan(state, key: jax.Array, cfg, steps: int,
     state_spec = StreamcastState(
         chunks=P(NODE_AXIS, None, None),
         tx_left=P(NODE_AXIS, None),
+        cursor=P(NODE_AXIS, None),
         slot_event=P(),
         slot_birth=P(),
         offered=P(),

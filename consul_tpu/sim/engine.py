@@ -752,6 +752,7 @@ def run_streamcast(
     mesh=None,
     exchange: str = "alltoall",
     telemetry: bool = False,
+    policy: str = None,
 ):
     """Sustained-load streamcast study (cfg: StreamcastConfig): the
     heavy-traffic workload — a continuous chunked event stream under
@@ -759,15 +760,22 @@ def run_streamcast(
     tracked in the in-flight window.  Returns a
     :class:`consul_tpu.streamcast.StreamcastReport`.
 
-    ``mesh=`` shards the chunk planes over the device mesh
-    (parallel/shard.py; events ride the per-destination outbox seam)
-    and fills ``report.shard_overflow``; ``exchange`` picks the outbox
-    transport (see :func:`run_broadcast`).  ``state`` is donated on
-    both paths (jaxlint J3): callers pass a fresh init positionally.
+    ``policy=`` overrides the config's chunk-selection policy
+    (streamcast.model.POLICIES — validated by the config rebuild, so a
+    typo fails loudly before tracing); the policy is trace-time static
+    and lands one jit-cache entry per value, exactly like the config
+    field it replaces.  ``mesh=`` shards the chunk planes over the
+    device mesh (parallel/shard.py; events ride the per-destination
+    outbox seam) and fills ``report.shard_overflow``; ``exchange``
+    picks the outbox transport (see :func:`run_broadcast`).  ``state``
+    is donated on both paths (jaxlint J3): callers pass a fresh init
+    positionally.
     """
     from consul_tpu.streamcast.model import streamcast_init
     from consul_tpu.streamcast.report import StreamcastReport
 
+    if policy is not None and policy != cfg.policy:
+        cfg = dataclasses.replace(cfg, policy=policy)
     _check_exchange(exchange, mesh)
     key = jax.random.PRNGKey(seed)
     if mesh is not None:
@@ -811,6 +819,7 @@ def run_streamcast(
         coalesced=np.asarray(coalesced),
         sent=np.asarray(sent),
         wall_s=wall,
+        policy=cfg.policy,
         shard_overflow=shard_ov,
         **_trace_fields("streamcast", trace),
     )
@@ -1131,6 +1140,10 @@ def _streamcast_bounds(cfg):
         return (StreamcastState(
             chunks=Bound(0, 1),
             tx_left=z,
+            # Chunk cursor: stores (sel + 1) % E (pipeline) or the
+            # uncapped sel + 1 (rarest's cycle-spent park), so the
+            # int8/int16 narrowing certificate is [0, E].
+            cursor=z,
             slot_event=Bound(-1, -1),
             slot_birth=z,
             offered=z, delivered=z, quiesced=z,
@@ -1393,6 +1406,29 @@ def jaxlint_registry(include=("small", "big"),
             lambda: streamcast_init(stcfg),
             lambda s, k: streamcast_scan(s, k, stcfg, 8), stcfg.n,
             bounds=_streamcast_bounds(stcfg))
+        # Selection-policy twins: the policy is trace-time static, so
+        # each non-uniform policy is a DISTINCT program (the pipeline
+        # twin carries the int8 cursor arithmetic rangelint certifies)
+        # — both under every zero-findings gate, unsharded + sharded.
+        for pol in ("pipeline", "rarest"):
+            stcfg_p = dataclasses.replace(stcfg, policy=pol)
+            add(f"streamcast@small/{pol}", "streamcast_scan",
+                lambda c=stcfg_p: streamcast_init(c),
+                lambda s, k, c=stcfg_p: streamcast_scan(s, k, c, 8),
+                stcfg.n, bounds=_streamcast_bounds(stcfg_p))
+            for d in sharded_devices:
+                add_sharded_streamcast(f"small/{pol}", d, stcfg_p, 8)
+        # Adversarial-load twin (sim/load.py): standing backlog +
+        # heavy-tailed sizes + hotspot origins — the born-delivered
+        # chunk-mask and backlog-pinning paths under the gates.
+        stcfg_adv = dataclasses.replace(
+            stcfg, backlog=4, size_tail=1.0, hotspot=0.5,
+            policy="pipeline",
+        )
+        add("streamcast@small/adversarial", "streamcast_scan",
+            lambda: streamcast_init(stcfg_adv),
+            lambda s, k: streamcast_scan(s, k, stcfg_adv, 8),
+            stcfg.n, bounds=_streamcast_bounds(stcfg_adv))
         gecfg = GeoConfig(n=64, segments=8, bridges_per_segment=2,
                           events=4, wan_window=4, wan_msg_bytes=100,
                           wan_capacity_bytes=800.0,
@@ -1671,6 +1707,17 @@ def jaxlint_registry(include=("small", "big"),
         for model, cfg, steps, knobs, track, n in sw_small:
             for u in (1, 8):
                 add_sweep("small", model, cfg, steps, u, knobs, track, n)
+        # Policy twins of the batched streamcast plane: policy is
+        # static under the sweep too (one cached program per policy ×
+        # U), so the policy × offered-load grid is <= 3 vmapped
+        # programs — pinned under the gates at U in {1, 8}.
+        st_row = next(r for r in sw_small if r[0] == "streamcast")
+        for pol in ("pipeline", "rarest"):
+            _, st_cfg, st_steps, st_knobs, st_track, st_n = st_row
+            pcfg = dataclasses.replace(st_cfg, policy=pol)
+            for u in (1, 8):
+                add_sweep(f"small/{pol}", "streamcast", pcfg, st_steps,
+                          u, st_knobs, st_track, st_n)
         # Batched telemetry twin: the [U, steps, M] trace plane under
         # the zero-findings gates (one model suffices — the obs seam
         # is shared by every vmapped impl).

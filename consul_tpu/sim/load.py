@@ -1,0 +1,114 @@
+"""Adversarial offered-load generators for the streaming planes.
+
+Production event traffic is not a well-behaved homogeneous Poisson
+stream: queues wake up with work already in them, payload sizes are
+heavy-tailed, and publishers cluster on a handful of hot nodes.  This
+module holds the pure schedule-shaping primitives that turn a clean
+synthetic arrival schedule into those regimes — each one a pure
+``jnp`` function of (key, schedule arrays, severity scalar), so a
+severity can ride as a TRACED per-universe knob (consul_tpu/sweep)
+exactly like the fault severities in :mod:`consul_tpu.sim.faults`:
+
+  standing_backlog   pin the first B arrivals to tick 0 — the window
+                     starts the run already holding work (the
+                     bufferbloat regime: sustained load measured
+                     against a queue that never drained).
+  paced_ticks        staggered (constant-interval) birth ticks at the
+                     same mean rate as the Poisson stream — the
+                     deterministic offered load that measures a
+                     capacity knee without Poisson burst noise.
+  heavy_tail_sizes   per-event chunk counts from a Pareto(tail) draw
+                     over [1, E]: mostly small events with occasional
+                     full-width ones — ``tail`` is the Pareto tail
+                     index (smaller = heavier); 0 disables (every
+                     event uses all E chunks, the exactness default).
+  hotspot_origins    re-originate a ``frac`` of the arrivals at one
+                     hot node — the all-events-from-one-DC pattern the
+                     geo bench showed is the hard case; 0 disables.
+
+The disable values (backlog=0, tail=0.0, frac=0.0) are exact no-ops on
+the schedule ARRAYS: the consuming program stays bit-equal to the
+clean-stream program (the streamcast ``policy="uniform"`` exactness
+discipline rides through these generators untouched).  Severity draws
+come from the caller's salted keys, never from the gap/origin/name
+streams, so enabling one regime never reshuffles another.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def standing_backlog(ev_tick: jax.Array, backlog: int) -> jax.Array:
+    """Pin the first ``backlog`` schedule entries to tick 0.
+
+    The remaining arrivals keep their staggered birth ticks, so the
+    stream is "B events already in flight at t=0, then the ongoing
+    arrival process" — a window that starts full instead of filling
+    gradually.  ``backlog`` is static schedule structure (it decides
+    WHICH entries move, not a rate); a backlog wider than the window
+    overflows loudly at tick 0, never silently.
+    """
+    if backlog <= 0:
+        return ev_tick
+    k = ev_tick.shape[0]
+    idx = jnp.arange(k, dtype=jnp.int32)
+    return jnp.where(idx < backlog, 0, ev_tick)
+
+
+def paced_ticks(k: int, rate) -> jax.Array:
+    """int32[k] staggered birth ticks: event i is born at
+    ``floor(i / rate)`` — one event every ``1/rate`` ticks, the same
+    mean offered load as the Poisson stream but with ZERO burst
+    variance.  A window overflows under this stream iff
+    ``rate x slot lifetime`` really exceeds W (the deterministic
+    capacity knee); under Poisson arrivals the same knee is smeared by
+    burst noise.  ``rate`` enters as ordinary jnp arithmetic
+    (sweepable), exactly like the Poisson gap derivation."""
+    rate_f = jnp.maximum(jnp.asarray(rate, jnp.float32), 1e-6)
+    idx = jnp.arange(k, dtype=jnp.float32)
+    return jnp.floor(idx / rate_f).astype(jnp.int32)
+
+
+def heavy_tail_sizes(key: jax.Array, k: int, e_max: int,
+                     tail) -> jax.Array:
+    """int32[k] per-event chunk counts in [1, e_max].
+
+    ``tail`` > 0 draws Pareto(x_min=1, index=tail) sizes clipped to
+    the static E ceiling — P(size >= s) = s**-tail, so tail=1 gives
+    the classic mostly-1-chunk stream with occasional full-payload
+    events.  ``tail`` enters as ordinary jnp arithmetic (sweepable);
+    tail=0 returns every event at the full ``e_max`` — the exactness
+    default, where the chunk-validity mask is all-True and the
+    consuming program is bit-equal to the unmasked one.
+    """
+    u = jax.random.uniform(
+        key, (k,), jnp.float32, minval=1e-7, maxval=1.0
+    )
+    tail_f = jnp.asarray(tail, jnp.float32)
+    alpha = jnp.maximum(tail_f, 1e-6)
+    # floor, not ceil: P(size >= s) = s**-tail exactly on the integer
+    # support (ceil would map the whole (1, 2] mass to 2 and leave
+    # P(size = 1) = 0 — no head, which defeats "mostly small").
+    pareto = jnp.clip(
+        jnp.floor(u ** (-1.0 / alpha)), 1.0, float(e_max)
+    ).astype(jnp.int32)
+    return jnp.where(tail_f > 0.0, pareto, jnp.int32(e_max))
+
+
+def hotspot_origins(key: jax.Array, ev_origin: jax.Array, frac,
+                    node: int) -> jax.Array:
+    """Re-originate a ``frac`` of the arrivals at the hot ``node``.
+
+    Each event independently publishes from ``node`` with probability
+    ``frac`` (sweepable: it enters only as a comparison threshold);
+    frac=0 keeps every origin untouched — including the draw itself,
+    whose key is salted off the arrival stream, so the clean program
+    never sees reshuffled origins.
+    """
+    u = jax.random.uniform(key, ev_origin.shape, jnp.float32)
+    return jnp.where(
+        u < jnp.asarray(frac, jnp.float32),
+        jnp.int32(node), ev_origin,
+    )

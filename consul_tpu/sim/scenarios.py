@@ -142,7 +142,8 @@ def event100k(seed: int = 0, devices: int = None,
 
 def stream100k(seed: int = 0, n: int = 100_000, steps: int = 150,
                devices: int = None, exchange: str = "alltoall",
-               telemetry: bool = False) -> dict:
+               telemetry: bool = False,
+               policy: str = "uniform") -> dict:
     """Sustained event stream at 100k nodes: Poisson arrivals of
     4-chunk events pipelined through an 8-slot window under a fixed
     2-slot/round budget (consul_tpu/streamcast) — the heavy-traffic
@@ -150,11 +151,15 @@ def stream100k(seed: int = 0, n: int = 100_000, steps: int = 150,
     offered load with t50/t99 delivery quantiles and the
     window-overflow saturation signal.
 
-    ``devices`` shards the chunk planes over the first D devices
-    (``cli sim stream100k --devices D``) — chunk messages ride the
-    per-destination outbox, budget misses reported as shard_overflow;
-    ``exchange`` picks the transport (``--exchange ring`` = the Pallas
-    DMA kernel).  ``n``/``steps`` scale down for CPU smoke runs."""
+    ``policy`` picks the chunk-selection schedule (``cli sim
+    stream100k --policy {uniform,pipeline,rarest}``; streamcast.model
+    POLICIES — a typo fails loudly at config construction) and is
+    echoed in the summary.  ``devices`` shards the chunk planes over
+    the first D devices (``cli sim stream100k --devices D``) — chunk
+    messages ride the per-destination outbox, budget misses reported
+    as shard_overflow; ``exchange`` picks the transport (``--exchange
+    ring`` = the Pallas DMA kernel).  ``n``/``steps`` scale down for
+    CPU smoke runs."""
     from consul_tpu.parallel import mesh_for
     from consul_tpu.sim.engine import run_streamcast
     from consul_tpu.streamcast import StreamcastConfig
@@ -163,7 +168,7 @@ def stream100k(seed: int = 0, n: int = 100_000, steps: int = 150,
     cfg = StreamcastConfig(
         n=n, events=int(rate * steps * 1.5), chunks=4, window=8,
         fanout=4, chunk_budget=2, rate=rate, names=16, loss=0.05,
-        profile=LAN, done_frac=0.999,
+        profile=LAN, done_frac=0.999, policy=policy,
         delivery="edges" if devices else "aggregate",
     )
     rep = run_streamcast(cfg, steps=steps, seed=seed, warmup=False,
@@ -344,7 +349,8 @@ SCENARIOS: dict[str, Callable[..., dict]] = {
 
 
 def run_scenario(name: str, seed: int = 0, devices: int = None,
-                 exchange: str = None, telemetry: bool = False) -> dict:
+                 exchange: str = None, telemetry: bool = False,
+                 policy: str = None) -> dict:
     """Run a preset by name.  ``devices`` shards the node axis over the
     first D mesh devices for the scenarios that support it (probe1k,
     event100k, stream100k, geo100k); asking it of any other preset is an error,
@@ -353,7 +359,10 @@ def run_scenario(name: str, seed: int = 0, devices: int = None,
     loud-never-silent contract.  ``telemetry`` runs the study with the
     in-scan metrics seam on (consul_tpu/obs) and adds the bridged
     /v1/agent/metrics-shaped snapshot under ``"metrics"`` (``cli sim
-    --metrics``); presets without the seam reject it loudly too."""
+    --metrics``); presets without the seam reject it loudly too.
+    ``policy`` picks the streamcast chunk-selection schedule (``cli
+    sim stream100k --policy``); presets without the selection-policy
+    seam reject it loudly — never a silently-ignored flag."""
     import inspect
 
     try:
@@ -372,7 +381,13 @@ def run_scenario(name: str, seed: int = 0, devices: int = None,
         raise ValueError(
             f"scenario {name!r} does not support --metrics"
         )
+    if policy and "policy" not in params:
+        raise ValueError(
+            f"scenario {name!r} does not support --policy (the "
+            "chunk-selection seam belongs to the streamcast plane)"
+        )
     tele_kw = {"telemetry": True} if telemetry else {}
+    pol_kw = {"policy": policy} if policy else {}
     if devices:
         if "devices" not in params:
             raise ValueError(
@@ -380,5 +395,5 @@ def run_scenario(name: str, seed: int = 0, devices: int = None,
             )
         return fn(seed=seed, devices=devices,
                   **({"exchange": exchange} if exchange else {}),
-                  **tele_kw)
-    return fn(seed=seed, **tele_kw)
+                  **tele_kw, **pol_kw)
+    return fn(seed=seed, **tele_kw, **pol_kw)

@@ -16,9 +16,12 @@ Entry points: ``streamcast_scan`` / ``run_streamcast`` in
 """
 
 from consul_tpu.streamcast.model import (
+    POLICIES,
     StreamcastConfig,
     StreamcastState,
     arrival_arrays,
+    chunk_validity,
+    select_chunk,
     streamcast_init,
     streamcast_round,
 )
@@ -30,10 +33,13 @@ from consul_tpu.streamcast.report import (
 from consul_tpu.streamcast.window import admit, retire
 
 __all__ = [
+    "POLICIES",
     "StreamcastConfig",
     "StreamcastState",
     "StreamcastReport",
     "arrival_arrays",
+    "chunk_validity",
+    "select_chunk",
     "streamcast_init",
     "streamcast_round",
     "per_event_latency",

@@ -17,14 +17,29 @@ Gossiping" (PAPERS.md):
     copies REGARDLESS of how many events are in flight — the
     constant-bandwidth property the paper's pipeline exists for.
 
+WHICH held chunk a serviced slot pushes is the selection-policy seam
+(``policy`` on the config, :func:`select_chunk`): ``uniform`` re-draws
+a random held chunk each round (the original program), ``pipeline``
+cycles a per-(node, slot) cursor through the held chunks — the
+paper's round-robin schedule, which exists precisely because uniform
+re-drawing wastes the fixed budget on duplicate chunks — and
+``rarest`` greedily drains the lowest-index held chunk.  The policy is
+trace-time static: one compiled program per policy, every knob under
+it still traced.
+
 Arrivals are a static-capacity schedule of K events (explicit
 ``schedule`` tuples, or Poisson at ``rate`` events/tick — the offered
 load); events carry a ``name`` for Lamport coalescing (a newer event
 supersedes an older same-name one mid-flight, the latest-state rule of
-eventing/coalesce.py).  Window overflow — an arrival that finds no
-free slot — is DROPPED AND COUNTED, never silent: the same accounting
-contract as the sharded outbox budget, and the saturation signal the
-bench throughput curve reads its knee from.
+eventing/coalesce.py).  The offered stream can be made ADVERSARIAL
+without leaving the one-program discipline (sim/load.py): a standing
+``backlog`` pinned to tick 0, heavy-tailed per-event chunk counts
+(``size_tail`` — masked chunks over the static E ceiling are born
+delivered), and a ``hotspot`` origin concentration.  Window overflow
+— an arrival that finds no free slot — is DROPPED AND COUNTED, never
+silent: the same accounting contract as the sharded outbox budget,
+and the saturation signal the bench throughput curve reads its knee
+from.
 
 Degenerate contract: at ``window=1, chunks=1`` with a single scheduled
 event, one round of this model consumes the SAME RNG stream and
@@ -56,6 +71,45 @@ from consul_tpu.streamcast.window import admit, retire
 # with a round's key stream.
 _AUX_SALT = 0x73C00000
 _SCHED_SALT = 0x73C00001
+# Adversarial-load salts, folded off the SCHEDULE key inside
+# arrival_arrays: the heavy-tail size and hotspot-origin draws live on
+# their own streams, so enabling one regime never reshuffles the
+# gap/origin/name draws of the clean stream (sim/load.py).
+_SIZE_SALT = 0x73C00002
+_HOT_SALT = 0x73C00003
+
+#: Chunk/slot selection policies (the ``StreamcastConfig.policy``
+#: seam).  ``uniform`` re-draws a uniformly-random held chunk per
+#: serviced slot (the original program, bit-equal pinned);
+#: ``pipeline`` is the round-robin schedule of "The Algorithm of
+#: Pipelined Gossiping" — a per-(node, slot) cursor cycles the held
+#: chunks so budget is never wasted re-drawing duplicates; ``rarest``
+#: is the cheap greedy twin — the lowest-index held chunk not yet
+#: pushed this cycle (same cursor plane, index-biased order, no
+#: randomness).
+POLICIES = ("uniform", "pipeline", "rarest")
+
+
+def cursor_dtype(chunks: int):
+    """Narrowest signed dtype that holds a chunk cursor in
+    [0, chunks] — closed: the rarest policy parks the cursor AT
+    ``chunks`` to mean "cycle spent, wrap on next service" — int8 up
+    to 127 chunks (rangelint-certified), int16 beyond."""
+    return jnp.int8 if chunks <= 127 else jnp.int16
+
+
+def cursor_phase(rows: jax.Array, e_chunks: int, dtype) -> jax.Array:
+    """Per-node starting cursor at slot fill: ``global_id % E``.
+
+    Resetting every node's cursor to 0 would SYNCHRONIZE the
+    round-robin — the population pushes the same chunk in near-
+    lockstep waves, and a receiver missing one chunk waits up to a
+    full E-round wave period for it to come around.  A per-node phase
+    offset keyed by global id desynchronizes the cycle: every round
+    carries a balanced ~1/E mix of all chunks, so the last-chunk tail
+    sees constant intensity instead of periodic bursts.  Global ids
+    (not block-local rows) keep the sharded twin bit-equal at D=1."""
+    return (rows % e_chunks).astype(dtype)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,10 +126,24 @@ class StreamcastConfig:
     Poisson event names from [0, names) so same-name supersede
     pressure exists; 0 keeps every event distinct.
 
-    ``rate``, ``loss`` and ``chunk_budget`` are rate-like knobs (the
-    sweep plane vmaps them; ``chunk_budget`` only ever enters as a
-    rank comparison, never a shape).  ``window``/``chunks``/``events``
-    feed array shapes and stay static.
+    ``rate``, ``loss``, ``chunk_budget``, ``size_tail`` and
+    ``hotspot`` are rate-like knobs (the sweep plane vmaps them;
+    ``chunk_budget`` only ever enters as a rank comparison, never a
+    shape).  ``window``/``chunks``/``events``/``backlog``/``policy``
+    feed array shapes or trace-time structure and stay static.
+
+    ``policy`` picks the chunk/slot selection schedule (POLICIES):
+    ``uniform`` (default) is the original uniformly-random held-chunk
+    draw — BIT-EQUAL to the pre-policy program; ``pipeline`` is the
+    paper's round-robin cursor schedule; ``rarest`` the greedy
+    lowest-index twin.  The adversarial-load knobs (sim/load.py):
+    ``backlog`` pins the first B Poisson arrivals to tick 0 (a window
+    that starts full), ``size_tail`` > 0 draws heavy-tailed per-event
+    chunk counts over the static E ceiling (masked chunks are born
+    delivered), ``hotspot``/``hotspot_node`` re-originate a fraction
+    of arrivals at one hot node.  Scheduled mode expresses all three
+    explicitly (tick-0 entries, 4-tuple chunk counts, repeated
+    origins), so combining them with ``schedule`` is rejected loudly.
 
     ``faults`` supports loss ramps only (extra packet loss over time);
     the node-level primitives (partitions, degraded sets, churn) model
@@ -92,8 +160,14 @@ class StreamcastConfig:
     retransmit_mult: int | None = None
     loss: float = 0.0
     rate: float = 0.0               # Poisson offered load, events/tick
-    schedule: tuple = ()            # ((tick, origin, name), ...)
+    schedule: tuple = ()            # ((tick, origin, name[, chunks]), ...)
     names: int = 0                  # Poisson name-space size (0 = unnamed)
+    policy: str = "uniform"         # chunk selection schedule (POLICIES)
+    arrivals: str = "poisson"       # Poisson gaps | "paced" stagger
+    backlog: int = 0                # arrivals pre-pinned to tick 0
+    size_tail: float = 0.0          # Pareto tail index of event sizes
+    hotspot: float = 0.0            # fraction re-originated at the hot node
+    hotspot_node: int = 0
     # Delivery fraction at which an event counts as delivered and its
     # slot retires: 1.0 (default) is the exactness contract (every
     # node, the broadcast-pin semantics); large-n sustained-load
@@ -127,6 +201,34 @@ class StreamcastConfig:
             raise ValueError(
                 f"chunk_budget={self.chunk_budget} must be >= 1"
             )
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"policy={self.policy!r} is not a chunk-selection "
+                f"policy; choose from {POLICIES}"
+            )
+        if self.arrivals not in ("poisson", "paced"):
+            raise ValueError(
+                f"arrivals={self.arrivals!r} is not an arrival "
+                "process; choose 'poisson' (exponential gaps) or "
+                "'paced' (constant-interval stagger, the "
+                "deterministic capacity-knee stream)"
+            )
+        if self.backlog < 0:
+            raise ValueError(f"backlog={self.backlog} must be >= 0")
+        if _concrete(self.size_tail) and self.size_tail < 0.0:
+            raise ValueError(
+                f"size_tail={self.size_tail} must be >= 0 (a Pareto "
+                "tail index; 0 disables heavy-tailed sizes)"
+            )
+        if _concrete(self.hotspot) and not 0.0 <= self.hotspot <= 1.0:
+            raise ValueError(
+                f"hotspot={self.hotspot} outside [0, 1]"
+            )
+        if not 0 <= self.hotspot_node < self.n:
+            raise ValueError(
+                f"hotspot_node={self.hotspot_node} outside "
+                f"[0, {self.n})"
+            )
         if not 0.0 < self.done_frac <= 1.0:
             raise ValueError(
                 f"done_frac={self.done_frac} outside (0, 1]"
@@ -152,14 +254,37 @@ class StreamcastConfig:
                     f"len(schedule)={len(self.schedule)}; omit events "
                     "in scheduled mode"
                 )
+            adversarial = (
+                ("backlog", self.backlog),
+                ("arrivals", self.arrivals != "poisson"),
+                ("size_tail", self.size_tail
+                 if _concrete(self.size_tail) else 1),
+                ("hotspot", self.hotspot
+                 if _concrete(self.hotspot) else 1),
+            )
+            for knob, val in adversarial:
+                if val:
+                    raise ValueError(
+                        f"{knob}= shapes the POISSON arrival stream; "
+                        "a scheduled stream expresses it explicitly "
+                        "(tick-0 entries for backlog, 4-tuple chunk "
+                        "counts for sizes, repeated origins for the "
+                        "hotspot)"
+                    )
             last = None
             for entry in self.schedule:
-                if len(entry) != 3:
+                if len(entry) not in (3, 4):
                     raise ValueError(
                         f"schedule entries are (tick, origin, name) "
-                        f"3-tuples, got {entry!r}"
+                        f"3-tuples or (tick, origin, name, chunks) "
+                        f"4-tuples, got {entry!r}"
                     )
-                tick, origin, _name = entry
+                tick, origin, _name = entry[:3]
+                if len(entry) == 4 and not 1 <= entry[3] <= self.chunks:
+                    raise ValueError(
+                        f"schedule chunk count {entry[3]} outside "
+                        f"[1, chunks={self.chunks}]"
+                    )
                 if tick < 0:
                     raise ValueError(f"schedule tick {tick} < 0")
                 if last is not None and tick < last:
@@ -183,6 +308,12 @@ class StreamcastConfig:
                     "Poisson mode needs events=K (static schedule "
                     "capacity; size it to cover rate x steps with "
                     "headroom)"
+                )
+            if self.backlog > self.events:
+                raise ValueError(
+                    f"backlog={self.backlog} exceeds the schedule "
+                    f"capacity events={self.events} — the standing "
+                    "backlog is a prefix of the K arrivals"
                 )
 
     @property
@@ -214,6 +345,7 @@ class StreamcastConfig:
 class StreamcastState(NamedTuple):
     chunks: jax.Array           # bool[n, W, E] — chunk c of slot w held
     tx_left: jax.Array          # int32[n, W] — per-slot transmit budget
+    cursor: jax.Array           # int8/16[n, W] — pipeline chunk cursor
     slot_event: jax.Array       # int32[W] — global event id, -1 free
     slot_birth: jax.Array       # int32[W] — arrival tick of the occupant
     offered: jax.Array          # int32 — arrivals seen (admitted or not)
@@ -229,6 +361,7 @@ def streamcast_init(cfg: StreamcastConfig) -> StreamcastState:
     return StreamcastState(
         chunks=jnp.zeros((n, w, e), jnp.bool_),
         tx_left=jnp.zeros((n, w), jnp.int32),
+        cursor=jnp.zeros((n, w), cursor_dtype(e)),
         slot_event=jnp.full((w,), -1, jnp.int32),
         slot_birth=jnp.zeros((w,), jnp.int32),
         offered=jnp.int32(0),
@@ -241,32 +374,61 @@ def streamcast_init(cfg: StreamcastConfig) -> StreamcastState:
 
 
 def arrival_arrays(cfg: StreamcastConfig, key: jax.Array):
-    """``(ev_tick, ev_origin, ev_name)`` int32[K] — the arrival
-    schedule as device arrays.
+    """``(ev_tick, ev_origin, ev_name, ev_chunks)`` int32[K] — the
+    arrival schedule as device arrays.
 
     Scheduled mode folds the host tuples in (validated at config
-    construction); Poisson mode derives inter-arrival gaps from
-    ``key`` with ``rate`` as ordinary jnp arithmetic, so the offered
-    load is sweepable as a traced per-universe knob (consul_tpu/sweep)
-    — per-universe keys then give per-universe schedules."""
+    construction; 3-tuples default the chunk count to the full E);
+    Poisson mode derives inter-arrival gaps from ``key`` with ``rate``
+    as ordinary jnp arithmetic, so the offered load is sweepable as a
+    traced per-universe knob (consul_tpu/sweep) — per-universe keys
+    then give per-universe schedules.  The adversarial regimes
+    (sim/load.py) shape the Poisson stream here: ``backlog`` pins the
+    leading arrivals to tick 0, ``size_tail`` draws heavy-tailed
+    per-event chunk counts, ``hotspot`` re-originates arrivals at the
+    hot node — each on a salted stream of its own, so the clean-knob
+    program (backlog=0, size_tail=0, hotspot=0) is bit-equal to the
+    pre-adversarial one."""
+    from consul_tpu.sim.load import (
+        heavy_tail_sizes,
+        hotspot_origins,
+        paced_ticks,
+        standing_backlog,
+    )
+
     k = cfg.k_events
     if cfg.schedule:
         ev_tick = jnp.asarray(
-            [t for t, _, _ in cfg.schedule], jnp.int32
+            [e[0] for e in cfg.schedule], jnp.int32
         )
         ev_origin = jnp.asarray(
-            [o for _, o, _ in cfg.schedule], jnp.int32
+            [e[1] for e in cfg.schedule], jnp.int32
         )
         ev_name = jnp.asarray(
-            [m for _, _, m in cfg.schedule], jnp.int32
+            [e[2] for e in cfg.schedule], jnp.int32
         )
-        return ev_tick, ev_origin, ev_name
+        ev_chunks = jnp.asarray(
+            [e[3] if len(e) == 4 else cfg.chunks
+             for e in cfg.schedule], jnp.int32
+        )
+        return ev_tick, ev_origin, ev_name, ev_chunks
     k_gap, k_org, k_name = jax.random.split(key, 3)
     rate = jnp.maximum(jnp.asarray(cfg.rate, jnp.float32), 1e-6)
-    gaps = jax.random.exponential(k_gap, (k,)) / rate
-    ev_tick = jnp.floor(jnp.cumsum(gaps)).astype(jnp.int32)
+    if cfg.arrivals == "paced":
+        # Staggered birth at the same mean rate: k_gap stays split so
+        # origins/names/sizes are IDENTICAL to the Poisson stream's —
+        # the two arrival processes differ only in timing.
+        ev_tick = paced_ticks(k, rate)
+    else:
+        gaps = jax.random.exponential(k_gap, (k,)) / rate
+        ev_tick = jnp.floor(jnp.cumsum(gaps)).astype(jnp.int32)
+    ev_tick = standing_backlog(ev_tick, cfg.backlog)
     ev_origin = jax.random.randint(
         k_org, (k,), 0, cfg.n, dtype=jnp.int32
+    )
+    ev_origin = hotspot_origins(
+        jax.random.fold_in(key, _HOT_SALT), ev_origin,
+        cfg.hotspot, cfg.hotspot_node,
     )
     if cfg.names > 0:
         ev_name = jax.random.randint(
@@ -274,7 +436,11 @@ def arrival_arrays(cfg: StreamcastConfig, key: jax.Array):
         )
     else:
         ev_name = jnp.full((k,), -1, jnp.int32)
-    return ev_tick, ev_origin, ev_name
+    ev_chunks = heavy_tail_sizes(
+        jax.random.fold_in(key, _SIZE_SALT), k, cfg.chunks,
+        cfg.size_tail,
+    )
+    return ev_tick, ev_origin, ev_name, ev_chunks
 
 
 def _p_live(cfg: StreamcastConfig, tick: jax.Array):
@@ -287,6 +453,89 @@ def _p_live(cfg: StreamcastConfig, tick: jax.Array):
             1.0 - extra_loss_at(cfg.faults, tick)
         )
     return 1.0 - cfg.loss
+
+
+def chunk_validity(slot_event: jax.Array, ev_chunks: jax.Array,
+                   e_chunks: int) -> jax.Array:
+    """bool[W, E] — the REAL chunks of each slot's occupant: chunk c
+    is real iff ``c < ev_chunks[occupant]``.  Chunks at or past the
+    occupant's count are the heavy-tail padding over the static E
+    ceiling — born delivered at every node, never selected, never
+    counted toward completion beyond their birth truth.  Free slots
+    read event 0's count; every consumer is occupancy-gated."""
+    nch = ev_chunks[jnp.maximum(slot_event, 0)]
+    return (
+        jnp.arange(e_chunks, dtype=jnp.int32)[None, :] < nch[:, None]
+    )
+
+
+def select_chunk(cfg: StreamcastConfig, k_chunk: jax.Array,
+                 rows: jax.Array, held_real: jax.Array,
+                 cursor: jax.Array, serviced: jax.Array):
+    """The policy seam: which held chunk does a serviced slot push?
+
+    ``held_real`` bool[rows, W, E] (held AND real under the validity
+    mask), ``cursor`` int8/16[rows, W], ``serviced`` bool[rows, W].
+    Returns ``(sel, next_cursor)`` — ``sel`` int32[rows, W] always
+    indexes a held real chunk wherever any exists (consumers gate on
+    ``serviced``, a subset of eligibility).
+
+      uniform    argmax of a fresh per-(node, slot) uniform draw over
+                 the held chunks — the original program; the ONLY
+                 policy that consumes ``k_chunk``, so its RNG stream
+                 stays bit-identical to the pre-policy plane.
+      pipeline   the round-robin schedule of "The Algorithm of
+                 Pipelined Gossiping": the held chunk at the smallest
+                 cyclic distance from the cursor, cursor advanced past
+                 it on service — a node cycles its held chunks instead
+                 of re-drawing duplicates, so all E chunks of a slot
+                 flow within E serviced rounds (uniform needs
+                 ~E·H(E) by coupon collection).
+      rarest     the greedy lowest-index twin: the lowest-index held
+                 chunk NOT yet pushed this cycle (the cursor is the
+                 first index not yet pushed; a wrap restarts at the
+                 lowest held index) — chunk waves drain biased toward
+                 low indices, no randomness.  A memoryless
+                 "lowest-index held" greedy would be DEGENERATE: the
+                 origin would push chunk 0 until its budget died and
+                 chunks 1..E-1 would never leave it — the cycle
+                 memory is what makes the greedy livable, and the
+                 same cursor plane provides it for free.
+    """
+    e_chunks = held_real.shape[2]
+    if cfg.policy == "uniform":
+        g = owned_uniform(
+            k_chunk, rows, (held_real.shape[1], e_chunks)
+        )
+        sel = jnp.argmax(
+            jnp.where(held_real, g, -1.0), axis=2
+        ).astype(jnp.int32)
+        return sel, cursor
+    cidx = jnp.arange(e_chunks, dtype=jnp.int32)
+    cur = cursor.astype(jnp.int32)[:, :, None]
+    if cfg.policy == "pipeline":
+        dist = jnp.mod(cidx[None, None, :] - cur, e_chunks)
+        sel = jnp.argmin(
+            jnp.where(held_real, dist, e_chunks), axis=2
+        ).astype(jnp.int32)
+        nxt = jnp.where(
+            serviced, (sel + 1) % e_chunks,
+            cursor.astype(jnp.int32),
+        )
+        return sel, nxt.astype(cursor.dtype)
+    # rarest: lowest held index >= cursor; wrapped candidates rank
+    # after un-wrapped ones but still by index (the low-index bias).
+    score = jnp.where(
+        held_real & (cidx[None, None, :] >= cur),
+        cidx[None, None, :],
+        jnp.where(held_real, cidx[None, None, :] + e_chunks,
+                  2 * e_chunks),
+    )
+    sel = jnp.argmin(score, axis=2).astype(jnp.int32)
+    # Cursor = sel + 1 uncapped (range [0, E]): E means "cycle spent,
+    # wrap next service"; the fill reset re-phases it.
+    nxt = jnp.where(serviced, sel + 1, cursor.astype(jnp.int32))
+    return sel, nxt.astype(cursor.dtype)
 
 
 def streamcast_round(state: StreamcastState, key: jax.Array,
@@ -307,7 +556,7 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
     """
     n, w_slots, e_chunks = cfg.n, cfg.window, cfg.chunks
     fanout = cfg.fanout
-    ev_tick, ev_origin, ev_name = sched
+    ev_tick, ev_origin, ev_name, ev_chunks = sched
     t = state.tick
     k_sel, k_loss = jax.random.split(key)
     k_tie, k_chunk = jax.random.split(jax.random.fold_in(key, _AUX_SALT))
@@ -319,22 +568,37 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
     )
     chunks = state.chunks & ~(freed | filled)[None, :, None]
     tx_left = jnp.where((freed | filled)[None, :], 0, state.tx_left)
-    org = ev_origin[jnp.maximum(slot_event, 0)]
     rows = jnp.arange(n, dtype=jnp.int32)
+    cursor = jnp.where(
+        (freed | filled)[None, :],
+        cursor_phase(rows, e_chunks, state.cursor.dtype)[:, None],
+        state.cursor,
+    )
+    org = ev_origin[jnp.maximum(slot_event, 0)]
     seed = filled[None, :] & (rows[:, None] == org[None, :])
-    chunks = chunks | seed[:, :, None]
+    # Heavy-tail sizes: chunks past the occupant's count are born
+    # delivered at EVERY node — completion then requires only the real
+    # chunks, and the validity mask keeps them out of selection and
+    # sender eligibility below.  All-real events (the default) make
+    # ``born`` identically False.
+    occ = slot_event >= 0
+    cvalid = chunk_validity(slot_event, ev_chunks, e_chunks)
+    born = occ[:, None] & ~cvalid
+    chunks = chunks | seed[:, :, None] | born[None, :, :]
     tx_left = jnp.where(seed, cfg.tx_limit, tx_left)
 
     # -- 2. transmit under the pipelined budget ----------------------
     # A node services its top-``chunk_budget`` eligible slots (highest
-    # remaining budget, random tie-break) and pushes ONE uniformly
-    # chosen held chunk per serviced slot to ``fanout`` targets shared
-    # across slots — bandwidth <= chunk_budget * fanout copies/round
-    # however many events are in flight.  The budget enters as a rank
-    # comparison, never a shape, so it is sweepable.
-    occ = slot_event >= 0
+    # remaining budget, random tie-break) and pushes ONE held chunk
+    # per serviced slot — chosen by the selection policy seam
+    # (select_chunk: uniform draw, round-robin pipeline cursor, or
+    # greedy lowest-index) — to ``fanout`` targets shared across slots
+    # — bandwidth <= chunk_budget * fanout copies/round however many
+    # events are in flight.  The budget enters as a rank comparison,
+    # never a shape, so it is sweepable.
+    held_real = chunks & cvalid[None, :, :]
     eligible = (
-        jnp.any(chunks, axis=2) & (tx_left > 0) & occ[None, :]
+        jnp.any(held_real, axis=2) & (tx_left > 0) & occ[None, :]
     )
     prio = jnp.where(
         eligible, tx_left.astype(jnp.float32), -jnp.inf
@@ -350,9 +614,8 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
     )
     rank = jnp.sum(ahead.astype(jnp.int32), axis=2)
     serviced = eligible & (rank < cfg.chunk_budget)
-    g = owned_uniform(k_chunk, rows, (w_slots, e_chunks))
-    sel = jnp.argmax(jnp.where(chunks, g, -1.0), axis=2).astype(
-        jnp.int32
+    sel, cursor = select_chunk(
+        cfg, k_chunk, rows, held_real, cursor, serviced
     )
     p_live = _p_live(cfg, t)
 
@@ -389,7 +652,7 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
         # copies of chunk c of slot w are identical, so the per-class
         # sender count is sufficient and the network is elementwise
         # RNG (no scatter).
-        onehot = chunks & (
+        onehot = held_real & (
             sel[:, :, None]
             == jnp.arange(e_chunks, dtype=jnp.int32)[None, None, :]
         )
@@ -411,9 +674,12 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
     # -- 3. completion + retirement ----------------------------------
     full = jnp.all(new_chunks, axis=2) & occ[None, :]
     done_count = jnp.sum(full, axis=0, dtype=jnp.int32)      # [W]
+    # Active senders hold a REAL chunk: born-delivered padding must
+    # not keep a slot out of quiescence (every node "holds" it).
     active = jnp.sum(
-        jnp.any(new_chunks, axis=2) & (tx_left > 0), axis=0,
-        dtype=jnp.int32,
+        jnp.any(new_chunks & cvalid[None, :, :], axis=2)
+        & (tx_left > 0),
+        axis=0, dtype=jnp.int32,
     )
     cleared, complete, quiesced = retire(
         slot_event, done_count, active, slot_birth, t, cfg.done_target
@@ -432,6 +698,9 @@ def streamcast_round(state: StreamcastState, key: jax.Array,
     nxt = StreamcastState(
         chunks=new_chunks & ~cleared[None, :, None],
         tx_left=jnp.where(cleared[None, :], 0, tx_left),
+        cursor=jnp.where(
+            cleared[None, :], jnp.asarray(0, cursor.dtype), cursor
+        ),
         slot_event=jnp.where(cleared, -1, slot_event),
         slot_birth=slot_birth,
         offered=offered,

@@ -98,6 +98,9 @@ class StreamcastReport:
     coalesced: np.ndarray       # int32[ticks] cumulative
     sent: np.ndarray            # int32[ticks] chunk copies offered/round
     wall_s: float
+    # Chunk-selection policy of the study (model.POLICIES) — the label
+    # every per-policy curve/telemetry row carries.
+    policy: str = "uniform"
     # Sharded (shard_map) runs only: outbox budget misses —
     # see BroadcastReport.overflow.
     shard_overflow: int = None
@@ -160,6 +163,7 @@ class StreamcastReport:
             "tick_ms": self.tick_ms,
             "window": self.window,
             "chunks_per_event": self.chunks,
+            "policy": self.policy,
             "events_offered": self.offered_total,
             "events_delivered": self.delivered_total,
             "events_quiesced": int(self.quiesced[-1]),

@@ -24,7 +24,13 @@ never silently truncated —
 
 Admission order is Lamport order (ascending event id) into ascending
 free slots — deterministic, so the brute-force reference in
-tests/test_streamcast.py can replay it exactly.
+tests/test_streamcast.py can replay it exactly.  The allocator is
+SIZE-AGNOSTIC: heavy-tailed per-event chunk counts (sim/load.py,
+model.chunk_validity) shape the chunk planes and completion, never
+slot occupancy — a 1-chunk event and a full-E event cost the same
+window slot, which is exactly why a heavy-tailed stream under a
+standing backlog is an adversarial regime worth measuring rather than
+an allocator special case.
 """
 
 from __future__ import annotations

@@ -52,6 +52,13 @@ _SHAPE_FIELDS = frozenset({
     "bridges_per_segment", "indirect_checks", "udp_buffer_size",
     "event_buffer_size", "query_buffer_size", "max_user_event_size",
     "events", "chunks", "window", "names",
+    # streamcast policy seam + backlog: the selection policy and the
+    # arrival process are trace-time branches (one program per choice
+    # — sweep policy × load by building one batched program per
+    # policy, <= 3 total), the standing backlog picks WHICH schedule
+    # entries pin to tick 0 (structure, not a rate), and the hot node
+    # is a scatter target.
+    "policy", "arrivals", "backlog", "hotspot_node",
     # geo/WAN plane: the link slot planes, ring window, and queue
     # bound are all sized by these (consul_tpu/geo/model.py)
     "wan_latency_ticks", "wan_window", "wan_capacity_bytes",
@@ -260,14 +267,20 @@ SWEEP_ENTRYPOINTS: dict = {
     # offered load — per-universe arrival schedules derive from the
     # per-universe keys, so ONE batched program measures a whole
     # throughput curve; ``chunk_budget`` is the pipelined bandwidth
-    # cap (a rank comparison, never a shape).
+    # cap (a rank comparison, never a shape); ``size_tail`` and
+    # ``hotspot`` are the adversarial-load severities (sim/load.py —
+    # both enter the Poisson schedule as ordinary jnp arithmetic, so a
+    # heavy-tail or hotspot ladder is one vmapped program).  The
+    # selection ``policy`` is trace-time static — sweep policy × load
+    # as one batched program PER policy, never as a knob.
     "streamcast": _EntrypointSpec(
         name="streamcast",
         init=_streamcast_init,
         call=lambda s, k, c, steps, track, telemetry=False:
             engine._streamcast_scan(s, k, c, steps, telemetry),
         base_cfg=lambda c: c,
-        knob_paths=frozenset({"loss", "rate", "chunk_budget"}),
+        knob_paths=frozenset({"loss", "rate", "chunk_budget",
+                              "size_tail", "hotspot"}),
         aggregate_only=frozenset({"fanout"}),
         fault_paths=True,
         sharded=_sharded_streamcast,

@@ -499,6 +499,23 @@ class TestRepoGate:
                 f"sharded_streamcast@small/D{d}/ring" in small_programs
             )
 
+    def test_registry_covers_streamcast_policies(self, small_programs):
+        # The selection-policy seam: each non-uniform policy is a
+        # DISTINCT program (policy is trace-time static), so the
+        # pipeline/rarest twins — unsharded, sharded at D in {1, 2},
+        # and the batched sweep at U in {1, 8} — sit under every
+        # zero-findings gate, as does the adversarial-load twin
+        # (standing backlog + heavy-tail sizes + hotspot).
+        for pol in ("pipeline", "rarest"):
+            assert f"streamcast@small/{pol}" in small_programs
+            for d in (1, 2):
+                assert (f"sharded_streamcast@small/{pol}/D{d}"
+                        in small_programs)
+            for u in (1, 8):
+                assert (f"sweep_streamcast@small/{pol}/U{u}"
+                        in small_programs)
+        assert "streamcast@small/adversarial" in small_programs
+
     def test_registry_covers_telemetry_twins(self, small_programs):
         # The in-scan telemetry plane (consul_tpu/obs): telemetry=on
         # twins of all seven entrypoints, of the five sharded scans at

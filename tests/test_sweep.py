@@ -118,6 +118,30 @@ class TestU1BitEquality:
         assert _leaves_equal(outs, outs2), f"{model}: per-tick outputs"
         assert _leaves_equal(final, final2), f"{model}: final state"
 
+    def test_u1_pipeline_policy_bit_equal_to_unbatched(self):
+        # The policy x load acceptance ladder's U=1 rung: the batched
+        # pipeline-policy program (cursor plane in the stacked carry)
+        # reproduces the plain pipeline scan exactly — composing with
+        # the sharded D=1 pins in tests/test_streamcast.py this closes
+        # U=1 ≡ plain scan for the paper schedule.
+        import dataclasses as _dc
+
+        cfg0, init, scan, steps, _ = _SMALL["streamcast"]
+        cfg = _dc.replace(cfg0, policy="pipeline")
+        key = jax.random.PRNGKey(5)
+        final, outs = scan(init(cfg), key, cfg, steps)
+        outs = jax.tree_util.tree_map(np.asarray, outs)
+        final = jax.tree_util.tree_map(np.asarray, final)
+
+        uni = Universe(entrypoint="streamcast", cfg=cfg, steps=steps,
+                       seeds=(5,))
+        sweep = make_sweep("streamcast", 1)
+        final2, outs2 = sweep(
+            stacked_init(uni), uni.keys(), (), cfg, steps, (), (),
+        )
+        assert _leaves_equal(outs, outs2)
+        assert _leaves_equal(final, final2)
+
     def test_u1_with_knob_at_default_is_bit_equal(self):
         # The knob-rebuild path itself (traced scalar spliced into the
         # config) must not perturb the program's arithmetic: a loss
@@ -242,14 +266,22 @@ class TestKnobValidation:
         # The offered load and the pipelined bandwidth cap are the
         # streamcast tuning family; neither feeds a shape (rate is
         # jnp arithmetic in the arrival derivation, chunk_budget a
-        # rank comparison).
+        # rank comparison).  The adversarial-load severities
+        # (sim/load.py) are jnp arithmetic on the schedule too.
         cfg = _SMALL["streamcast"][0]
         self._mk(cfg, "rate", 0.5, entrypoint="streamcast")  # no raise
         self._mk(cfg, "chunk_budget", 3, entrypoint="streamcast")
+        self._mk(cfg, "size_tail", 1.0, entrypoint="streamcast")
+        self._mk(cfg, "hotspot", 0.5, entrypoint="streamcast")
 
     def test_streamcast_shape_fields_rejected(self):
+        # policy is a trace-time branch (one program per policy — the
+        # policy x load grid is one batched program PER policy, never
+        # a knob), backlog picks which schedule entries move, and the
+        # hot node is a scatter target: all structure, all refused.
         cfg = _SMALL["streamcast"][0]
-        for knob in ("window", "chunks", "events", "names"):
+        for knob in ("window", "chunks", "events", "names", "policy",
+                     "backlog", "hotspot_node"):
             with pytest.raises(ValueError,
                                match="shapes or trace-time structure"):
                 self._mk(cfg, knob, 4, entrypoint="streamcast")
@@ -539,6 +571,8 @@ class TestFaultMatrixCoverage:
             make_preset("tuning", universes=5)
         with pytest.raises(ValueError, match="grid preset"):
             make_preset("streamload", universes=5)
+        with pytest.raises(ValueError, match="grid preset"):
+            make_preset("streamadv", universes=5)
 
     def test_seed_preset_universe_override(self):
         uni = make_preset("seeds4k", universes=3)

@@ -549,6 +549,20 @@ class TestRepoGate:
             v.format() for v in violations
         )
 
+    def test_load_generators_are_covered_and_clean(self):
+        # The adversarial-load schedule shapers (sim/load.py) are
+        # traced code consumed inside every streamcast program —
+        # same by-name pin as the streamcast tree.
+        target = PKG_ROOT / "sim" / "load.py"
+        assert any(
+            target == tree or target.is_relative_to(tree)
+            for tree in LINT_TREES
+        ), "consul_tpu/sim/load.py left the linted trees"
+        violations = lint_paths([target])
+        assert violations == [], "\n".join(
+            v.format() for v in violations
+        )
+
     def test_sweep_plane_is_covered_and_clean(self):
         # The universe-sweep subsystem (vmapped batched scans + the
         # traced knob-rebuild path) is traced code; pin consul_tpu/
