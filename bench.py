@@ -1290,6 +1290,26 @@ def main() -> None:
                     ),
                 })
             out["narrowing_certificates_sparse"] = table
+
+            # equivlint (consul_tpu/analysis/equivlint.py): the
+            # exactness-ladder prover over the declared EQUIV_PAIRS.
+            # Structural-only here (witness=False): the witnessed
+            # ladder costs ~2 min of executions and has its own tier-1
+            # home (tests/test_equivlint.py); bench reports what the
+            # canonicalizer closes for free plus the trace+prove wall.
+            # Pairs live on the small tier; trace it fresh (the big
+            # traces above don't cover the pair programs).
+            from consul_tpu.analysis import equivlint as _el
+
+            t0 = _t.monotonic()
+            small = jaxlint_registry(include=("small",))
+            verdicts = _el.prove_pairs(small, witness=False)
+            out["equivlint_wall_s"] = round(_t.monotonic() - t0, 2)
+            out["equivlint_pairs"] = len(verdicts)
+            for verdict in ("PROVED", "WITNESSED", "FAILED", "SKIPPED"):
+                out[f"equivlint_{verdict.lower()}"] = sum(
+                    1 for v in verdicts if v.verdict == verdict
+                )
             return {"analysis": out}
         except Exception as e:  # noqa: BLE001 - report, keep headline
             return {"analysis_error": str(e)[:200]}

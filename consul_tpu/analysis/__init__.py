@@ -1,8 +1,8 @@
 """Correctness tooling for the simulation plane.
 
-Four complementary passes keep the "whole study = one XLA program"
-invariant (its HBM budget, and now its VALUE contracts) true as the
-codebase grows:
+Five complementary passes keep the "whole study = one XLA program"
+invariant (its HBM budget, its VALUE contracts, and its program ABI)
+true as the codebase grows:
 
 * :mod:`consul_tpu.analysis.tracelint` — an AST-based static pass (8
   rules R1-R8) that catches trace-breaking code shapes before they
@@ -22,6 +22,12 @@ codebase grows:
   key lineage, and loud-accounting (silent-drop) checks.  CLI:
   ``python -m consul_tpu.cli check`` (all three passes, one merged
   JSON) or ``python -m consul_tpu.analysis.rangelint``.
+* :mod:`consul_tpu.analysis.equivlint` — the exactness-ladder prover
+  (rules E1-E3, P1-P3): canonical-jaxpr structural proofs / cached
+  tiny-shape witnesses for every declared ``EQUIV_PAIRS`` rung, golden
+  program fingerprints (``tests/golden/programs.json``) diffed on
+  every check, and Pallas DMA-discipline rules over Mosaic kernel
+  bodies.  CLI: ``python -m consul_tpu.cli equivlint``.
 * :mod:`consul_tpu.analysis.guards` — runtime retrace counters for the
   jitted study entrypoints, surfaced to tests as
   ``@pytest.mark.single_trace``.
@@ -63,29 +69,53 @@ _EXPORTS = {
     "analyze_spec": "rangelint",
     "lint_registry": "rangelint",
     "narrowing_ledger": "rangelint",
+    "EQUIV_RULES": "equivlint",
+    "Fingerprint": "equivlint",
+    "PairVerdict": "equivlint",
+    "canonical_hash": "equivlint",
+    "canonicalize": "equivlint",
+    "diff_golden": "equivlint",
+    "fingerprint_registry": "equivlint",
+    "lint_pallas": "equivlint",
+    "prove_pairs": "equivlint",
+    "run_equivlint": "equivlint",
 }
 
 __all__ = sorted(_EXPORTS)
 
 
 def run_check(include=("small", "big"), budget_gb: float = 16.0,
-              paths=None) -> dict:
+              paths=None, changed: bool = False,
+              witness: bool = True) -> dict:
     """The ``cli check`` umbrella: tracelint (AST) + jaxlint (jaxpr
-    shapes/bytes) + rangelint (jaxpr values) in one pass, tracing each
-    registry program ONCE and sharing it between the two jaxpr passes.
+    shapes/bytes) + rangelint (jaxpr values) + equivlint (E1 ladder
+    verdicts, E2/E3 golden fingerprints, P1-P3 Pallas DMA discipline)
+    in one pass, tracing each registry program ONCE and sharing the
+    trace between every jaxpr pass.
+
+    ``changed=True`` is the git-diff-aware pre-commit path: only
+    programs whose family sources changed are traced/linted (core-
+    plane edits — sim/, parallel/, ops/, obs/, sweep/ — widen to the
+    full registry), tracelint runs over the changed files only, and
+    the golden gate diffs just the traced subset.  ``witness=False``
+    downgrades would-be witness executions to SKIPPED (structural
+    proofs and fingerprints only).
 
     Returns the merged machine-readable dict (``--format json``'s
     payload): per-pass findings, per-pass wall seconds, the jaxlint
-    peak-bytes map, the rangelint narrowing certificates, and
-    ``clean``.  Callers own the exit-code contract (nonzero on any
-    finding)."""
+    peak-bytes map, the rangelint narrowing certificates, the
+    equivlint verdicts/golden summary, and ``clean``.  Callers own the
+    exit-code contract (nonzero on any finding)."""
     import time as _time
 
+    from consul_tpu.analysis import equivlint as _el
     from consul_tpu.analysis import jaxlint as _jl
     from consul_tpu.analysis import rangelint as _rl
     from consul_tpu.analysis import tracelint as _tl
 
     out: dict = {"wall_s": {}}
+
+    changed_files = _el.git_changed_files() if changed else None
 
     t0 = _time.monotonic()
     from pathlib import Path as _Path
@@ -94,6 +124,9 @@ def run_check(include=("small", "big"), budget_gb: float = 16.0,
     for p in (paths or _tl.default_paths()):
         p = _Path(p)
         files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    if changed_files is not None:
+        keep = {str(_Path(f).resolve()) for f in changed_files}
+        files = [f for f in files if str(_Path(f).resolve()) in keep]
     violations = _tl.lint_paths(files)
     out["tracelint"] = {
         "violations": [v.to_json() for v in violations],
@@ -101,16 +134,31 @@ def run_check(include=("small", "big"), budget_gb: float = 16.0,
     }
     out["wall_s"]["tracelint"] = round(_time.monotonic() - t0, 2)
 
-    from consul_tpu.sim.engine import jaxlint_registry
+    from consul_tpu.sim.engine import EQUIV_PAIRS, jaxlint_registry
 
     programs = jaxlint_registry(include=include)
+    pairs = EQUIV_PAIRS
+    if changed_files is not None:
+        keys = _el.changed_program_keys(programs, changed_files)
+        pairs = tuple(p for p in pairs
+                      if p.a in keys or p.b in keys)
+        # A re-verified pair needs BOTH sides traced even when only
+        # one side's family changed.
+        for p in pairs:
+            keys.update(k for k in (p.a, p.b) if k in programs)
+        programs = {n: s for n, s in programs.items() if n in keys}
+    out["changed"] = (None if changed_files is None
+                      else sorted(changed_files))
+
     budget_bytes = int(budget_gb * (1 << 30))
     jl_findings, peaks = [], {}
     rl_findings, certs = [], {}
+    traces: dict = {}
     t_trace = t_jl = t_rl = 0.0
     for name, spec in programs.items():
         t0 = _time.monotonic()
         traced = spec.trace()
+        traces[name] = traced
         t_trace += _time.monotonic() - t0
         t0 = _time.monotonic()
         found, peak = _jl.analyze_jaxpr(
@@ -138,10 +186,32 @@ def run_check(include=("small", "big"), budget_gb: float = 16.0,
             n: [c.to_json() for c in cs] for n, cs in certs.items()
         },
     }
+
+    t0 = _time.monotonic()
+    # A sliced run (changed-mode or a single tier) must not report the
+    # untraced remainder of the golden file as E3 coverage holes.
+    partial = (changed_files is not None
+               or not {"small", "big"} <= set(include))
+    el = _el.run_equivlint(programs, traces=traces, pairs=pairs,
+                           witness=witness, subset=partial)
+    el_findings = el["findings"]
+    out["equivlint"] = {
+        "findings": [f.to_json() for f in el_findings],
+        "verdicts": [v.to_json() for v in el["verdicts"]],
+        "proved": el["proved"],
+        "witnessed": el["witnessed"],
+        "failed": el["failed"],
+        "skipped": el["skipped"],
+        "golden_diffs": el["golden_diffs"],
+        "pairs": len(pairs),
+    }
+    out["wall_s"]["equivlint"] = round(_time.monotonic() - t0, 2)
+
     out["wall_s"]["trace"] = round(t_trace, 2)
     out["wall_s"]["jaxlint"] = round(t_jl, 2)
     out["wall_s"]["rangelint"] = round(t_rl, 2)
-    out["clean"] = not (violations or jl_findings or rl_findings)
+    out["clean"] = not (violations or jl_findings or rl_findings
+                        or el_findings)
     return out
 
 

@@ -1,0 +1,1187 @@
+"""equivlint: jaxpr equivalence prover + golden program fingerprints.
+
+The repo's correctness story is an exactness LADDER — D == 1 is the
+unsharded program, ring == alltoall, U == 1 is the plain scan,
+telemetry=off is the identity, policy/flag defaults are bit-equal to
+their explicit spellings.  Until this module every rung was enforced by
+a RUNTIME bit-equality test, and the test matrix grew multiplicatively
+with every family x policy x D x U point.  equivlint turns each rung
+into DATA (``sim.engine.EQUIV_PAIRS``) and certifies it statically
+where possible, concretely where not:
+
+**Equivalence prover (E1).**  Every declared pair is first attacked by
+the jaxpr canonicalizer (:func:`canonicalize`): dead-code elimination,
+alpha-renaming by definition order, commutative-operand sorting,
+constant de-duplication, recursive scan/cond/pjit body
+canonicalization.  Structural identity of the canonical forms is a
+machine-checked PROOF that the two programs hand XLA the same
+computation — verdict ``PROVED``, zero executions.  Pairs the
+canonicalizer cannot close (sharded twins, telemetry twins — genuinely
+different programs with equal *projected* outputs) fall back to ONE
+shared tiny-shape concrete witness execution per program (cached per
+registry key, reused across pairs), bit-compared through the pair's
+declared output projection — verdict ``WITNESSED``.  Anything else is
+``FAILED`` and a finding; never silent.
+
+**Fingerprint gate (E2/E3).**  Every registry entry gets a golden
+fingerprint — canonical-jaxpr sha256, total equation count, per-
+primitive histogram, J6 peak bytes, and (recorded at update time)
+XLA ``cost_analysis`` flops — committed under
+``tests/golden/programs.json``.  ``cli check`` diffs the live registry
+against the snapshot: any PR that changes what XLA receives must
+regenerate the goldens DELIBERATELY (``cli equivlint
+--update-golden``), the same compile-cache-invariant discipline
+training stacks hang on program hashes.  E2 fires on drift, E3 on
+coverage holes (live program with no golden, golden with no live
+program).
+
+**Pallas pass (P1-P3).**  ``pallas_call`` bodies are OPAQUE to
+jaxlint/rangelint (``_OPAQUE_PRIMS``); this pass lifts the opacity for
+the DMA discipline of Mosaic kernels (``ops/ring_exchange.py`` and any
+future overlap schedule):
+
+  P1  every ``make_async_copy``/``make_async_remote_copy`` start has
+      exactly one matching wait (per semaphore x slot, per scope) —
+      an unmatched start deadlocks or races at the next slot reuse;
+  P2  no re-start of an in-flight (semaphore, slot) pair before its
+      wait (the h%2 double-buffer reuse race), and no direct
+      read/write of a ref that is the destination of an in-flight DMA;
+  P3  ``get_barrier_semaphore`` gating matches the interpret-mode
+      seam: no barrier under ``interpret=True`` (the interpreter
+      neither supports nor needs it), no barrier without a
+      ``collective_id``, and no remote DMA on real hardware without an
+      entry barrier.
+
+DMA operand parsing rides ``eqn.params["tree"]``: Mosaic's
+``dma_start``/``dma_wait`` flatten
+``(src_ref, src_transforms, dst_ref, dst_transforms, dst_sem,
+dst_sem_transforms, src_sem, src_sem_transforms, device_id)`` and
+``wait_send`` swaps src/dst before binding, so the waited semaphore is
+ALWAYS the unflattened tree's dst_sem slot — no heuristics.
+
+Deliberately out of scope: DMA-vs-DMA destination overlap (the ring
+kernel's hop pipeline intentionally keeps two remote copies in flight
+whose dst expressions coincide textually but land on DIFFERENT
+devices), and cross-branch start/wait pairing (each sub-jaxpr scope
+must balance on its own — conservative, and every kernel in the repo
+is straight-line).
+
+CLI: ``python -m consul_tpu.analysis.equivlint`` (or ``cli
+equivlint``) — ``--update-golden`` regenerates snapshots, ``--module``
+lints fixture kernels from a file defining ``EQUIVLINT_PROGRAMS``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import time
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "EQUIV_RULES",
+    "Fingerprint",
+    "PairVerdict",
+    "canonicalize",
+    "canonical_hash",
+    "diff_golden",
+    "eqn_histogram",
+    "fingerprint",
+    "fingerprint_registry",
+    "golden_path",
+    "lint_pallas",
+    "load_golden",
+    "main",
+    "pallas_findings",
+    "prove_pairs",
+    "run_equivlint",
+    "write_golden",
+]
+
+EQUIV_RULES = {
+    "E1": "every declared EQUIV_PAIR must close: PROVED (canonical "
+          "jaxprs structurally identical) or WITNESSED (shared "
+          "tiny-shape execution bit-equal through the pair's "
+          "projection); FAILED is a finding",
+    "E2": "live program fingerprint differs from the committed golden "
+          "(tests/golden/programs.json) — regenerate deliberately via "
+          "cli equivlint --update-golden",
+    "E3": "fingerprint coverage hole: live registry entry without a "
+          "golden, or golden entry naming no live program",
+    "P1": "every DMA start has exactly one matching wait per "
+          "(semaphore, slot) per scope",
+    "P2": "no re-start of an in-flight (semaphore, slot) before its "
+          "wait, and no direct ref access of an in-flight DMA "
+          "destination",
+    "P3": "get_barrier_semaphore gating must match the interpret seam "
+          "(no barrier under interpret, none without collective_id, "
+          "remote DMA on hardware only behind a barrier)",
+}
+
+_WAIT_SENTINEL = "<dynamic>"
+
+# ---------------------------------------------------------------------------
+# Canonicalizer: jaxpr -> stable text -> sha256.
+# ---------------------------------------------------------------------------
+
+# Binary primitives whose operand order is semantically free: canonical
+# form sorts their input tokens so `a + b` and `b + a` print alike.
+_COMMUTATIVE_PRIMS = frozenset({
+    "add", "mul", "max", "min", "and", "or", "xor", "eq", "ne",
+    "add_any",
+})
+
+# Address-looking substrings that must never reach the hash: repr() of
+# meshes, callables and compiler params can embed `0x7f...` pointers
+# that differ per process.
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def _aval_token(aval) -> str:
+    try:
+        return aval.str_short(short_dtypes=True)
+    except Exception:
+        return str(aval)
+
+
+def _const_digest(c) -> str:
+    """Stable content digest of one jaxpr constant."""
+    import numpy as np
+
+    try:
+        a = np.asarray(c)
+        h = hashlib.sha256()
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+        return h.hexdigest()[:16]
+    except Exception:
+        return _ADDR_RE.sub("0x", repr(c))[:64]
+
+
+def _param_token(v, depth: int) -> str:
+    """Canonical token for one eqn param value.
+
+    Sub-jaxprs recurse through the full canonicalizer (scan/cond/pjit
+    bodies get their own alpha-space); callables reduce to their
+    qualname (partials and locals repr with process addresses);
+    everything else is repr() with addresses scrubbed."""
+    if hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+        return "{" + _canon_jaxpr(v.jaxpr, tuple(getattr(v, "consts", ())),
+                                  depth + 1) + "}"
+    if hasattr(v, "eqns") and hasattr(v, "invars"):
+        return "{" + _canon_jaxpr(v, (), depth + 1) + "}"
+    if isinstance(v, (tuple, list)):
+        inner = ",".join(_param_token(x, depth) for x in v)
+        return f"({inner})"
+    if isinstance(v, dict):
+        inner = ",".join(
+            f"{k}:{_param_token(v[k], depth)}" for k in sorted(v)
+        )
+        return "{" + inner + "}"
+    if callable(v) and not isinstance(v, type):
+        return f"<fn {getattr(v, '__qualname__', type(v).__name__)}>"
+    return _ADDR_RE.sub("0x", repr(v))
+
+
+def _live_eqns(jaxpr) -> list:
+    """Dead-code elimination: keep eqns (in order) whose outputs feed
+    the jaxpr's outvars transitively, plus anything effectful."""
+    from jax._src import core as jcore
+
+    live: set = set()
+    for v in jaxpr.outvars:
+        if isinstance(v, jcore.Var):
+            live.add(v)
+    keep = []
+    for eqn in reversed(jaxpr.eqns):
+        needed = bool(getattr(eqn, "effects", ())) or any(
+            o in live for o in eqn.outvars
+        )
+        if not needed:
+            continue
+        keep.append(eqn)
+        for v in eqn.invars:
+            if isinstance(v, jcore.Var):
+                live.add(v)
+    keep.reverse()
+    return keep
+
+
+def _canon_jaxpr(jaxpr, consts: tuple, depth: int = 0) -> str:
+    """Canonical text of one (raw) jaxpr: DCE'd, alpha-renamed by
+    definition order, commutative operands sorted, constants
+    de-duplicated by content.  Depth-capped defensively (the registry's
+    deepest nesting is jit > shard_map > scan > cond ~ 6)."""
+    from jax._src import core as jcore
+
+    if depth > 24:
+        return "<depth-capped>"
+
+    eqns = _live_eqns(jaxpr)
+
+    names: dict = {}
+
+    # Constants: name by content digest so duplicated consts collapse
+    # and the binding order of equal payloads cannot matter.
+    digests: dict = {}
+    const_lines = []
+    const_by_var = dict(
+        zip(jaxpr.constvars, consts if consts else [None] * 99999)
+    )
+    for cv in jaxpr.constvars:
+        c = const_by_var.get(cv)
+        d = (_const_digest(c) if c is not None
+             else f"abstract:{_aval_token(cv.aval)}")
+        if d not in digests:
+            digests[d] = f"c{len(digests)}"
+            const_lines.append(
+                f"  const {digests[d]}:{_aval_token(cv.aval)} = {d}"
+            )
+        names[cv] = digests[d]
+
+    for i, v in enumerate(jaxpr.invars):
+        names[v] = f"a{i}"
+
+    def atom(v) -> str:
+        if isinstance(v, jcore.Var):
+            if v not in names:
+                # Dropvar or a var DCE'd away upstream.
+                return "_"
+            return names[v]
+        # Literal
+        val = getattr(v, "val", v)
+        return f"lit[{_ADDR_RE.sub('0x', repr(val))}:{_aval_token(v.aval)}]"
+
+    lines = ["in " + " ".join(
+        f"{names[v]}:{_aval_token(v.aval)}" for v in jaxpr.invars
+    )]
+    lines.extend(const_lines)
+
+    serial = 0
+    for eqn in eqns:
+        outs = []
+        for o in eqn.outvars:
+            if type(o).__name__ == "DropVar":
+                outs.append("_")
+                continue
+            names[o] = f"v{serial}"
+            serial += 1
+            outs.append(f"{names[o]}:{_aval_token(o.aval)}")
+        ins = [atom(v) for v in eqn.invars]
+        prim = eqn.primitive.name
+        if prim in _COMMUTATIVE_PRIMS and len(ins) == 2:
+            ins = sorted(ins)
+        params = ",".join(
+            f"{k}={_param_token(v, depth)}"
+            for k, v in sorted(eqn.params.items())
+        )
+        lines.append(f"  {' '.join(outs)} = {prim}[{params}] "
+                     f"{' '.join(ins)}")
+
+    lines.append("out " + " ".join(atom(v) for v in jaxpr.outvars))
+    return "\n".join(lines)
+
+
+def canonicalize(closed_jaxpr) -> str:
+    """Canonical text form of a traced program (see module docstring
+    for the normalizations).  Structural identity of two canonical
+    forms is the E1 PROOF relation; its sha256 is the E2 fingerprint."""
+    return _canon_jaxpr(
+        closed_jaxpr.jaxpr, tuple(closed_jaxpr.consts), 0
+    )
+
+
+def canonical_hash(closed_jaxpr) -> str:
+    return hashlib.sha256(canonicalize(closed_jaxpr).encode()).hexdigest()
+
+
+def eqn_histogram(closed_jaxpr) -> dict:
+    """Per-primitive equation counts, sub-jaxprs included — the
+    fingerprint's shape-of-the-program component (E2 diffs name which
+    primitive moved, not just that SOMETHING did)."""
+    from consul_tpu.analysis.jaxlint import _sub_jaxprs
+
+    hist: dict = {}
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            hist[name] = hist.get(name, 0) + 1
+            for _, sub, _ in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(closed_jaxpr.jaxpr)
+    return dict(sorted(hist.items()))
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints + the golden gate.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    """One registry entry's program-ABI snapshot.  ``flops`` is
+    recorded at --update-golden time only (cost_analysis requires
+    lowering, too slow for the per-PR gate) and compared with tolerance
+    when both sides have it."""
+
+    hash: str
+    eqns: int
+    histogram: dict
+    peak_bytes: int
+    devices: int = 1
+    flops: Optional[float] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def fingerprint(program, traced=None, flops: bool = False) -> Fingerprint:
+    from consul_tpu.analysis.jaxlint import eqn_count, estimate_peak
+
+    if traced is None:
+        traced = program.trace()
+    fl = None
+    if flops:
+        fl = _cost_flops(program)
+    return Fingerprint(
+        hash=canonical_hash(traced),
+        eqns=eqn_count(traced),
+        histogram=eqn_histogram(traced),
+        peak_bytes=int(estimate_peak(traced).chip_bytes),
+        devices=int(program.devices),
+        flops=fl,
+    )
+
+
+def _cost_flops(program) -> Optional[float]:
+    """XLA cost_analysis flops of the lowered program; None when the
+    backend refuses (abstract-only 10M entries are never lowered)."""
+    import jax
+
+    if getattr(program, "abstract_only", False):
+        return None
+    try:
+        fn, args = program.build()
+        cost = jax.jit(fn).lower(*args).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return None
+
+
+def fingerprint_registry(programs: dict, traces: Optional[dict] = None,
+                         flops: bool = False) -> dict:
+    """name -> Fingerprint over a registry dict, reusing ``traces``
+    (name -> ClosedJaxpr) when the caller already paid for them."""
+    out = {}
+    for name, prog in programs.items():
+        traced = (traces or {}).get(name)
+        out[name] = fingerprint(prog, traced=traced, flops=flops)
+    return out
+
+
+def golden_path() -> str:
+    """tests/golden/programs.json at the repo root (resolved relative
+    to this file so the gate works from any cwd)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)),
+                        "tests", "golden", "programs.json")
+
+
+def load_golden(path: Optional[str] = None) -> dict:
+    path = path or golden_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_golden(fingerprints: dict, path: Optional[str] = None,
+                 merge: bool = True) -> str:
+    """Write (or merge-update) the golden snapshot.  ``merge=True``
+    keeps existing entries not in ``fingerprints`` — a --set small
+    update must not drop the big set's goldens."""
+    import jax
+
+    path = path or golden_path()
+    doc = load_golden(path) if merge else {}
+    programs = dict(doc.get("programs", {}))
+    for name, fp in sorted(fingerprints.items()):
+        programs[name] = fp.to_json()
+    doc = {
+        "meta": {
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "jax": jax.__version__,
+            "note": "regenerate deliberately: cli equivlint "
+                    "--update-golden",
+        },
+        "programs": dict(sorted(programs.items())),
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def _hist_delta(live: dict, gold: dict) -> str:
+    moved = []
+    for k in sorted(set(live) | set(gold)):
+        a, b = live.get(k, 0), gold.get(k, 0)
+        if a != b:
+            moved.append(f"{k} {b}->{a}")
+    return ", ".join(moved[:6]) + ("..." if len(moved) > 6 else "")
+
+
+def diff_golden(live: dict, golden: Optional[dict] = None,
+                flops_rtol: float = 0.05,
+                subset: bool = False) -> list:
+    """E2/E3 findings: ``live`` (name -> Fingerprint) against the
+    committed snapshot.  Golden entries needing more devices than the
+    process exposes are skipped (the registry itself already dropped
+    them); everything else unaccounted for is LOUD.  ``subset=True``
+    (the --changed path, which deliberately traces a slice of the
+    registry) suppresses the golden-without-live direction."""
+    import jax
+
+    from consul_tpu.analysis.jaxlint import Finding, format_bytes
+
+    if golden is None:
+        golden = load_golden()
+    gold_programs = golden.get("programs", {})
+    findings = []
+    for name in sorted(live):
+        fp = live[name]
+        g = gold_programs.get(name)
+        if g is None:
+            findings.append(Finding(
+                program=name, rule="E3",
+                message="no golden fingerprint — run `cli equivlint "
+                        "--update-golden` and commit "
+                        "tests/golden/programs.json",
+            ))
+            continue
+        if fp.hash != g["hash"]:
+            detail = []
+            if fp.eqns != g["eqns"]:
+                detail.append(f"eqns {g['eqns']}->{fp.eqns}")
+            hd = _hist_delta(fp.histogram, g.get("histogram", {}))
+            if hd:
+                detail.append(f"histogram: {hd}")
+            if fp.peak_bytes != g["peak_bytes"]:
+                detail.append(
+                    f"peak {format_bytes(g['peak_bytes'])}->"
+                    f"{format_bytes(fp.peak_bytes)}"
+                )
+            what = "; ".join(detail) or "same shape, different program"
+            findings.append(Finding(
+                program=name, rule="E2",
+                message=f"canonical jaxpr drifted from golden ({what}) "
+                        "— if intended, regenerate via "
+                        "`cli equivlint --update-golden`",
+            ))
+            continue
+        # Hash equal: eqns/histogram/peak derive from the same jaxpr,
+        # but diff them anyway — a stale hand-edited golden must not
+        # pass silently.
+        if fp.eqns != g["eqns"] or fp.histogram != g.get("histogram"):
+            findings.append(Finding(
+                program=name, rule="E2",
+                message="golden entry internally inconsistent "
+                        "(hash matches, counts do not) — regenerate",
+            ))
+        if (fp.flops is not None and g.get("flops") is not None
+                and g["flops"] > 0
+                and abs(fp.flops - g["flops"]) > flops_rtol * g["flops"]):
+            findings.append(Finding(
+                program=name, rule="E2",
+                message=f"cost_analysis flops drifted "
+                        f"{g['flops']:.3g} -> {fp.flops:.3g} "
+                        f"(> {flops_rtol:.0%})",
+            ))
+    if subset:
+        return findings
+    n_dev = len(jax.devices())
+    for name, g in sorted(gold_programs.items()):
+        if name in live:
+            continue
+        if int(g.get("devices", 1)) > n_dev:
+            continue  # device-gated: the registry dropped it too
+        findings.append(Finding(
+            program=name, rule="E3",
+            message="golden entry names no live registry program — "
+                    "stale snapshot, regenerate via `cli equivlint "
+                    "--update-golden`",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Equivalence prover: PROVED / WITNESSED / FAILED over EQUIV_PAIRS.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PairVerdict:
+    pair: str          # "a ~ b"
+    relation: str
+    verdict: str       # PROVED | WITNESSED | FAILED | SKIPPED
+    detail: str = ""
+    wall_s: float = 0.0
+
+    def format(self) -> str:
+        d = f" ({self.detail})" if self.detail else ""
+        return f"{self.verdict:9s} {self.pair} [{self.relation}]{d}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _leaves(tree) -> list:
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _bit_equal(a, b) -> Optional[str]:
+    """None when the two output pytrees are bit-identical, else a
+    human description of the first divergence.  NaNs compare by BITS —
+    exactly the ladder's contract."""
+    import jax
+    import numpy as np
+
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    if ta != tb:
+        return f"output trees differ: {ta} vs {tb}"
+    for i, (la, lb) in enumerate(zip(_leaves(a), _leaves(b))):
+        xa, xb = np.asarray(la), np.asarray(lb)
+        if xa.shape != xb.shape or xa.dtype != xb.dtype:
+            return (f"leaf {i}: {xa.dtype}{list(xa.shape)} vs "
+                    f"{xb.dtype}{list(xb.shape)}")
+        if xa.tobytes() != xb.tobytes():
+            neq = np.sum(xa.reshape(-1) != xb.reshape(-1))
+            return f"leaf {i}: {neq}/{xa.size} elements differ"
+    return None
+
+
+def _witness_output(prog, cache: dict, args_override=None):
+    """The ONE concrete execution per registry key: fn(init(), key0)
+    (or the pair's args builder), device_get'd and cached so every
+    pair touching this program shares it."""
+    import jax
+
+    if prog.name in cache:
+        return cache[prog.name]
+    if getattr(prog, "abstract_only", False):
+        raise RuntimeError(f"{prog.name} is abstract-only: never "
+                           "executed, cannot witness")
+    fn, _ = prog.build()
+    if args_override is not None:
+        args = args_override()
+    else:
+        if prog.init is None:
+            raise RuntimeError(
+                f"{prog.name} has no init (registry entry predates the "
+                "witness seam) and the pair declares no args builder"
+            )
+        args = (prog.init(), jax.random.PRNGKey(0))
+    out = jax.device_get(fn(*args))
+    cache[prog.name] = out
+    return out
+
+
+def prove_pairs(programs: dict, pairs=None,
+                traces: Optional[dict] = None,
+                witness: bool = True,
+                _witness_cache: Optional[dict] = None) -> list:
+    """E1 over the declared ladder: one PairVerdict per EQUIV_PAIR.
+
+    Structural proof first (canonical forms of the two traces, only
+    meaningful for projection-free pairs — a projected pair's full
+    outputs differ by construction); the witness engine second.
+    ``witness=False`` (the --changed fast path) downgrades would-be
+    witnesses to SKIPPED rather than executing."""
+    if pairs is None:
+        from consul_tpu.sim.engine import EQUIV_PAIRS
+        pairs = EQUIV_PAIRS
+    traces = traces if traces is not None else {}
+    cache = _witness_cache if _witness_cache is not None else {}
+    canon: dict = {}
+    verdicts = []
+
+    def canon_of(name):
+        if name not in canon:
+            prog = programs[name]
+            traced = traces.get(name)
+            if traced is None:
+                traced = prog.trace()
+                traces[name] = traced
+            canon[name] = canonicalize(traced)
+        return canon[name]
+
+    for pair in pairs:
+        t0 = time.time()
+        label = f"{pair.a} ~ {pair.b}"
+        if pair.a not in programs or pair.b not in programs:
+            missing = pair.a if pair.a not in programs else pair.b
+            verdicts.append(PairVerdict(
+                pair=label, relation=pair.relation, verdict="SKIPPED",
+                detail=f"{missing} not in registry (device-gated)",
+            ))
+            continue
+        structural = pair.project_a is None and pair.project_b is None
+        try:
+            if structural and canon_of(pair.a) == canon_of(pair.b):
+                verdicts.append(PairVerdict(
+                    pair=label, relation=pair.relation,
+                    verdict="PROVED",
+                    detail="canonical jaxprs structurally identical",
+                    wall_s=time.time() - t0,
+                ))
+                continue
+            if not witness:
+                verdicts.append(PairVerdict(
+                    pair=label, relation=pair.relation,
+                    verdict="SKIPPED",
+                    detail="witness disabled (--no-witness)",
+                    wall_s=time.time() - t0,
+                ))
+                continue
+            out_a = _witness_output(programs[pair.a], cache, pair.args_a)
+            out_b = _witness_output(programs[pair.b], cache, pair.args_b)
+            if pair.project_a is not None:
+                out_a = pair.project_a(out_a)
+            if pair.project_b is not None:
+                out_b = pair.project_b(out_b)
+            diff = _bit_equal(out_a, out_b)
+            if diff is None:
+                verdicts.append(PairVerdict(
+                    pair=label, relation=pair.relation,
+                    verdict="WITNESSED",
+                    detail="tiny-shape execution bit-equal",
+                    wall_s=time.time() - t0,
+                ))
+            else:
+                verdicts.append(PairVerdict(
+                    pair=label, relation=pair.relation,
+                    verdict="FAILED", detail=diff,
+                    wall_s=time.time() - t0,
+                ))
+        except Exception as e:  # noqa: BLE001 — verdicts are never silent
+            verdicts.append(PairVerdict(
+                pair=label, relation=pair.relation, verdict="FAILED",
+                detail=f"{type(e).__name__}: {e}",
+                wall_s=time.time() - t0,
+            ))
+    return verdicts
+
+
+# ---------------------------------------------------------------------------
+# Pallas pass: P1-P3 over Mosaic kernel bodies.
+# ---------------------------------------------------------------------------
+
+_DMA_TREE_LEN = 9  # (src, src_t, dst, dst_t, dst_sem, dst_sem_t,
+#                    src_sem, src_sem_t, device_id)
+_REF_ACCESS_PRIMS = frozenset({"get", "swap", "masked_load",
+                               "masked_swap", "addupdate"})
+
+
+def _dma_operands(eqn):
+    """Unflatten a dma_start/dma_wait eqn's operands through its tree
+    param.  Returns the 9-tuple, or None when the layout is not the
+    Mosaic copy descriptor (future primitives degrade to no-analysis,
+    never to a crash)."""
+    from jax import tree_util
+
+    tree = eqn.params.get("tree")
+    if tree is None:
+        return None
+    try:
+        ops = tree_util.tree_unflatten(tree, tuple(eqn.invars))
+    except Exception:
+        return None
+    if not isinstance(ops, tuple) or len(ops) != _DMA_TREE_LEN:
+        return None
+    return ops
+
+
+def _slot_of(sem_transforms) -> Any:
+    """Static slot key of a semaphore indexer: the tuple of literal
+    index values (``sem.at[h % 2]`` with a Python ``h`` is a trace-time
+    Literal), or the dynamic sentinel when any leaf is a traced var."""
+    from jax import tree_util
+    from jax._src import core as jcore
+
+    leaves = tree_util.tree_leaves(sem_transforms)
+    vals = []
+    for leaf in leaves:
+        if isinstance(leaf, jcore.Var):
+            return _WAIT_SENTINEL
+        val = getattr(leaf, "val", leaf)
+        try:
+            vals.append(int(val))
+        except Exception:
+            vals.append(str(val))
+    return tuple(vals)
+
+
+@dataclasses.dataclass
+class _InFlight:
+    eqn: Any
+    dst_ref: Any
+    src_ref: Any
+    where: str
+
+
+def _scan_dma_scope(jaxpr, program: str, findings: list,
+                    flags: dict) -> None:
+    """Linear DMA-discipline scan of ONE jaxpr scope (P1/P2), recursing
+    into sub-jaxprs as independent scopes.  ``flags`` accumulates
+    barrier/remote sightings for the enclosing pallas_call's P3."""
+    from consul_tpu.analysis.jaxlint import Finding, _src, _sub_jaxprs
+
+    inflight: dict = {}
+
+    def key_conflicts(sem, slot):
+        """In-flight keys this (sem, slot) collides with — exact slot
+        match, with the dynamic sentinel colliding with everything on
+        the same semaphore (conservative)."""
+        out = []
+        for (s, sl) in inflight:
+            if s is not sem:
+                continue
+            if slot == _WAIT_SENTINEL or sl == _WAIT_SENTINEL or sl == slot:
+                out.append((s, sl))
+        return out
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        where = _src(eqn)
+        if prim == "get_barrier_semaphore":
+            flags["barrier"] = True
+        elif prim == "dma_start":
+            ops = _dma_operands(eqn)
+            if ops is None:
+                continue
+            (src, _src_t, dst, _dst_t, dst_sem, dst_sem_t,
+             src_sem, src_sem_t, device_id) = ops
+            if device_id is not None:
+                flags["remote"] = True
+            sems = [(dst_sem, _slot_of(dst_sem_t))]
+            if src_sem is not None:
+                sems.append((src_sem, _slot_of(src_sem_t)))
+            for sem, slot in sems:
+                hit = key_conflicts(sem, slot)
+                if hit:
+                    prev = inflight[hit[0]]
+                    findings.append(Finding(
+                        program=program, rule="P2",
+                        message=f"DMA start reuses in-flight semaphore "
+                                f"slot {slot} (previous start at "
+                                f"{prev.where or '<unknown>'} not yet "
+                                "waited) — the h%2 double-buffer race",
+                        where=where,
+                    ))
+                inflight[(sem, slot)] = _InFlight(
+                    eqn=eqn, dst_ref=dst, src_ref=src, where=where,
+                )
+        elif prim == "dma_wait":
+            ops = _dma_operands(eqn)
+            if ops is None:
+                continue
+            # wait_send swaps src/dst before binding, so the waited
+            # semaphore is ALWAYS the tree's dst_sem position.
+            (_a, _b, _c, _d, sem, sem_t, _e, _f, _g) = ops
+            slot = _slot_of(sem_t)
+            hit = key_conflicts(sem, slot)
+            if not hit:
+                findings.append(Finding(
+                    program=program, rule="P1",
+                    message=f"DMA wait on semaphore slot {slot} with no "
+                            "matching in-flight start in this scope",
+                    where=where,
+                ))
+            elif slot == _WAIT_SENTINEL and len(hit) > 1:
+                findings.append(Finding(
+                    program=program, rule="P1",
+                    message="dynamically-indexed semaphore wait cannot "
+                            f"be matched statically ({len(hit)} "
+                            "candidate starts in flight)",
+                    where=where,
+                ))
+                inflight.pop(hit[0], None)
+            else:
+                inflight.pop(hit[0], None)
+        elif prim in _REF_ACCESS_PRIMS and eqn.invars:
+            ref = eqn.invars[0]
+            for (sem, slot), inf in inflight.items():
+                if ref is inf.dst_ref or (
+                        prim in ("swap", "masked_swap", "addupdate")
+                        and ref is inf.src_ref):
+                    findings.append(Finding(
+                        program=program, rule="P2",
+                        message=f"direct {prim} of a ref that is the "
+                                f"{'destination' if ref is inf.dst_ref else 'source'} "
+                                f"of an in-flight DMA (started at "
+                                f"{inf.where or '<unknown>'}, slot "
+                                f"{slot}) before its wait",
+                        where=where,
+                    ))
+                    break
+        else:
+            for _, sub, _ in _sub_jaxprs(eqn):
+                _scan_dma_scope(sub, program, findings, flags)
+
+    for (sem, slot), inf in inflight.items():
+        findings.append(Finding(
+            program=program, rule="P1",
+            message=f"DMA start on semaphore slot {slot} is never "
+                    "waited in this scope — unmatched start deadlocks "
+                    "or races the next slot reuse",
+            where=inf.where,
+        ))
+
+
+def _mosaic_params(eqn) -> dict:
+    cp = eqn.params.get("compiler_params")
+    if cp is None:
+        return {}
+    if isinstance(cp, dict):
+        mosaic = cp.get("mosaic", cp)
+        return mosaic if isinstance(mosaic, dict) else {}
+    mosaic = getattr(cp, "mosaic", cp)
+    if isinstance(mosaic, dict):
+        return mosaic
+    out = {}
+    for field in ("collective_id",):
+        if hasattr(mosaic, field):
+            out[field] = getattr(mosaic, field)
+    return out
+
+
+def pallas_findings(program: str, closed_jaxpr) -> list:
+    """P1-P3 findings for every ``pallas_call`` reachable from a traced
+    program (sub-jaxprs walked, so kernels inside shard_map-in-scan are
+    covered — the ring twins' actual nesting)."""
+    from consul_tpu.analysis.jaxlint import Finding, _src, _sub_jaxprs
+
+    findings: list = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "pallas_call":
+                body = eqn.params.get("jaxpr")
+                if body is None:
+                    continue
+                raw = getattr(body, "jaxpr", body)
+                flags = {"barrier": False, "remote": False}
+                _scan_dma_scope(raw, program, findings, flags)
+                interpret = bool(eqn.params.get("interpret", False))
+                collective_id = _mosaic_params(eqn).get("collective_id")
+                where = _src(eqn)
+                if flags["barrier"] and interpret:
+                    findings.append(Finding(
+                        program=program, rule="P3",
+                        message="get_barrier_semaphore under "
+                                "interpret=True — the interpreter "
+                                "neither supports nor needs the "
+                                "barrier; gate it on interpret "
+                                "(ops/ring_exchange.py seam)",
+                        where=where,
+                    ))
+                if flags["barrier"] and collective_id is None:
+                    findings.append(Finding(
+                        program=program, rule="P3",
+                        message="get_barrier_semaphore without "
+                                "compiler_params collective_id — "
+                                "Mosaic cannot match the barrier "
+                                "across programs",
+                        where=where,
+                    ))
+                if (flags["remote"] and not interpret
+                        and not flags["barrier"]):
+                    findings.append(Finding(
+                        program=program, rule="P3",
+                        message="remote DMA on real hardware without "
+                                "an entry barrier — a neighbour's DMA "
+                                "can land in an unallocated inbox",
+                        where=where,
+                    ))
+            for _, sub, _ in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(closed_jaxpr.jaxpr)
+    return findings
+
+
+def lint_pallas(programs: dict, traces: Optional[dict] = None) -> list:
+    """P1-P3 across a registry (only programs whose traces contain a
+    ``pallas_call`` contribute — the ring twins today)."""
+    traces = traces if traces is not None else {}
+    findings = []
+    for name, prog in programs.items():
+        traced = traces.get(name)
+        if traced is None:
+            traced = prog.trace()
+            traces[name] = traced
+        findings.extend(pallas_findings(name, traced))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# --changed: git-diff-aware program selection (the pre-commit path).
+# ---------------------------------------------------------------------------
+
+# family -> source files/prefixes (repo-relative) whose edits dirty
+# that family's registry programs.  Core-plane prefixes dirty EVERY
+# program (the engine, sharding, ops, telemetry and sweep layers are
+# woven through all of them).
+_FAMILY_SOURCES = {
+    "broadcast": ("consul_tpu/models/broadcast.py",),
+    "membership": ("consul_tpu/models/membership.py",),
+    "sparse": ("consul_tpu/models/membership_sparse.py",
+               "consul_tpu/models/membership.py"),
+    "swim": ("consul_tpu/models/swim.py",),
+    "lifeguard": ("consul_tpu/models/lifeguard.py",
+                  "consul_tpu/models/swim.py"),
+    "multidc": ("consul_tpu/models/multidc.py",),
+    "streamcast": ("consul_tpu/streamcast/",),
+    "geo": ("consul_tpu/geo/", "consul_tpu/models/multidc.py"),
+}
+
+_CORE_SOURCES = (
+    "consul_tpu/sim/", "consul_tpu/parallel/", "consul_tpu/ops/",
+    "consul_tpu/obs/", "consul_tpu/sweep/", "consul_tpu/protocol",
+)
+
+
+def git_changed_files(base: str = "HEAD") -> list:
+    """Repo-relative paths changed vs ``base`` (staged + unstaged) plus
+    untracked files — the working-tree delta a pre-commit check must
+    cover.  Empty list when git is unavailable (callers fall back to
+    the full registry LOUDLY rather than silently skipping)."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(here))
+    out: list = []
+    for cmd in (
+        ["git", "diff", "--name-only", base],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30, check=True)
+        except Exception:
+            return []
+        out.extend(line.strip() for line in res.stdout.splitlines()
+                   if line.strip())
+    return sorted(set(out))
+
+
+def _program_family(name: str) -> str:
+    fam = name.split("@", 1)[0]
+    for prefix in ("sharded_", "sweep_"):
+        if fam.startswith(prefix):
+            fam = fam[len(prefix):]
+    return fam
+
+
+def changed_program_keys(programs: dict, changed_files) -> set:
+    """The registry subset a change set dirties: core-plane edits
+    select everything, model/family edits select that family's
+    unsharded + sharded + sweep twins, anything else selects nothing
+    (the fast no-op pre-commit path)."""
+    changed = list(changed_files)
+    if any(f.startswith(_CORE_SOURCES) for f in changed):
+        return set(programs)
+    fams = {
+        fam for fam, srcs in _FAMILY_SOURCES.items()
+        if any(f.startswith(srcs) for f in changed)
+    }
+    return {n for n in programs if _program_family(n) in fams}
+
+
+# ---------------------------------------------------------------------------
+# Umbrella + CLI.
+# ---------------------------------------------------------------------------
+
+
+def run_equivlint(programs: dict, traces: Optional[dict] = None,
+                  pairs=None, golden: Optional[str] = None,
+                  witness: bool = True, flops: bool = False,
+                  subset: bool = False) -> dict:
+    """The full pass: E1 verdicts + E2/E3 golden diff + P1-P3, sharing
+    one trace cache.  Returns the summary dict ``cli check`` and the
+    graft dryrun tail read.  ``subset=True`` marks a deliberately
+    partial registry (--changed): the golden gate only diffs what was
+    traced."""
+    t0 = time.time()
+    traces = traces if traces is not None else {}
+    verdicts = prove_pairs(programs, pairs=pairs, traces=traces,
+                           witness=witness)
+    from consul_tpu.analysis.jaxlint import Finding
+
+    findings = []
+    for v in verdicts:
+        if v.verdict == "FAILED":
+            findings.append(Finding(
+                program=v.pair, rule="E1",
+                message=f"declared equivalence failed: {v.detail} "
+                        f"[{v.relation}]",
+            ))
+    live = fingerprint_registry(programs, traces=traces, flops=flops)
+    golden_doc = load_golden(golden)
+    findings.extend(diff_golden(live, golden_doc, subset=subset))
+    findings.extend(lint_pallas(programs, traces=traces))
+    counts = {k: sum(1 for v in verdicts if v.verdict == k)
+              for k in ("PROVED", "WITNESSED", "FAILED", "SKIPPED")}
+    return {
+        "verdicts": verdicts,
+        "findings": findings,
+        "fingerprints": live,
+        "proved": counts["PROVED"],
+        "witnessed": counts["WITNESSED"],
+        "failed": counts["FAILED"],
+        "skipped": counts["SKIPPED"],
+        "golden_diffs": sum(1 for f in findings
+                            if f.rule in ("E2", "E3")),
+        "pallas_findings": [f for f in findings
+                            if f.rule.startswith("P")],
+        "wall_s": time.time() - t0,
+    }
+
+
+def _load_fixture_programs(path: str) -> dict:
+    """Load ``EQUIVLINT_PROGRAMS`` (name -> (fn, args)) from a module
+    file — the planted bad/clean Pallas fixture hook, mirroring
+    jaxlint's ``JAXLINT_PROGRAMS`` seam."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_equivlint_fixture",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    progs = getattr(mod, "EQUIVLINT_PROGRAMS", None)
+    if not isinstance(progs, dict):
+        raise SystemExit(
+            f"{path} does not define an EQUIVLINT_PROGRAMS dict"
+        )
+    return progs
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="equivlint",
+        description="jaxpr equivalence prover + golden fingerprint "
+                    "gate + Pallas DMA discipline",
+    )
+    parser.add_argument("--set", default="small,big",
+                        help="registry set(s), comma-separated: "
+                        "small | big | small,big (default both — the "
+                        "golden gate covers the full registry; a "
+                        "single tier diffs as a subset)")
+    parser.add_argument("--update-golden", action="store_true",
+                        help="regenerate tests/golden/programs.json "
+                        "for the selected set (merge-updates)")
+    parser.add_argument("--golden", default=None,
+                        help="alternate golden snapshot path")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--no-witness", action="store_true",
+                        help="skip witness executions (structural "
+                        "proofs and fingerprints only)")
+    parser.add_argument("--flops", action="store_true",
+                        help="lower programs for cost_analysis flops "
+                        "(slow; implied by --update-golden)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--module", default=None,
+                        help="lint fixture kernels from a module file "
+                        "defining EQUIVLINT_PROGRAMS instead of the "
+                        "registry")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in EQUIV_RULES.items():
+            print(f"{rule}: {desc}")
+        return 0
+
+    # Same device-forcing preamble as cli jaxlint: the registry's
+    # sharded twins need 8 virtual devices on CPU.
+    if "XLA_FLAGS" not in os.environ:
+        os.environ["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=8"
+        )
+    import jax  # noqa: F401  (device init after the env var)
+
+    from consul_tpu.analysis.jaxlint import _backend_initialized
+
+    _ = _backend_initialized()
+
+    if args.module:
+        progs = _load_fixture_programs(args.module)
+        findings = []
+        for name, (fn, fargs) in progs.items():
+            traced = jax.make_jaxpr(fn)(*fargs)
+            findings.extend(pallas_findings(name, traced))
+        if args.format == "json":
+            print(json.dumps([f.to_json() for f in findings], indent=1))
+        else:
+            for f in findings:
+                print(f.format())
+            print(f"equivlint[module]: {len(findings)} finding(s) over "
+                  f"{len(progs)} fixture program(s)")
+        return 1 if findings else 0
+
+    from consul_tpu.sim.engine import jaxlint_registry
+
+    include = tuple(s.strip() for s in args.set.split(",") if s.strip())
+    programs = jaxlint_registry(include=include)
+    traces: dict = {}
+
+    if args.update_golden:
+        live = fingerprint_registry(programs, traces=traces, flops=True)
+        path = write_golden(live, path=args.golden)
+        print(f"wrote {len(live)} fingerprint(s) to {path}")
+        return 0
+
+    res = run_equivlint(programs, traces=traces, golden=args.golden,
+                        witness=not args.no_witness, flops=args.flops,
+                        subset=not {"small", "big"} <= set(include))
+    if args.format == "json":
+        print(json.dumps({
+            "verdicts": [v.to_json() for v in res["verdicts"]],
+            "findings": [f.to_json() for f in res["findings"]],
+            "proved": res["proved"], "witnessed": res["witnessed"],
+            "failed": res["failed"], "skipped": res["skipped"],
+            "golden_diffs": res["golden_diffs"],
+            "wall_s": res["wall_s"],
+        }, indent=1))
+    else:
+        for v in res["verdicts"]:
+            print(v.format())
+        for f in res["findings"]:
+            print(f.format())
+        print(
+            f"equivlint: {len(programs)} program(s), "
+            f"{res['proved']} proved / {res['witnessed']} witnessed / "
+            f"{res['failed']} failed / {res['skipped']} skipped, "
+            f"{res['golden_diffs']} golden diff(s), "
+            f"{len(res['pallas_findings'])} pallas finding(s) "
+            f"in {res['wall_s']:.1f}s"
+        )
+    return 1 if res["findings"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

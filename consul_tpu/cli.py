@@ -266,10 +266,44 @@ def build_parser() -> argparse.ArgumentParser:
                          "(e.g. 10000000)")
 
     sp = sub.add_parser(
+        "equivlint",
+        help="exactness-ladder prover + golden fingerprint gate + "
+             "Pallas DMA discipline (rules E1-E3, P1-P3) over the "
+             "registered entrypoints",
+    )
+    sp.set_defaults(fn=cmd_equivlint)
+    sp.add_argument("--list-rules", action="store_true",
+                    dest="list_rules", help="enumerate rules and exit")
+    sp.add_argument("--format", choices=["text", "json"], default="text",
+                    dest="format")
+    sp.add_argument("--set", choices=["small", "big", "all"],
+                    default="all", dest="which",
+                    help="registry slice (default: both tiers — the "
+                         "golden file covers small AND big)")
+    sp.add_argument("--update-golden", action="store_true",
+                    dest="update_golden",
+                    help="regenerate tests/golden/programs.json from "
+                         "the live fingerprints (merge: entries "
+                         "outside --set are kept)")
+    sp.add_argument("--golden", default="",
+                    help="alternate golden snapshot path")
+    sp.add_argument("--no-witness", action="store_true",
+                    dest="no_witness",
+                    help="structural proofs only: would-be witness "
+                         "executions report SKIPPED instead of running")
+    sp.add_argument("--flops", action="store_true",
+                    help="include XLA cost_analysis flops in "
+                         "fingerprints (lowers every program)")
+    sp.add_argument("--module", default="",
+                    help="lint EQUIVLINT_PROGRAMS from a Python file "
+                         "instead of the engine registry (P-rules "
+                         "fixture seam)")
+
+    sp = sub.add_parser(
         "check",
-        help="the umbrella pass: tracelint + jaxlint + rangelint in "
-             "one run, each registry program traced once, merged "
-             "--format json, shared exit-code contract",
+        help="the umbrella pass: tracelint + jaxlint + rangelint + "
+             "equivlint in one run, each registry program traced once, "
+             "merged --format json, shared exit-code contract",
     )
     sp.set_defaults(fn=cmd_check)
     sp.add_argument("--format", choices=["text", "json"], default="text",
@@ -281,6 +315,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--budget-gb", type=float, default=16.0,
                     dest="budget_gb",
                     help="per-chip HBM budget for jaxlint J6")
+    sp.add_argument("--changed", action="store_true",
+                    help="git-diff-aware pre-commit mode: lint/prove "
+                         "only programs whose family sources changed "
+                         "vs HEAD (core-plane edits widen to the full "
+                         "registry)")
+    sp.add_argument("--no-witness", action="store_true",
+                    dest="no_witness",
+                    help="equivlint structural proofs only (skip "
+                         "witness executions)")
 
     # simulator -----------------------------------------------------------
     sp = sub.add_parser(
@@ -1208,6 +1251,35 @@ async def cmd_rangelint(args) -> int:
     return rangelint_main(argv)
 
 
+async def cmd_equivlint(args) -> int:
+    """Exactness-ladder prover over the declared EQUIV_PAIRS (E1),
+    golden program-fingerprint gate (E2/E3), and Pallas DMA-discipline
+    rules (P1-P3) — consul_tpu.analysis.equivlint.  Exit-code contract
+    mirrors ``cli jaxlint``: nonzero on any FAILED verdict, golden
+    diff, or Pallas finding."""
+    from consul_tpu.analysis.equivlint import main as equivlint_main
+
+    argv = []
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.format != "text":
+        argv.extend(["--format", args.format])
+    argv.extend(
+        ["--set", "small,big" if args.which == "all" else args.which]
+    )
+    if args.update_golden:
+        argv.append("--update-golden")
+    if args.golden:
+        argv.extend(["--golden", args.golden])
+    if args.no_witness:
+        argv.append("--no-witness")
+    if args.flops:
+        argv.append("--flops")
+    if args.module:
+        argv.extend(["--module", args.module])
+    return equivlint_main(argv)
+
+
 async def cmd_check(args) -> int:
     """The umbrella subcommand: tracelint + jaxlint + rangelint in one
     pass (each registry program traced ONCE, shared by both jaxpr
@@ -1229,20 +1301,24 @@ async def cmd_check(args) -> int:
     include = (
         ("small", "big") if args.which == "all" else (args.which,)
     )
-    out = run_check(include=include, budget_gb=args.budget_gb)
+    out = run_check(include=include, budget_gb=args.budget_gb,
+                    changed=args.changed,
+                    witness=not args.no_witness)
     if args.format == "json":
         print(json.dumps(out))
         return 0 if out["clean"] else 1
     for v in out["tracelint"]["violations"]:
         print(f"{v['path']}:{v['line']}:{v['col']} {v['rule']} "
               f"{v['message']}")
-    for key in ("jaxlint", "rangelint"):
+    for key in ("jaxlint", "rangelint", "equivlint"):
         for f in out[key]["findings"]:
             where = f["where"] or "<program>"
             print(f"{f['program']}: {where} {f['rule']} {f['message']}")
+    el = out["equivlint"]
     n_bad = (len(out["tracelint"]["violations"])
              + len(out["jaxlint"]["findings"])
-             + len(out["rangelint"]["findings"]))
+             + len(out["rangelint"]["findings"])
+             + len(el["findings"]))
     walls = ", ".join(
         f"{k} {v}s" for k, v in out["wall_s"].items()
     )
@@ -1254,7 +1330,10 @@ async def cmd_check(args) -> int:
         f"check: {'clean' if out['clean'] else f'{n_bad} finding(s)'} "
         f"({out['tracelint']['files']} file(s), "
         f"{out['jaxlint']['programs']} program(s), "
-        f"{n_certs} narrowing certificate(s); {walls})",
+        f"{n_certs} narrowing certificate(s), "
+        f"{el['proved']} proved + {el['witnessed']} witnessed of "
+        f"{el['pairs']} pair(s), {el['golden_diffs']} golden diff(s); "
+        f"{walls})",
         file=sys.stderr,
     )
     return 0 if out["clean"] else 1

@@ -72,6 +72,30 @@ from consul_tpu.parallel.mesh import NODE_AXIS, block_size
 OUTBOX_SAFETY = 2   # c: budget multiple of the per-destination mean
 OUTBOX_FLOOR = 64   # never fewer slots than this (small-n studies)
 
+# Equivalence-ladder pair metadata (consul_tpu/analysis/equivlint.py):
+# sharded registry-key prefix -> the unsharded family it must be
+# bit-equal to at D == 1.  sim.engine.EQUIV_PAIRS expands this into
+# the declared D=1 and ring==alltoall rungs, so adding a sharded twin
+# here is what puts it ON the ladder — one dict line, not one runtime
+# test per axis point.
+SHARDED_TWINS = {
+    "sharded_broadcast": "broadcast",
+    "sharded_membership": "membership",
+    "sharded_sparse": "sparse",
+    "sharded_streamcast": "streamcast",
+    "sharded_geo": "geo",
+}
+
+# Sharded twins whose outs tuple appends ONE trailing leaf (the outbox
+# overflow counter) relative to the unsharded program — the D=1
+# witness compares through a drop-last projection for these.  The
+# sparse twin folds outbox misses into the family's own overflow
+# output, so its outputs align 1:1 with the unsharded scan.
+SHARDED_EXTRA_OVERFLOW = frozenset({
+    "sharded_broadcast", "sharded_membership", "sharded_streamcast",
+    "sharded_geo",
+})
+
 
 # ---------------------------------------------------------------------------
 # Outbox: fixed-budget cross-shard message routing.

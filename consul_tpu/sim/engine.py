@@ -1004,6 +1004,13 @@ class SimProgram:
     # n' (the 10M-node narrowing-ledger hook).
     bounds: Optional[Callable[[], Any]] = None
     scale: Optional[Callable[[int], "SimProgram"]] = None
+    # equivlint witness seam (consul_tpu/analysis/equivlint.py):
+    # ``init`` rebuilds the CONCRETE initial state (the same callable
+    # build() eval_shapes), so a declared EQUIV_PAIR the canonicalizer
+    # cannot close gets its one tiny-shape witness execution as
+    # ``fn(init(), PRNGKey(0))``.  None for programs whose args are not
+    # (state, key)-shaped (the sweep plane carries its own builders).
+    init: Optional[Callable[[], Any]] = None
 
     def trace(self) -> Any:
         fn, args = self.build()
@@ -1294,7 +1301,7 @@ def jaxlint_registry(include=("small", "big"),
 
         programs[name] = SimProgram(
             name=name, entrypoint=entrypoint, build=build, n=n,
-            devices=devices, **kw,
+            devices=devices, init=init, **kw,
         )
 
     def add_sharded(tag: str, d: int, bcfg, bsteps, mcfg, msteps, mtrack,
@@ -1418,6 +1425,32 @@ def jaxlint_registry(include=("small", "big"),
                 stcfg.n, bounds=_streamcast_bounds(stcfg_p))
             for d in sharded_devices:
                 add_sharded_streamcast(f"small/{pol}", d, stcfg_p, 8)
+        # Explicit-default twins: the SAME program spelled with its
+        # defaults written out — policy="uniform" explicit, telemetry
+        # False explicit, sparse amortize auto resolved to its value.
+        # These are the PROVED rungs of the exactness ladder
+        # (EQUIV_PAIRS below): equivlint closes each by canonical-
+        # jaxpr identity, zero executions, so "a preset is just a
+        # point in knob space" stays machine-checked as the knob
+        # surface grows (ROADMAP item 1).
+        stcfg_u = dataclasses.replace(stcfg, policy="uniform")
+        add("streamcast@small/uniform", "streamcast_scan",
+            lambda: streamcast_init(stcfg_u),
+            lambda s, k: streamcast_scan(s, k, stcfg_u, 8), stcfg.n,
+            bounds=_streamcast_bounds(stcfg_u))
+        add("broadcast@small/notelemetry", "broadcast_scan",
+            lambda: broadcast_init(bcfg),
+            lambda s, k: broadcast_scan(s, k, bcfg, 8, False), bcfg.n,
+            bounds=_broadcast_bounds(bcfg))
+        from consul_tpu.models.membership_sparse import resolve_amortize
+
+        scfg_am = dataclasses.replace(
+            scfg, amortize=resolve_amortize(scfg)
+        )
+        add("sparse@small/amortize", "sparse_membership_scan",
+            lambda: sparse_membership_init(scfg_am),
+            lambda s, k: sparse_membership_scan(s, k, scfg_am, 8, (3,)),
+            mcfg.n, bounds=_sparse_bounds(scfg_am))
         # Adversarial-load twin (sim/load.py): standing backlog +
         # heavy-tailed sizes + hotspot origins — the born-delivered
         # chunk-mask and backlog-pinning paths under the gates.
@@ -1748,3 +1781,159 @@ def jaxlint_registry(include=("small", "big"),
             add_sweep("100k", "sparse", scfg100k, 3, u,
                       ("base.loss",), (42,), 100_000)
     return programs
+
+
+# ---------------------------------------------------------------------------
+# EQUIV_PAIRS: the exactness ladder as DATA.
+#
+# Each rung of the repo's bit-equality ladder — D == 1 is the unsharded
+# program, ring == alltoall, U == 1 is the plain scan, telemetry=off is
+# the identity, explicit defaults == omitted flags — declared as one
+# EquivPair of registry keys + the input relation, certified by
+# consul_tpu/analysis/equivlint.py: structural canonical-jaxpr identity
+# (PROVED) where the two builds trace to the same program, one shared
+# tiny-shape witness execution (WITNESSED) where they are genuinely
+# different programs with equal projected outputs.  Runtime bit-
+# equality tests for WITNESSED rungs keep one tier-1 representative per
+# family; the rest ride `-m slow` (tests/test_shard.py, test_obs.py).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EquivPair:
+    """One declared ladder rung: registry keys ``a``/``b`` plus the
+    input relation.  ``project_a``/``project_b`` map each side's raw
+    output pytree onto the common comparison domain (e.g. drop the
+    sharded twin's trailing overflow leaf); ``args_a``/``args_b``
+    override the default witness args ``(init(), PRNGKey(0))`` for
+    programs with differently-shaped inputs (the sweep plane's
+    ``(stacked_state, keys, knob_values)``)."""
+
+    a: str
+    b: str
+    relation: str
+    family: str
+    project_a: Optional[Callable[[Any], Any]] = None
+    project_b: Optional[Callable[[Any], Any]] = None
+    args_a: Optional[Callable[[], tuple]] = None
+    args_b: Optional[Callable[[], tuple]] = None
+    note: str = ""
+
+
+def _drop_last_out(out):
+    """(final, (outs..., extra)) -> (final, outs) — strips the trailing
+    leaf a sharded twin (outbox overflow) or telemetry twin (metrics
+    trace) appends to the unsharded/off program's outs tuple."""
+    final, outs = out
+    return (final, tuple(outs)[:-1])
+
+
+def _scalar_out(out):
+    """(final, (scalar_plane, extra)) -> (final, scalar_plane) — the
+    broadcast family's unsharded outs is a bare array, so its twins
+    project to element 0 rather than a shorter tuple."""
+    final, outs = out
+    return (final, outs[0])
+
+
+def _squeeze_u(out):
+    """Drop the leading U=1 universe axis from every leaf — the sweep
+    twin's outputs are the plain scan's stacked once."""
+    return jax.tree_util.tree_map(lambda x: x[0], out)
+
+
+def _sweep_u1_args(model: str) -> Callable[[], tuple]:
+    """Concrete witness args for a U=1 sweep twin: the plain program's
+    init stacked to [1, ...], PRNGKey(0) as the single universe key,
+    and the config's OWN value for each knob — exactly the relation the
+    U=1 rung claims (sweeping a knob at its default is the plain
+    scan)."""
+
+    def make() -> tuple:
+        from consul_tpu.sweep.universe import (
+            SWEEP_ENTRYPOINTS,
+            knob_dtype,
+            _resolve_path,
+        )
+
+        if model == "swim":
+            cfg = SwimConfig(n=64, subject=1, loss=0.05)
+            knobs = ("loss",)
+        elif model == "broadcast":
+            cfg = BroadcastConfig(n=64, fanout=3, delivery="edges")
+            knobs = ("loss",)
+        else:
+            raise ValueError(f"no U=1 witness builder for {model!r}")
+        spec = SWEEP_ENTRYPOINTS[model]
+        state = spec.init(cfg)
+        stacked = jax.tree_util.tree_map(lambda a: a[None], state)
+        keys = jax.random.PRNGKey(0)[None]
+        values = tuple(
+            jnp.full((1,), getattr(*_resolve_path(cfg, p)),
+                     knob_dtype(p))
+            for p in knobs
+        )
+        return (stacked, keys, values)
+
+    return make
+
+
+def _build_equiv_pairs() -> tuple:
+    from consul_tpu.parallel.shard import (
+        SHARDED_EXTRA_OVERFLOW,
+        SHARDED_TWINS,
+    )
+
+    pairs = [
+        # Explicit-default rungs — same program, different spelling:
+        # the canonicalizer closes these structurally (PROVED).
+        EquivPair("streamcast@small/uniform", "streamcast@small",
+                  relation="flag omitted: policy='uniform' == default",
+                  family="streamcast"),
+        EquivPair("broadcast@small/notelemetry", "broadcast@small",
+                  relation="flag omitted: telemetry=False == default",
+                  family="broadcast"),
+        EquivPair("sparse@small/amortize", "sparse@small",
+                  relation="amortize auto == explicit resolved value",
+                  family="sparse"),
+    ]
+    for sharded, family in sorted(SHARDED_TWINS.items()):
+        if sharded == "sharded_broadcast":
+            proj = _scalar_out
+        elif sharded in SHARDED_EXTRA_OVERFLOW:
+            proj = _drop_last_out
+        else:
+            proj = None  # outputs align 1:1 (sparse)
+        pairs.append(EquivPair(
+            f"{sharded}@small/D1", f"{family}@small",
+            relation="D=1 slice == unsharded", family=family,
+            project_a=proj,
+        ))
+        pairs.append(EquivPair(
+            f"{sharded}@small/D2/ring", f"{sharded}@small/D2",
+            relation="ring == alltoall (D=2)", family=family,
+        ))
+    for family, proj in (
+        ("broadcast", _scalar_out),
+        ("membership", _drop_last_out),
+        ("sparse", _drop_last_out),
+        ("swim", _drop_last_out),
+        ("lifeguard", _drop_last_out),
+        ("streamcast", _drop_last_out),
+        ("geo", _drop_last_out),
+    ):
+        pairs.append(EquivPair(
+            f"{family}@small/telemetry", f"{family}@small",
+            relation="telemetry == off on every existing output",
+            family=family, project_a=proj,
+        ))
+    for model in ("swim", "broadcast"):
+        pairs.append(EquivPair(
+            f"sweep_{model}@small/U1", f"{model}@small",
+            relation="U=1 sweep == plain scan", family=model,
+            project_a=_squeeze_u, args_a=_sweep_u1_args(model),
+        ))
+    return tuple(pairs)
+
+
+EQUIV_PAIRS: tuple = _build_equiv_pairs()

@@ -1,0 +1,127 @@
+"""Planted Pallas DMA-discipline fixtures for equivlint's P1-P3 rules.
+
+Each entry is a tiny Mosaic kernel with ONE deliberate violation (or
+none, for the clean controls): the bad/clean pairs pin that
+``consul_tpu.analysis.equivlint.pallas_findings`` catches exactly the
+planted defect with file:line provenance into THIS file, and nothing
+else.  ``EQUIVLINT_PROGRAMS`` (name -> (fn, args)) is the
+``cli equivlint --module`` contract, mirroring jaxlint's
+``JAXLINT_PROGRAMS`` fixture seam — tracing only, nothing here is ever
+executed, so the deadlocking kernels are safe to import.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SHAPE = (8, 128)
+
+
+def _call(kernel, *, sems, interpret=True, collective_id=None):
+    """pallas_call wrapper shared by every fixture: ANY-space refs (the
+    ring kernel's convention) and DMA scratch semaphores."""
+    params = {}
+    if collective_id is not None:
+        params["compiler_params"] = pltpu.TPUCompilerParams(
+            collective_id=collective_id
+        )
+
+    def fn(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(_SHAPE, jnp.int32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=list(sems),
+            interpret=interpret,
+            **params,
+        )(x)
+
+    return fn
+
+
+def _clean_local(in_ref, out_ref, sem):
+    copy = pltpu.make_async_copy(in_ref, out_ref, sem)
+    copy.start()
+    copy.wait()
+
+
+def _p1_missing_wait(in_ref, out_ref, sem):
+    copy = pltpu.make_async_copy(in_ref, out_ref, sem)
+    copy.start()  # planted P1: never waited
+
+
+def _p1_wait_without_start(in_ref, out_ref, sem):
+    copy = pltpu.make_async_copy(in_ref, out_ref, sem)
+    copy.wait()  # planted P1: nothing in flight
+
+
+def _p2_slot_reuse(in_ref, out_ref, sem):
+    # Double-buffered semaphore used WITHOUT the discipline: slot 0 is
+    # restarted while its first copy is still in flight — the h%2 race
+    # the ring kernel's start(h+1)-before-wait(h) pipeline avoids by
+    # alternating slots.
+    first = pltpu.make_async_copy(in_ref.at[0], out_ref.at[0],
+                                  sem.at[0])
+    first.start()
+    second = pltpu.make_async_copy(in_ref.at[1], out_ref.at[1],
+                                   sem.at[0])
+    second.start()  # planted P2: slot 0 still in flight
+    second.wait()
+    first.wait()
+
+
+def _p2_clean_double_buffer(in_ref, out_ref, sem):
+    # The correct spelling of the same pipeline: alternate slots, so
+    # two copies are in flight on DIFFERENT slots (the ring kernel's
+    # schedule) — must NOT fire.
+    first = pltpu.make_async_copy(in_ref.at[0], out_ref.at[0],
+                                  sem.at[0])
+    first.start()
+    second = pltpu.make_async_copy(in_ref.at[1], out_ref.at[1],
+                                   sem.at[1])
+    second.start()
+    first.wait()
+    second.wait()
+
+
+def _p2_touch_dst(in_ref, out_ref, sem):
+    copy = pltpu.make_async_copy(in_ref, out_ref, sem)
+    copy.start()
+    out_ref[0, 0]  # planted P2: read of the in-flight destination
+    copy.wait()
+
+
+def _barrier_kernel(in_ref, out_ref, sem):
+    bar = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bar, 1)
+    pltpu.semaphore_wait(bar, 1)
+    copy = pltpu.make_async_copy(in_ref, out_ref, sem)
+    copy.start()
+    copy.wait()
+
+
+_ARGS = (jax.ShapeDtypeStruct(_SHAPE, jnp.int32),)
+_DMA = pltpu.SemaphoreType.DMA
+_DMA2 = pltpu.SemaphoreType.DMA((2,))
+
+EQUIVLINT_PROGRAMS = {
+    "clean_local": (_call(_clean_local, sems=(_DMA,)), _ARGS),
+    "p1_missing_wait": (_call(_p1_missing_wait, sems=(_DMA,)), _ARGS),
+    "p1_wait_without_start": (
+        _call(_p1_wait_without_start, sems=(_DMA,)), _ARGS),
+    "p2_slot_reuse": (_call(_p2_slot_reuse, sems=(_DMA2,)), _ARGS),
+    "p2_clean_double_buffer": (
+        _call(_p2_clean_double_buffer, sems=(_DMA2,)), _ARGS),
+    "p2_touch_dst": (_call(_p2_touch_dst, sems=(_DMA,)), _ARGS),
+    # P3 pair: the SAME barrier kernel, once under interpret=True (the
+    # interpreter neither supports nor needs the barrier) and once on
+    # "hardware" without a collective_id (Mosaic cannot match the
+    # barrier across programs).  Tracing only — never lowered.
+    "p3_barrier_under_interpret": (
+        _call(_barrier_kernel, sems=(_DMA,), interpret=True,
+              collective_id=7), _ARGS),
+    "p3_barrier_no_collective_id": (
+        _call(_barrier_kernel, sems=(_DMA,), interpret=False), _ARGS),
+}

@@ -1,0 +1,462 @@
+"""equivlint: the exactness-ladder prover (E1), the golden
+program-fingerprint gate (E2/E3), and the Pallas DMA-discipline rules
+(P1-P3).
+
+Tier-1 carries the whole certification story: the canonicalizer's
+algebraic properties, every declared EQUIV_PAIR closing as PROVED or
+WITNESSED (zero FAILED — this is the gate that let the runtime
+bit-equality duplicates move behind ``-m slow``), the committed golden
+snapshot diffing clean, and the planted DMA fixtures firing with
+file:line provenance while the real ring kernel passes silent.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consul_tpu.analysis.equivlint import (
+    EQUIV_RULES,
+    canonical_hash,
+    canonicalize,
+    changed_program_keys,
+    diff_golden,
+    fingerprint,
+    git_changed_files,
+    load_golden,
+    pallas_findings,
+    prove_pairs,
+    write_golden,
+)
+from consul_tpu.sim.engine import EQUIV_PAIRS, SimProgram, jaxlint_registry
+
+SDS = jax.ShapeDtypeStruct
+_VEC = SDS((16,), jnp.float32)
+
+
+def _hash(fn, *args):
+    return canonical_hash(jax.make_jaxpr(fn)(*args))
+
+
+def _program(name, fn, *args):
+    return SimProgram(name=name, entrypoint=name,
+                      build=lambda: (fn, tuple(args)), n=0)
+
+
+# ---------------------------------------------------------------------------
+# Registry fixtures: trace once per module, share across tests.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_programs():
+    return jaxlint_registry(include=("small",))
+
+
+@pytest.fixture(scope="module")
+def small_traces(small_programs):
+    return {n: p.trace() for n, p in small_programs.items()}
+
+
+@pytest.fixture(scope="module")
+def small_verdicts(small_programs, small_traces):
+    return prove_pairs(small_programs, traces=small_traces)
+
+
+# ---------------------------------------------------------------------------
+# Canonicalizer properties: what must NOT move the hash, and what must.
+# ---------------------------------------------------------------------------
+
+
+class TestCanonicalizer:
+    def test_alpha_renamed_locals_identical(self):
+        def a(x, y):
+            acc = x * 2.0
+            gain = acc + y
+            return gain - 1.0
+
+        def b(p, q):
+            t0 = p * 2.0
+            t1 = t0 + q
+            return t1 - 1.0
+
+        assert _hash(a, _VEC, _VEC) == _hash(b, _VEC, _VEC)
+
+    def test_commutative_operand_permutation_identical(self):
+        def a(x, y):
+            return x + y, x * y, jnp.maximum(x, y)
+
+        def b(x, y):
+            return y + x, y * x, jnp.maximum(y, x)
+
+        assert _hash(a, _VEC, _VEC) == _hash(b, _VEC, _VEC)
+
+    def test_noncommutative_operand_swap_differs(self):
+        # The sort is restricted to commutative primitives: x - y and
+        # y - x are DIFFERENT programs and must hash apart.
+        assert (_hash(lambda x, y: x - y, _VEC, _VEC)
+                != _hash(lambda x, y: y - x, _VEC, _VEC))
+
+    def test_dead_code_padding_identical(self):
+        def lean(x):
+            return x * 3.0
+
+        def padded(x):
+            waste = jnp.sum(jnp.sin(x)) + 41.0  # dead: never escapes
+            del waste
+            return x * 3.0
+
+        assert _hash(lean, _VEC) == _hash(padded, _VEC)
+
+    def test_changed_constant_differs(self):
+        # The anti-property: a genuinely different program (the fanout
+        # knob moved) must NOT canonicalize together.
+        assert (_hash(lambda x: x * 3.0, _VEC)
+                != _hash(lambda x: x * 4.0, _VEC))
+
+    def test_dead_code_in_scan_body_identical(self):
+        def lean(c, xs):
+            return jax.lax.scan(lambda c, x: (c + x, c), c, xs)
+
+        def padded(c, xs):
+            def tick(c, x):
+                waste = jnp.cos(x) * 7.0
+                del waste
+                return c + x, c
+
+            return jax.lax.scan(tick, c, xs)
+
+        args = (SDS((), jnp.float32), SDS((8,), jnp.float32))
+        assert _hash(lean, *args) == _hash(padded, *args)
+
+    def test_canonical_text_has_no_addresses(self, small_traces):
+        # Process stability: id()-derived reprs (0x7f...) in any param
+        # would make the committed golden machine-local garbage.
+        text = canonicalize(
+            small_traces["sharded_broadcast@small/D1/ring"]
+        )
+        assert "0x" not in text
+
+
+# ---------------------------------------------------------------------------
+# E1: the declared ladder closes — the certificate that retired the
+# runtime bit-equality duplicates into -m slow.
+# ---------------------------------------------------------------------------
+
+
+class TestPairGate:
+    def test_every_pair_closes(self, small_verdicts):
+        bad = [v for v in small_verdicts
+               if v.verdict not in ("PROVED", "WITNESSED")]
+        assert len(small_verdicts) == len(EQUIV_PAIRS)
+        assert not bad, "\n".join(v.format() for v in bad)
+
+    def test_explicit_default_pairs_prove_structurally(self,
+                                                      small_verdicts):
+        # The defaults-are-defaults rungs (streamcast uniform policy,
+        # telemetry=False, amortize auto-resolution) are projection-
+        # free and must close WITHOUT spending a witness execution.
+        proved = {v.pair for v in small_verdicts
+                  if v.verdict == "PROVED"}
+        for key in ("streamcast@small/uniform",
+                    "broadcast@small/notelemetry",
+                    "sparse@small/amortize"):
+            assert any(key in p for p in proved), (key, proved)
+
+    def test_every_family_keeps_a_witnessed_rung(self, small_verdicts):
+        # Satellite contract: one WITNESSED representative per sharded
+        # family stays in tier-1 so the ladder is exercised end to end
+        # even with the duplicate runtime tests behind -m slow.
+        witnessed = " ".join(v.pair for v in small_verdicts
+                             if v.verdict == "WITNESSED")
+        for family in ("broadcast", "membership", "sparse",
+                       "streamcast", "geo", "swim"):
+            assert family in witnessed, (family, witnessed)
+
+    def test_witness_divergence_is_loud(self):
+        # A pair that is NOT equivalent must come back FAILED with the
+        # divergence named — never silently dropped.  Structurally
+        # distinct (different constant), so the prover spends the
+        # witness execution, which catches the bit divergence.
+        from consul_tpu.sim.engine import EquivPair
+
+        key_sds = SDS((2,), jnp.uint32)
+
+        def _p(name, k):
+            return SimProgram(
+                name=name, entrypoint=name,
+                build=lambda: (lambda x, key: x * k, (_VEC, key_sds)),
+                n=0, init=lambda: jnp.ones(16, jnp.float32),
+            )
+
+        progs = {"three@t": _p("three@t", 3.0),
+                 "four@t": _p("four@t", 4.0)}
+        bad = EquivPair(a="three@t", b="four@t",
+                        relation="planted-divergence", family="test")
+        [v] = prove_pairs(progs, pairs=(bad,))
+        assert v.verdict == "FAILED"
+        assert v.detail
+
+    def test_witness_without_init_fails_loudly(self):
+        # A registry entry predating the init seam cannot be silently
+        # skipped: the verdict is FAILED and names the hole.
+        from consul_tpu.sim.engine import EquivPair
+
+        progs = {
+            "a@t": _program("a@t", lambda x: x * 3.0, _VEC),
+            "b@t": _program("b@t", lambda x: x * 4.0, _VEC),
+        }
+        pair = EquivPair(a="a@t", b="b@t", relation="no-init",
+                         family="test")
+        [v] = prove_pairs(progs, pairs=(pair,))
+        assert v.verdict == "FAILED"
+        assert "init" in v.detail
+
+    def test_missing_side_skips_loudly(self, small_programs):
+        from consul_tpu.sim.engine import EquivPair
+
+        ghost = EquivPair(a="broadcast@small", b="nonesuch@small",
+                          relation="ghost", family="test")
+        [v] = prove_pairs(small_programs, pairs=(ghost,))
+        assert v.verdict == "SKIPPED"
+        assert "nonesuch" in v.detail
+
+
+# ---------------------------------------------------------------------------
+# E2/E3: the golden fingerprint gate.
+# ---------------------------------------------------------------------------
+
+
+class TestGoldenGate:
+    @pytest.fixture(scope="class")
+    def live(self, small_programs, small_traces):
+        return {n: fingerprint(p, traced=small_traces[n])
+                for n, p in small_programs.items()}
+
+    def test_small_registry_diff_clean(self, live):
+        # The committed snapshot matches the live registry — the gate
+        # that replaced test_jaxlint's hand-pinned eqn counts.
+        findings = diff_golden(live, subset=True)
+        assert not findings, "\n".join(f.format() for f in findings)
+
+    def test_golden_covers_both_tiers(self):
+        golden = load_golden()["programs"]
+        assert any("@small" in n for n in golden)
+        assert any(n.endswith("@1m") for n in golden)
+
+    def test_drift_fires_e2_with_detail(self, live):
+        import dataclasses
+
+        name = "broadcast@small"
+        gold = load_golden()
+        mutated = dict(live)
+        mutated[name] = dataclasses.replace(
+            live[name], hash="0" * 64, eqns=live[name].eqns + 50,
+        )
+        rules = {f.rule for f in diff_golden(mutated, gold, subset=True)
+                 if f.program == name}
+        assert rules == {"E2"}
+        [f] = [f for f in diff_golden(mutated, gold, subset=True)
+               if f.program == name]
+        assert "eqns" in f.message  # says WHAT moved, not just that
+
+    def test_coverage_holes_fire_e3_both_directions(self, live):
+        gold = load_golden()
+        pruned = {
+            "meta": gold["meta"],
+            "programs": {k: v for k, v in gold["programs"].items()
+                         if k != "broadcast@small"},
+        }
+        live_extra = dict(live)
+        live_extra["newcomer@small"] = live["broadcast@small"]
+        findings = diff_golden(live_extra, pruned, subset=False)
+        holes = {f.program for f in findings if f.rule == "E3"}
+        assert "broadcast@small" in holes  # live without golden
+        # golden-without-live (the full small+big golden vs the small
+        # slice) is suppressed under subset=True only:
+        assert not [f for f in diff_golden(live, pruned, subset=True)
+                    if f.program not in live]
+
+    def test_write_golden_round_trip_and_merge(self, live, tmp_path):
+        path = tmp_path / "programs.json"
+        first = {"broadcast@small": live["broadcast@small"]}
+        write_golden(first, path=str(path))
+        second = {"membership@small": live["membership@small"]}
+        write_golden(second, path=str(path))  # merge keeps broadcast
+        snap = load_golden(str(path))
+        assert set(snap["programs"]) == {"broadcast@small",
+                                         "membership@small"}
+        assert not diff_golden(
+            {k: live[k] for k in snap["programs"]}, snap, subset=True
+        )
+
+    def test_eqn_counts_ride_the_golden(self, live):
+        # The successor of test_jaxlint's PINS table: the exact eqn
+        # counts now live in the committed snapshot, compared with
+        # equality (not +-20%) because the hash pins the whole jaxpr.
+        golden = load_golden()["programs"]
+        for name in ("broadcast@small", "membership@small",
+                     "sparse@small"):
+            assert live[name].eqns == golden[name]["eqns"]
+
+
+# ---------------------------------------------------------------------------
+# P1-P3: Pallas DMA discipline — planted fixtures fire, the real ring
+# kernel is silent.
+# ---------------------------------------------------------------------------
+
+
+class TestPallasRules:
+    @pytest.fixture(scope="class")
+    def fixture_findings(self):
+        import equivlint_fixtures as fx
+
+        out = {}
+        for name, (fn, args) in fx.EQUIVLINT_PROGRAMS.items():
+            out[name] = pallas_findings(name, jax.make_jaxpr(fn)(*args))
+        return out
+
+    def _rules(self, findings):
+        return [f.rule for f in findings]
+
+    def test_clean_fixtures_silent(self, fixture_findings):
+        assert fixture_findings["clean_local"] == []
+        assert fixture_findings["p2_clean_double_buffer"] == []
+
+    def test_p1_missing_wait(self, fixture_findings):
+        [f] = fixture_findings["p1_missing_wait"]
+        assert f.rule == "P1"
+        assert "equivlint_fixtures.py" in f.where
+
+    def test_p1_wait_without_start(self, fixture_findings):
+        [f] = fixture_findings["p1_wait_without_start"]
+        assert f.rule == "P1"
+
+    def test_p2_slot_reuse(self, fixture_findings):
+        # The h%2 double-buffer race: the planted P2 plus the
+        # consequent P1 (the clobbered first start is never waited).
+        rules = self._rules(fixture_findings["p2_slot_reuse"])
+        assert "P2" in rules
+        [p2] = [f for f in fixture_findings["p2_slot_reuse"]
+                if f.rule == "P2"]
+        assert "equivlint_fixtures.py" in p2.where
+        assert "slot" in p2.message
+
+    def test_p2_touch_dst(self, fixture_findings):
+        [f] = fixture_findings["p2_touch_dst"]
+        assert f.rule == "P2"
+        assert "destination" in f.message
+
+    def test_p3_barrier_fixtures(self, fixture_findings):
+        [a] = fixture_findings["p3_barrier_under_interpret"]
+        [b] = fixture_findings["p3_barrier_no_collective_id"]
+        assert a.rule == b.rule == "P3"
+        assert "interpret" in a.message
+        assert "collective_id" in b.message
+
+    def test_ring_registry_programs_clean(self, small_traces):
+        # The production kernel (start(h+1)-before-wait(h) double
+        # buffering, barrier behind the interpret seam): every sharded
+        # /ring registry entry must pass P1-P3 silent.
+        ring = {n: t for n, t in small_traces.items() if "/ring" in n}
+        assert ring, "registry lost its ring-backend entries"
+        for name, traced in ring.items():
+            assert pallas_findings(name, traced) == [], name
+
+
+# ---------------------------------------------------------------------------
+# --changed: git-diff-aware program selection.
+# ---------------------------------------------------------------------------
+
+
+class TestChangedSelection:
+    NAMES = ("broadcast@small", "sharded_broadcast@small/ring",
+             "sweep_swim@small/U8", "sparse@big", "streamcast@small",
+             "geo@small", "lifeguard@small")
+
+    def _progs(self):
+        return {n: None for n in self.NAMES}
+
+    def test_family_edit_selects_family_twins(self):
+        keys = changed_program_keys(
+            self._progs(), ["consul_tpu/models/broadcast.py"]
+        )
+        assert keys == {"broadcast@small",
+                        "sharded_broadcast@small/ring"}
+
+    def test_membership_edit_selects_sparse_too(self):
+        keys = changed_program_keys(
+            self._progs(), ["consul_tpu/models/membership.py"]
+        )
+        assert "sparse@big" in keys
+
+    def test_core_edit_selects_everything(self):
+        for core in ("consul_tpu/sim/engine.py",
+                     "consul_tpu/ops/ring_exchange.py",
+                     "consul_tpu/parallel/shard.py"):
+            assert changed_program_keys(
+                self._progs(), [core]
+            ) == set(self.NAMES), core
+
+    def test_unrelated_edit_selects_nothing(self):
+        assert changed_program_keys(
+            self._progs(), ["README.md", "tests/test_equivlint.py"]
+        ) == set()
+
+    def test_git_changed_files_runs(self):
+        assert isinstance(git_changed_files(), list)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (mirrors cli jaxlint: nonzero on findings, --format json
+# for CI, planted fixtures through --module).
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _run(self, argv):
+        from consul_tpu.cli import build_parser
+
+        args = build_parser().parse_args(argv)
+        return asyncio.run(args.fn(args))
+
+    def test_list_rules(self, capsys):
+        assert self._run(["equivlint", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in EQUIV_RULES:
+            assert rule in out
+
+    def test_planted_fixtures_exit_nonzero(self, capsys):
+        import equivlint_fixtures as fx
+
+        assert self._run(["equivlint", "--module", fx.__file__]) == 1
+        out = capsys.readouterr().out
+        for rule in ("P1", "P2", "P3"):
+            assert rule in out
+        assert "equivlint_fixtures.py" in out
+
+    def test_planted_fixtures_json(self, capsys):
+        import equivlint_fixtures as fx
+
+        assert self._run(["equivlint", "--module", fx.__file__,
+                          "--format", "json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert {f["rule"] for f in payload} == {"P1", "P2", "P3"}
+
+    def test_check_parser_accepts_changed_flags(self):
+        from consul_tpu.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["check", "--changed", "--no-witness"]
+        )
+        assert args.changed and args.no_witness
+
+    @pytest.mark.slow
+    def test_small_set_structural_clean(self, capsys):
+        # --no-witness: structural proofs + golden gate only.  The
+        # witnessed ladder is tier-1's TestPairGate; this is the CLI
+        # exit-code contract over the same registry.
+        assert self._run(["equivlint", "--set", "small",
+                          "--no-witness"]) == 0

@@ -22,7 +22,6 @@ from consul_tpu.analysis.guards import ENGINE_ENTRYPOINTS
 from consul_tpu.analysis.jaxlint import (
     RULES,
     analyze_jaxpr,
-    eqn_count,
     estimate_peak,
     format_bytes,
     lint_programs,
@@ -692,34 +691,10 @@ class TestSweepFootprint:
         assert abs(p4 - predicted) / p4 < 0.05, (p1, p4, p8, predicted)
 
 
-class TestGoldenProgramSize:
-    """Accidental program bloat (an unrolled loop sneaking into a
-    round) fails tier-1 loudly instead of surfacing as a compile-time
-    regression.  Counts include every sub-jaxpr equation."""
-
-    # Re-pinned for the owned-draws randomness plane: every per-node
-    # draw site gained the vmapped fold_in key derivation
-    # (ops/sampling.owned_keys) — a few equations per site — net of
-    # the compact_to_budget consolidation.
-    PINS = {
-        "broadcast@small": 142,
-        "membership@small": 928,
-        "sparse@small": 3022,
-    }
-    RTOL = 0.2
-
-    @pytest.mark.parametrize("name", sorted(PINS))
-    def test_eqn_count_pinned(self, name, small_traces):
-        expected = self.PINS[name]
-        got = eqn_count(small_traces[name])
-        lo, hi = int(expected * (1 - self.RTOL)), int(
-            expected * (1 + self.RTOL)
-        )
-        assert lo <= got <= hi, (
-            f"{name}: {got} equations vs pinned {expected} "
-            f"(allowed [{lo}, {hi}]) — program size shifted; if "
-            "intentional, update the pin"
-        )
+# Program-size pinning moved to the golden fingerprint gate: exact
+# per-program eqn counts (not +-20% hand pins) now live in
+# tests/golden/programs.json, diffed by equivlint E2 on every
+# `cli check` and asserted in tests/test_equivlint.py.
 
 
 # ---------------------------------------------------------------------------

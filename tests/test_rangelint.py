@@ -581,7 +581,7 @@ class TestCli:
 
     @pytest.mark.slow
     def test_check_umbrella_json(self, capsys):
-        # The merged three-pass payload + the shared exit contract.
+        # The merged four-pass payload + the shared exit contract.
         import json
 
         assert self._run(["check", "--set", "small",
@@ -592,6 +592,12 @@ class TestCli:
         assert payload["jaxlint"]["findings"] == []
         assert payload["rangelint"]["findings"] == []
         assert payload["rangelint"]["certificates"]
+        assert payload["equivlint"]["findings"] == []
+        assert payload["equivlint"]["failed"] == 0
+        assert payload["equivlint"]["golden_diffs"] == 0
+        assert (payload["equivlint"]["proved"]
+                + payload["equivlint"]["witnessed"]
+                == payload["equivlint"]["pairs"])
         assert set(payload["wall_s"]) >= {
-            "tracelint", "jaxlint", "rangelint", "trace",
+            "tracelint", "jaxlint", "rangelint", "trace", "equivlint",
         }
